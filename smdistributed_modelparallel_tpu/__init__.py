@@ -1,0 +1,191 @@
+"""smdistributed_modelparallel_tpu — TPU-native model-parallelism framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of AWS SageMaker's
+``smdistributed.modelparallel`` (reference surveyed in /root/repo/SURVEY.md):
+pipeline, tensor, data, context and sharded-data parallelism behind the
+``smp.init`` / ``@smp.step`` / ``smp.DistributedModel`` /
+``smp.DistributedOptimizer`` API, lowered to a single SPMD program over a
+``jax.sharding.Mesh`` instead of the reference's MPMD module-server runtime.
+
+Typical use::
+
+    import smdistributed_modelparallel_tpu as smp
+
+    smp.init({"pipeline_parallel_degree": 4, "microbatches": 8, "ddp": True})
+    model = smp.DistributedModel(module, loss_fn=...)
+    optimizer = smp.DistributedOptimizer(optax.adamw(1e-4), model)
+
+    @smp.step
+    def train_step(model, batch):
+        loss = model(batch)
+        model.backward(loss)
+        return loss
+
+    losses = train_step(model, batch)   # StepOutput
+    optimizer.step()
+"""
+
+from smdistributed_modelparallel_tpu.backend.config import ModelParallelConfig
+from smdistributed_modelparallel_tpu.backend.collectives import (
+    CollectiveCommunicator,
+    CommGroup,
+    RankType,
+)
+from smdistributed_modelparallel_tpu.backend.split import StepOutput, TensorSplitter
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils import exceptions
+from smdistributed_modelparallel_tpu.utils.exceptions import (
+    SMPError,
+    SMPRuntimeError,
+    SMPUnsupportedError,
+    SMPValidationError,
+)
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+__version__ = "0.1.0"
+
+WORLD = CommGroup.WORLD
+PP_GROUP = CommGroup.PP_GROUP
+TP_GROUP = CommGroup.TP_GROUP
+DP_GROUP = CommGroup.DP_GROUP
+RDP_GROUP = CommGroup.RDP_GROUP
+MP_GROUP = CommGroup.MP_GROUP
+
+
+def init(config=None, devices=None):
+    """Initialize the framework.
+
+    Parity: reference ``torch/__init__.py:88-176`` (``smp.init``) — config
+    validation, backend init, topology construction. The reference also
+    launches a C++ listener thread and patches ``nn.Module``; neither has a
+    TPU counterpart (there are no in-flight requests, and module recording
+    happens at DistributedModel construction).
+    """
+    cfg = config if isinstance(config, ModelParallelConfig) else ModelParallelConfig(config)
+    state.initialize(cfg, devices=devices)
+    return cfg
+
+
+def is_initialized():
+    return state.initialized
+
+
+def shutdown():
+    state.core.shutdown()
+    state.reset()
+
+
+def reset():
+    """Testing hook: drop model/optimizer/step registrations."""
+    state.reset()
+
+
+# -- rank / size / group queries (parity: backend/core.py:434-489) ------
+
+def rank():
+    return state.core.rank()
+
+
+def size():
+    return state.core.size()
+
+
+def local_rank():
+    return state.core.local_rank()
+
+
+def local_size():
+    return state.core.local_size()
+
+
+def pp_rank():
+    return state.core.pp_rank()
+
+
+def tp_rank():
+    return state.core.tp_rank()
+
+
+def rdp_rank():
+    return state.core.rdp_rank()
+
+
+def dp_rank():
+    return state.core.dp_rank()
+
+
+def mp_rank():
+    return state.core.mp_rank()
+
+
+def cp_rank():
+    return state.core.cp_rank()
+
+
+def pp_size():
+    return state.core.pp_size()
+
+
+def tp_size():
+    return state.core.tp_size()
+
+
+def rdp_size():
+    return state.core.rdp_size()
+
+
+def dp_size():
+    return state.core.dp_size()
+
+
+def mp_size():
+    return state.core.mp_size()
+
+
+def cp_size():
+    return state.core.cp_size()
+
+
+def num_microbatches():
+    return state.cfg.microbatches if state.cfg else 1
+
+
+def get_pp_group():
+    return state.core.get_pp_group()
+
+
+def get_tp_group():
+    return state.core.get_tp_group()
+
+
+def get_dp_group():
+    return state.core.get_dp_group()
+
+
+def get_rdp_group():
+    return state.core.get_rdp_group()
+
+
+def get_mp_group():
+    return state.core.get_mp_group()
+
+
+def get_world_group():
+    return state.core.get_world_group()
+
+
+def get_mesh():
+    """The jax.sharding.Mesh for the current topology (TPU-native addition)."""
+    return state.mesh
+
+
+def barrier(group=CommGroup.WORLD):
+    state.core.barrier()
+
+
+def process_index():
+    return state.core.process_index()
+
+
+def process_count():
+    return state.core.process_count()

@@ -1,0 +1,4 @@
+"""Framework-independent backend core (config, topology, state, splitting).
+
+Parity target: reference ``smdistributed/modelparallel/backend/`` (SURVEY §2.2).
+"""

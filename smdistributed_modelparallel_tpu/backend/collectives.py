@@ -1,0 +1,98 @@
+"""Host-side control-plane collectives over pickled Python objects.
+
+Parity target: reference ``backend/collectives.py:69-348``
+(``CollectiveCommunicator`` / ``CommGroup`` / ``RankType``), which rides the
+C++ async object P2P layer (SURVEY §2.1 N2). The TPU build's control plane
+needs far less: under SPMD there is one program, so the reference's
+trace-result broadcast / request routing vanish. What remains is host-level
+coordination between *processes* (config agreement, partition-result
+broadcast under multi-host, checkpoint rendezvous), implemented over
+``jax.experimental.multihost_utils`` — pickled objects ride a uint8 device
+array broadcast. Single-process runs short-circuit to local no-ops.
+"""
+
+import pickle
+from enum import Enum
+
+import numpy as np
+
+import jax
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils.exceptions import SMPRuntimeError
+
+
+class CommGroup(Enum):
+    """Parity: reference ``backend/collectives.py:15-58``."""
+
+    WORLD = 0
+    PP_GROUP = 1
+    TP_GROUP = 2
+    DP_GROUP = 3
+    RDP_GROUP = 4
+    MP_GROUP = 5
+    CP_GROUP = 6  # TPU extension
+
+
+class RankType(Enum):
+    WORLD_RANK = 0
+    PP_RANK = 1
+    TP_RANK = 2
+    DP_RANK = 3
+    RDP_RANK = 4
+    MP_RANK = 5
+
+
+class CollectiveCommunicator:
+    """Object broadcast/allgather across *host processes*.
+
+    Note: reference collectives address per-GPU ranks; here device-level
+    data movement happens inside compiled programs (psum/all_gather/...),
+    and this class only coordinates host processes.
+    """
+
+    def __init__(self):
+        self._tx_counter = 0
+
+    def _multi(self):
+        return jax.process_count() > 1
+
+    def broadcast(self, obj, group=CommGroup.WORLD, src=0):
+        """Broadcast a picklable object from process `src` to all processes."""
+        if not self._multi():
+            return obj
+        from jax.experimental import multihost_utils
+
+        payload = pickle.dumps(obj) if jax.process_index() == src else b""
+        # Length-prefix exchange, then the payload as a uint8 array.
+        n = multihost_utils.broadcast_one_to_all(
+            np.array([len(payload)], dtype=np.int64), is_source=jax.process_index() == src
+        )
+        buf = np.frombuffer(payload.ljust(int(n[0]), b"\0"), dtype=np.uint8)
+        out = multihost_utils.broadcast_one_to_all(
+            buf, is_source=jax.process_index() == src
+        )
+        return pickle.loads(np.asarray(out).tobytes()[: int(n[0])])
+
+    def allgather(self, obj, group=CommGroup.WORLD):
+        """Gather a picklable object from every process; returns a list
+        indexed by process_index."""
+        if not self._multi():
+            return [obj]
+        from jax.experimental import multihost_utils
+
+        gathered = []
+        for src in range(jax.process_count()):
+            gathered.append(self.broadcast(obj, group=group, src=src))
+        return gathered
+
+    def barrier(self, name="smp_ccl_barrier"):
+        state.core.barrier(name)
+
+    def send(self, obj, dest, group=CommGroup.WORLD):
+        raise SMPRuntimeError(
+            "Point-to-point host messaging has no SPMD counterpart; use "
+            "broadcast/allgather, or lax collectives inside the compiled step."
+        )
+
+    recv_from = send

@@ -1,0 +1,242 @@
+"""Configuration engine.
+
+Parity target: reference ``backend/config.py:181-306`` — dependency-ordered
+evaluation of a declarative schema (``DependencyIterator``), type/options/
+bounds checks, aliases, cross-parameter ``requires`` / ``requires_not`` /
+``requires_either`` constraints, arithmetic default formulas, and SageMaker
+environment injection via ``SM_HP_MP_PARAMETERS``.
+"""
+
+import json
+import os
+import re
+
+from smdistributed_modelparallel_tpu.backend.schema import SCHEMA
+from smdistributed_modelparallel_tpu.utils.exceptions import ConfigError
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+_FORMULA_REF = re.compile(r"\(([A-Za-z_][A-Za-z0-9_]*)\)")
+
+
+class DependencyIterator:
+    """Yield schema keys so every key appears after its declared dependencies.
+
+    Parity: reference ``backend/config.py:181-200``.
+    """
+
+    def __init__(self, schema):
+        self.schema = schema
+
+    def __iter__(self):
+        emitted = set()
+        pending = list(self.schema.keys())
+        while pending:
+            progressed = False
+            remaining = []
+            for key in pending:
+                deps = self.schema[key].get("dependencies", [])
+                if all(d in emitted for d in deps):
+                    emitted.add(key)
+                    progressed = True
+                    yield key
+                else:
+                    remaining.append(key)
+            if not progressed:
+                raise ConfigError(f"Circular dependency among config keys: {remaining}")
+            pending = remaining
+
+
+def _eval_formula(expr, values):
+    """Evaluate an arithmetic default/bound like ``(pipeline_parallel_degree) + 2``."""
+
+    def sub(m):
+        name = m.group(1)
+        if name not in values:
+            raise ConfigError(f"Formula references unknown/unevaluated key '{name}': {expr}")
+        return repr(values[name])
+
+    py = _FORMULA_REF.sub(sub, expr)
+    if not re.fullmatch(r"[0-9eE\.\+\-\*/\(\) ]+", py):
+        raise ConfigError(f"Unsafe formula: {expr!r}")
+    return eval(py)  # noqa: S307 - validated to arithmetic-only above
+
+
+def _coerce(key, value, types):
+    if isinstance(types, type):
+        types = (types,)
+    if bool in types and not isinstance(value, bool) and value in (0, 1):
+        # Schema bools accept 0/1 from JSON/env configs.
+        return bool(value)
+    if isinstance(value, bool) and bool not in types:
+        raise ConfigError(f"Config '{key}': expected {types}, got bool {value}")
+    if isinstance(value, tuple(t for t in types if t is not type(None))):
+        return value
+    # ints are acceptable where floats are required; floats with integral value
+    # are acceptable where ints are required (matches 5e8-style YAML defaults).
+    if float in types and isinstance(value, int):
+        return float(value)
+    if int in types and isinstance(value, float) and value == int(value):
+        return int(value)
+    if type(None) in types and value is None:
+        return None
+    raise ConfigError(f"Config '{key}': expected {types}, got {type(value).__name__} {value!r}")
+
+
+class ModelParallelConfig:
+    """Validated, attribute-accessible configuration.
+
+    Parity: reference ``backend/config.py:203-306``.
+    """
+
+    def __init__(self, user_config=None):
+        user_config = dict(user_config or {})
+        env_cfg = os.environ.get("SM_HP_MP_PARAMETERS")
+        if env_cfg and not user_config:
+            try:
+                user_config = json.loads(env_cfg)
+            except json.JSONDecodeError as e:
+                raise ConfigError(f"SM_HP_MP_PARAMETERS is not valid JSON: {e}")
+
+        # Resolve aliases (e.g. partitions -> pipeline_parallel_degree).
+        alias_map = {
+            spec["alias"]: key for key, spec in SCHEMA.items() if "alias" in spec
+        }
+        resolved = {}
+        for key, value in user_config.items():
+            canonical = alias_map.get(key, key)
+            if canonical not in SCHEMA:
+                raise ConfigError(f"Unknown config key '{key}'")
+            if canonical in resolved:
+                raise ConfigError(f"Config key '{canonical}' specified twice (via alias '{key}')")
+            resolved[canonical] = value
+
+        values = {}
+        for key in DependencyIterator(SCHEMA):
+            spec = SCHEMA[key]
+            if key in resolved:
+                value = _coerce(key, resolved[key], spec["type"])
+                if spec.get("deprecated"):
+                    logger.warning(
+                        "Config '%s' is deprecated; use '%s'.", key, spec.get("replacement")
+                    )
+            else:
+                value = spec["default"]
+                if isinstance(value, str) and _FORMULA_REF.search(value) and spec["type"] is int:
+                    value = int(_eval_formula(value, values))
+                    # Computed defaults are clamped into bounds rather than
+                    # rejected (e.g. active_microbatches = pp+2 > microbatches).
+                    value = self._clamp(spec, value, values)
+            if value is not None:
+                self._check_bounds(key, spec, value, values)
+                self._check_options(key, spec, value)
+            values[key] = value
+
+        for key, spec in SCHEMA.items():
+            self._check_requires(key, spec, values)
+
+        self._values = values
+        self._validate_cross(values)
+
+    @staticmethod
+    def _clamp(spec, value, values):
+        lo, hi = spec.get("lower_bound"), spec.get("upper_bound")
+        if isinstance(lo, str):
+            lo = _eval_formula(lo, values)
+        if isinstance(hi, str):
+            hi = _eval_formula(hi, values)
+        if lo is not None:
+            value = max(value, lo)
+        if hi is not None:
+            value = min(value, hi)
+        return value
+
+    @staticmethod
+    def _check_bounds(key, spec, value, values):
+        for bound_name, op in (("lower_bound", "<"), ("upper_bound", ">")):
+            bound = spec.get(bound_name)
+            if bound is None:
+                continue
+            if isinstance(bound, str):
+                bound = _eval_formula(bound, values)
+            if (op == "<" and value < bound) or (op == ">" and value > bound):
+                raise ConfigError(
+                    f"Config '{key}'={value} violates {bound_name}={bound}"
+                )
+
+    @staticmethod
+    def _check_options(key, spec, value):
+        options = spec.get("options")
+        if options is not None and value not in options:
+            raise ConfigError(f"Config '{key}'={value!r} not in allowed options {options}")
+
+    @staticmethod
+    def _check_requires(key, spec, values):
+        value = values[key]
+        default = spec["default"]
+        is_non_default = value != default or (isinstance(default, str) and _FORMULA_REF.search(str(default)))
+        if not is_non_default:
+            return
+        for dep, required in spec.get("requires", {}).items():
+            if values[dep] != required:
+                raise ConfigError(
+                    f"Config '{key}'={value} requires '{dep}'={required}, got {values[dep]}"
+                )
+        for dep, forbidden in spec.get("requires_not", {}).items():
+            if values[dep] == forbidden:
+                raise ConfigError(
+                    f"Config '{key}'={value} requires '{dep}' != {forbidden!r}"
+                )
+        req_either = spec.get("requires_either")
+        if req_either and not any(values[d] == v for d, v in req_either.items()):
+            raise ConfigError(
+                f"Config '{key}'={value} requires one of {req_either}"
+            )
+
+    def _validate_cross(self, v):
+        if v["ddp_dist_backend"] == "nccl":
+            logger.info("ddp_dist_backend=nccl accepted for compatibility; using XLA collectives.")
+            v["ddp_dist_backend"] = "xla"
+        if v["sharded_data_parallel_degree"] > 1 and not v["ddp"]:
+            # Reference enables ZeRO-2D only under ddp; mirror that requirement.
+            raise ConfigError("sharded_data_parallel_degree > 1 requires ddp: True")
+        if v["offload_activations"] and v["activation_loading_horizon"] < 1:
+            logger.warning("activation_loading_horizon=0 disables offload prefetch pipelining.")
+
+    # -- accessors ------------------------------------------------------
+
+    def __getattr__(self, name):
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def __contains__(self, name):
+        return name in self._values
+
+    def as_dict(self):
+        return dict(self._values)
+
+    def __repr__(self):
+        non_default = {
+            k: v for k, v in self._values.items() if v != SCHEMA[k]["default"]
+        }
+        return f"ModelParallelConfig({non_default})"
+
+    # Convenience composite sizes -------------------------------------
+
+    @property
+    def zero2d_enabled(self):
+        return (
+            self._values["sharded_data_parallel_degree"] > 1
+            or self._values["_sharded_data_parallelism_config"] is not None
+        )
+
+    @property
+    def half_dtype(self):
+        if self._values["bf16"]:
+            return "bfloat16"
+        if self._values["fp16"] or self._values["fp16_params"]:
+            return "float16"
+        return None

@@ -1,0 +1,199 @@
+"""Process/topology core.
+
+Parity target: reference ``backend/core.py:191-562`` (``ModelParallelCore``).
+The reference wraps a C++ MPI/NCCL backend (ctypes ``smp_init`` etc., SURVEY
+§2.1 N1); on TPU the same responsibilities map to:
+
+- bootstrap: ``jax.distributed.initialize`` (multi-host) — no MPI;
+- rank/group queries: pure ``Ranker`` arithmetic over device indices
+  (reference ranks are 1:1 with GPUs; here 1:1 with TPU chips);
+- barrier: ``multihost_utils.sync_global_devices``;
+- timeline: see ``utils/timeline.py`` (host-side Perfetto trace, replacing
+  the C++ ``smp_create_timeline`` family, SURVEY §2.1 N5).
+
+One deliberate semantic difference: the reference runs one process per GPU,
+so ``rank()`` is both a process and a device id. A JAX process drives many
+local TPU chips; device-level queries (pp_rank/tp_rank/...) answer for a
+given device index (default: this process's first addressable device), while
+``process_index()`` exposes the host-level id for checkpoint coordination.
+"""
+
+import atexit
+import os
+
+import jax
+
+from smdistributed_modelparallel_tpu.backend.topology import DeviceTopology
+from smdistributed_modelparallel_tpu.utils.exceptions import NotInitializedError
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+class ModelParallelCore:
+    def __init__(self):
+        self.cfg = None
+        self.topology = None
+        self._initialized = False
+        self._timeline = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def initialize(self, cfg, devices=None):
+        if self._initialized:
+            logger.warning("smp core already initialized; re-initializing topology.")
+        self.cfg = cfg
+        self._maybe_init_distributed()
+        self.topology = DeviceTopology(cfg, devices=devices)
+        self._initialized = True
+        atexit.register(self.shutdown)
+        logger.info("Initialized %r over %d device(s), %d process(es).",
+                    self.topology, self.topology.size, jax.process_count())
+
+    def _maybe_init_distributed(self):
+        """Multi-host bootstrap. Under SageMaker/launcher envs with a
+        coordinator address set, bring up the JAX distributed runtime."""
+        coord = os.environ.get("SMP_COORDINATOR_ADDRESS") or os.environ.get(
+            "JAX_COORDINATOR_ADDRESS"
+        )
+        if coord and jax.process_count() == 1 and not self._initialized:
+            try:
+                jax.distributed.initialize()
+            except Exception as e:  # already initialized or single-host
+                logger.debug("jax.distributed.initialize skipped: %s", e)
+
+    def shutdown(self):
+        if not self._initialized:
+            return
+        self._initialized = False
+        if self._timeline is not None:
+            self._timeline.flush()
+
+    @property
+    def initialized(self):
+        return self._initialized
+
+    def _check(self):
+        if not self._initialized:
+            raise NotInitializedError("smp core")
+
+    # -- process-level --------------------------------------------------
+
+    def process_index(self):
+        return jax.process_index()
+
+    def process_count(self):
+        return jax.process_count()
+
+    def barrier(self, name="smp_barrier"):
+        self._check()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+
+    # -- device-level rank queries (reference API parity) ---------------
+
+    def _default_rank(self):
+        """Device index answering rank queries: first local addressable device."""
+        self._check()
+        local = self.topology.mesh.local_devices
+        if local:
+            flat = list(self.topology.mesh.devices.flat)
+            return flat.index(local[0])
+        return 0
+
+    def rank(self, device_index=None):
+        self._check()
+        return self._default_rank() if device_index is None else device_index
+
+    def size(self):
+        self._check()
+        return self.topology.size
+
+    def local_rank(self):
+        self._check()
+        return 0
+
+    def local_size(self):
+        return jax.local_device_count()
+
+    def pp_rank(self, device_index=None):
+        return self.topology.ranker.get_pp_rank(self.rank(device_index))
+
+    def tp_rank(self, device_index=None):
+        return self.topology.ranker.get_tp_rank(self.rank(device_index))
+
+    def rdp_rank(self, device_index=None):
+        return self.topology.ranker.get_rdp_rank(self.rank(device_index))
+
+    def dp_rank(self, device_index=None):
+        return self.topology.ranker.get_dp_rank(self.rank(device_index))
+
+    def mp_rank(self, device_index=None):
+        return self.topology.ranker.get_mp_rank(self.rank(device_index))
+
+    def cp_rank(self, device_index=None):
+        return self.topology.cp_rank(self.rank(device_index))
+
+    def pp_size(self):
+        self._check()
+        return self.topology.pp_size
+
+    def tp_size(self):
+        self._check()
+        return self.topology.tp_size
+
+    def rdp_size(self):
+        self._check()
+        return self.topology.d_size
+
+    def dp_size(self):
+        self._check()
+        return self.topology.dp_size
+
+    def mp_size(self):
+        self._check()
+        return self.topology.pp_size * self.topology.tp_size
+
+    def cp_size(self):
+        self._check()
+        return self.topology.cp_size
+
+    def ep_size(self):
+        self._check()
+        return self.topology.ep_size
+
+    def get_pp_group(self, device_index=None):
+        return self.topology.ranker.get_pp_group(self.rank(device_index))
+
+    def get_tp_group(self, device_index=None):
+        return self.topology.ranker.get_tp_group(self.rank(device_index))
+
+    def get_rdp_group(self, device_index=None):
+        return self.topology.ranker.get_rdp_group(self.rank(device_index))
+
+    def get_dp_group(self, device_index=None):
+        return self.topology.ranker.get_dp_group(self.rank(device_index))
+
+    def get_mp_group(self, device_index=None):
+        return self.topology.ranker.get_mp_group(self.rank(device_index))
+
+    def get_world_group(self):
+        self._check()
+        return self.topology.ranker.get_world_group()
+
+    @property
+    def mesh(self):
+        self._check()
+        return self.topology.mesh
+
+    # -- timeline -------------------------------------------------------
+
+    @property
+    def timeline(self):
+        if self._timeline is None:
+            from smdistributed_modelparallel_tpu.utils.timeline import Timeline
+
+            self._timeline = Timeline()
+        return self._timeline

@@ -1,0 +1,354 @@
+"""Declarative configuration schema.
+
+Parity target: reference ``backend/config.yaml:1-315``. Same key set and
+semantics (types, defaults, bounds, aliases, cross-parameter ``requires`` /
+``requires_not`` / ``requires_either`` constraints, arithmetic default
+formulas such as ``(pipeline_parallel_degree) + 2``), expressed as Python
+data instead of YAML, with TPU-specific re-interpretations noted per key and
+a handful of new TPU-native keys (context parallelism, sequence parallelism)
+per SURVEY.md §5.7/§7-M6.
+
+A formula default/bound is a string containing ``(other_param)`` references;
+it is evaluated after its dependencies (see ``DependencyIterator`` in
+``config.py``).
+"""
+
+# Each entry: type (a python type, a tuple of types, or 'none-able' via tuple
+# containing type(None)), default, optional lower_bound/upper_bound (number or
+# formula str), options list, alias str, requires / requires_not /
+# requires_either dicts, dependencies list, internal / deprecated flags.
+
+SCHEMA = {
+    "pipeline_parallel_degree": {
+        "type": int,
+        "default": 1,
+        "lower_bound": 1,
+        "alias": "partitions",
+        "description": "Pipeline parallelism degree.",
+    },
+    "tensor_parallel_degree": {
+        "type": int,
+        "default": 1,
+        "lower_bound": 1,
+        "requires": {"ddp": True},
+        "dependencies": ["ddp"],
+        "description": "Tensor parallelism degree.",
+    },
+    "microbatches": {
+        "type": int,
+        "default": 1,
+        "lower_bound": 1,
+        "description": "Number of microbatches the incoming batch is split into; "
+        "batch size must be divisible by this value.",
+    },
+    "pipeline": {
+        "type": str,
+        "default": "interleaved",
+        "options": ["simple", "interleaved", "_only_forward"],
+        "description": "Pipelining schedule. 'interleaved' lowers to a 1F1B "
+        "schedule in the compiled microbatch loop; 'simple' to all-forward-"
+        "then-all-backward.",
+    },
+    "horovod": {
+        "type": bool,
+        "default": False,
+        "description": "Reference-compat flag (TF/Horovod DP). Accepted, unused on TPU.",
+    },
+    "ddp": {
+        "type": bool,
+        "default": False,
+        "requires": {"horovod": False},
+        "dependencies": ["horovod"],
+        "description": "Enable data parallelism (reference: PyTorch DDP). Required "
+        "for data and tensor parallelism; on TPU this toggles the dp/rdp mesh axes.",
+    },
+    "sharded_data_parallel_degree": {
+        "type": int,
+        "default": 1,
+        "lower_bound": 1,
+        "requires": {
+            "tensor_parallel_degree": 1,
+            "pipeline_parallel_degree": 1,
+            "shard_optimizer_state": False,
+        },
+        "dependencies": [
+            "tensor_parallel_degree",
+            "pipeline_parallel_degree",
+            "shard_optimizer_state",
+        ],
+        "description": "Sharded data parallelism (reference: ZeRO-2D / DeepSpeed "
+        "stage 3). On TPU this lowers to fully-sharded parameter PartitionSpecs "
+        "over the dp axis.",
+    },
+    "sdp_reduce_bucket_size": {
+        "type": int,
+        "default": int(5e8),
+        "description": "Gradient-reduction bucket size in elements. Advisory on TPU "
+        "(XLA fuses reductions); kept for config compatibility.",
+    },
+    "sdp_param_persistence_threshold": {
+        "type": int,
+        "default": int(1e6),
+        "description": "Parameters smaller than this many elements are kept "
+        "replicated rather than sharded under sharded data parallelism.",
+    },
+    "sdp_max_live_parameters": {
+        "type": int,
+        "default": int(1e9),
+        "description": "Max number of parameters simultaneously in recombined "
+        "(allgathered) state. Advisory on TPU; XLA schedules allgathers.",
+    },
+    "sdp_hierarchical_allgather": {
+        "type": bool,
+        "default": True,
+        "description": "Hierarchical (intra- then inter-host) parameter allgather. "
+        "On TPU, ICI/DCN hierarchy is chosen by XLA from the mesh layout.",
+    },
+    "sdp_gradient_clipping": {
+        "type": float,
+        "default": 1.0,
+        "description": "Global grad-norm clip value applied under sharded data parallelism.",
+    },
+    "_sharded_data_parallelism_config": {
+        "type": (str, type(None)),
+        "default": None,
+        "internal": True,
+        "description": "Path to a JSON file overriding sharded-DP settings.",
+    },
+    "ddp_port": {
+        "type": (int, type(None)),
+        "default": None,
+        "lower_bound": 0,
+        "requires": {"ddp": True},
+        "dependencies": ["ddp"],
+        "description": "Reference-compat; coordination port for jax.distributed.",
+    },
+    "ddp_dist_backend": {
+        "type": str,
+        "default": "xla",
+        "options": ["xla", "nccl"],
+        "description": "Collective backend. On TPU always 'xla' (ICI collectives); "
+        "'nccl' is accepted for config compatibility and treated as 'xla'.",
+    },
+    "contiguous": {
+        "type": bool,
+        "default": True,
+        "description": "Force pipeline stages to be contiguous layer ranges "
+        "(reference: TF subgraph contiguity). The TPU pipeline is always "
+        "contiguous-per-stage; False is accepted and ignored.",
+    },
+    "placement_strategy": {
+        "type": str,
+        "default": "cluster",
+        "options": ["cluster", "spread", "PDT", "PTD", "DPT", "DTP", "TPD", "TDP"],
+        "description": "Mapping of (pp, rdp, tp) onto physical devices; the "
+        "right-most letter varies fastest over neighboring devices. 'cluster'="
+        "'DPT', 'spread'='TPD'. Lowers directly to jax.sharding.Mesh axis order.",
+    },
+    "optimize": {
+        "type": str,
+        "default": "speed",
+        "options": ["speed", "memory"],
+        "description": "DistributedTransformer layout: 'speed' = head-partitioned "
+        "(Megatron-style allgather/reduce), 'memory' = input-partitioned "
+        "(all-to-all scatter-merge).",
+    },
+    "auto_partition": {
+        "type": bool,
+        "default": True,
+        "requires_not": {"default_partition": None},
+        "dependencies": ["default_partition"],
+        "description": "Enable auto-partitioning of modules across pipeline stages.",
+    },
+    "default_partition": {
+        "type": (int, type(None)),
+        "default": None,
+        "lower_bound": 0,
+        "upper_bound": "(pipeline_parallel_degree) - 1",
+        "dependencies": ["pipeline_parallel_degree"],
+        "description": "Partition for modules not explicitly assigned when "
+        "auto_partition is disabled.",
+    },
+    "prescaled_batch": {
+        "type": bool,
+        "default": False,
+        "requires": {"optimize": "speed"},
+        "dependencies": ["optimize"],
+        "description": "DistributedTransformerLMHead expects the same batch on "
+        "every tp_rank (batch defined per TP group).",
+    },
+    "memory_weight": {
+        "type": float,
+        "default": 0.8,
+        "lower_bound": 0.0,
+        "upper_bound": 1.0,
+        "description": "Relative weight of memory (vs compute time) in the "
+        "auto-partitioner cost model.",
+    },
+    "active_microbatches": {
+        "type": int,
+        "default": "(pipeline_parallel_degree) + 2",
+        "lower_bound": 1,
+        "upper_bound": "(microbatches)",
+        "dependencies": ["microbatches", "pipeline_parallel_degree"],
+        "description": "Max microbatches simultaneously in flight; bounds "
+        "activation memory of the pipeline schedule.",
+    },
+    "fast_mode": {
+        "type": bool,
+        "default": False,
+        "internal": True,
+        "description": "Reference-compat. The compiled TPU pipeline always does "
+        "direct stage-to-stage transfers; accepted and ignored.",
+    },
+    "static_mode": {
+        "type": bool,
+        "default": False,
+        "internal": True,
+        "description": "Reference-compat. The TPU schedule is always static "
+        "(baked into the compiled program); accepted and ignored.",
+    },
+    "fp16": {
+        "type": bool,
+        "default": False,
+        "description": "Train in float16 with dynamic loss scaling.",
+    },
+    "bf16": {
+        "type": bool,
+        "default": False,
+        "requires": {"fp16": False, "fp16_params": False},
+        "dependencies": ["fp16", "fp16_params"],
+        "description": "Train in bfloat16 (the native TPU half precision).",
+    },
+    "fp16_params": {
+        "type": bool,
+        "default": False,
+        "deprecated": True,
+        "replacement": "fp16",
+        "description": "Deprecated; use fp16.",
+    },
+    "tensor_parallel_seed": {
+        "type": int,
+        "default": 0,
+        "lower_bound": 0,
+        "description": "Seed for random ops inside tensor-parallel distributed modules.",
+    },
+    "offload_activations": {
+        "type": bool,
+        "default": False,
+        "description": "Offload checkpointed activations to host memory during "
+        "forward, reload during backward. Only functional with activation "
+        "checkpointing.",
+    },
+    "_shard_offloaded_activations": {
+        "type": bool,
+        "default": True,
+        "internal": True,
+        "description": "Shard offloaded activations across the TP group instead "
+        "of offloading replicas from every tp_rank.",
+    },
+    "shard_optimizer_state": {
+        "type": bool,
+        "default": False,
+        "description": "Shard optimizer state across (reduced-)data-parallel ranks "
+        "(reference: virtual-parameter contiguous buffer; TPU: opt-state "
+        "PartitionSpecs over the rdp axis).",
+    },
+    "delayed_parameter_initialization": {
+        "type": bool,
+        "default": False,
+        "description": "Initialize parameters lazily/abstractly and materialize "
+        "them directly sharded on device (TPU: jax.eval_shape + sharded init).",
+    },
+    "skip_tracing": {
+        "type": bool,
+        "default": False,
+        "description": "Skip the cost-tracing pass; the auto-partitioner falls "
+        "back to parameter-count costs from jax.eval_shape.",
+    },
+    "activation_loading_horizon": {
+        "type": int,
+        "default": 4,
+        "lower_bound": 0,
+        "description": "How many offloaded layer activations may simultaneously "
+        "be resident on device awaiting consumption.",
+    },
+    "task_level_activation_loading_horizon": {
+        "type": int,
+        "default": 4,
+        "lower_bound": 1,
+        "internal": True,
+        "description": "Reference-compat scheduling knob; advisory on TPU.",
+    },
+    "herring": {
+        "type": bool,
+        "default": False,
+        "requires": {"ddp": False, "horovod": False},
+        "dependencies": ["ddp", "horovod"],
+        "internal": True,
+        "description": "Reference-compat; not functional.",
+    },
+    "_match_weights": {
+        "type": bool,
+        "default": False,
+        "internal": True,
+        "description": "Debug: slice and copy original weights into distributed modules.",
+    },
+    "_fp32_grad_accumulation": {
+        "type": bool,
+        "default": False,
+        "internal": True,
+        "requires_either": {"fp16": True, "fp16_params": True},
+        "dependencies": ["fp16", "fp16_params"],
+        "description": "Accumulate microbatch gradients in float32.",
+    },
+    "checkpoint_attentions": {
+        "type": bool,
+        "default": False,
+        "internal": True,
+        "description": "Activation-checkpoint the attention score computation in "
+        "DistributedTransformer.",
+    },
+    "load_partition": {
+        "type": bool,
+        "default": False,
+        "internal": True,
+        "description": "Load a saved partition assignment instead of repartitioning.",
+    },
+    "partition_file": {
+        "type": (str, type(None)),
+        "default": None,
+        "internal": True,
+        "description": "Path for saving/loading partition assignments.",
+    },
+    # ------------------------------------------------------------------
+    # TPU-native extensions (no reference counterpart; SURVEY.md §5.7, §7-M6)
+    # ------------------------------------------------------------------
+    "context_parallel_degree": {
+        "type": int,
+        "default": 1,
+        "lower_bound": 1,
+        "description": "TPU extension: context (sequence) parallelism degree for "
+        "long sequences; shards the sequence dimension across a 'cp' mesh axis.",
+    },
+    "context_parallel_impl": {
+        "type": str,
+        "default": "ring",
+        "options": ["ring", "ulysses", "allgather"],
+        "description": "TPU extension: ring attention (ppermute KV rotation), "
+        "Ulysses (all_to_all head/sequence exchange), or allgather-KV.",
+    },
+    "expert_parallel_degree": {
+        "type": int,
+        "default": 1,
+        "lower_bound": 1,
+        "description": "TPU extension: expert parallelism degree for MoE layers.",
+    },
+    "_device_count_override": {
+        "type": (int, type(None)),
+        "default": None,
+        "internal": True,
+        "description": "TPU extension: build the mesh over this many devices "
+        "instead of len(jax.devices()) (testing / dry-run).",
+    },
+}

@@ -1,0 +1,67 @@
+"""Global framework state singleton.
+
+Parity target: reference ``backend/state_mod.py:14-93`` (``ModelParallelState``)
+and the PyTorch-side ``torch/state_mod.py:31-418`` (``PTModelParallelState``).
+Under the SPMD design most of the reference's state (link-id maps, worker
+bookkeeping, serialization managers) disappears; what remains is the config,
+the core/topology, the current model/optimizer registrations, the module
+manager, the tp registry, and RNG management.
+"""
+
+from smdistributed_modelparallel_tpu.backend.core import ModelParallelCore
+from smdistributed_modelparallel_tpu.utils.exceptions import NotInitializedError
+
+
+class ModelParallelState:
+    def __init__(self):
+        self.cfg = None
+        self.core = ModelParallelCore()
+        self.model = None           # current smp.DistributedModel
+        self.optimizer = None       # current smp.DistributedOptimizer
+        self.module_manager = None  # set by model.py on DistributedModel creation
+        self.tp_registry = None     # lazily created TensorParallelismRegistry
+        self.rng_manager = None
+        self.step_count = 0
+        self.loaded_model_state = None      # deferred checkpoint payloads
+        self.loaded_optimizer_state = None
+
+    @property
+    def initialized(self):
+        return self.core.initialized
+
+    def initialize(self, cfg, devices=None):
+        self.cfg = cfg
+        self.core.initialize(cfg, devices=devices)
+        from smdistributed_modelparallel_tpu.utils.random import RngManager
+
+        self.rng_manager = RngManager(cfg.tensor_parallel_seed)
+        from smdistributed_modelparallel_tpu.nn.tp_registry import TensorParallelismRegistry
+
+        if self.tp_registry is None:
+            self.tp_registry = TensorParallelismRegistry()
+
+    def _check(self):
+        if not self.initialized:
+            raise NotInitializedError()
+
+    @property
+    def mesh(self):
+        self._check()
+        return self.core.mesh
+
+    @property
+    def topology(self):
+        self._check()
+        return self.core.topology
+
+    def reset(self):
+        """Testing hook: drop model/optimizer registrations and counters."""
+        self.model = None
+        self.optimizer = None
+        self.module_manager = None
+        self.step_count = 0
+        self.loaded_model_state = None
+        self.loaded_optimizer_state = None
+
+
+state = ModelParallelState()

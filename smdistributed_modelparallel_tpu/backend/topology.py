@@ -1,0 +1,107 @@
+"""Device topology: placement strategy -> jax.sharding.Mesh.
+
+TPU-native replacement for the reference's process-group construction
+(``torch/state_mod.py:83-166`` creates torch.distributed groups for
+dp/mp/pp/tp/rdp; ``backend/core.py:286`` registers pp groups with the C++
+backend). Here the whole topology is one ``jax.sharding.Mesh`` whose axis
+order is the placement permutation, so XLA lays collectives for the
+fastest-varying axis onto neighboring devices (ICI) exactly as the
+reference lays them onto neighboring GPUs.
+
+Mesh axes: the "D" letter of the placement string expands into the
+sub-axes ("rdp", "ep", "cp") — expert and context parallelism are carved
+out of the data-parallel dimension (TPU extensions; reference has only
+pp/tp/rdp). With ep == cp == 1 these are degenerate size-1 axes and the
+mesh is exactly the reference 3-axis topology.
+
+Axis name constants are the single source of truth for PartitionSpecs
+throughout the framework.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from smdistributed_modelparallel_tpu.backend.ranker import Ranker, normalize_placement
+from smdistributed_modelparallel_tpu.utils.exceptions import DeviceCountError
+
+# Canonical mesh axis names.
+PP_AXIS = "pp"
+TP_AXIS = "tp"
+RDP_AXIS = "rdp"
+EP_AXIS = "ep"
+CP_AXIS = "cp"
+
+# Axes across which a (non-prescaled) batch is sharded: every rank that holds
+# a distinct slice of data. Matches the reference's dp = tp x rdp composite
+# (``backend/core.py:49-55``) plus the TPU-only ep/cp sub-axes.
+DATA_AXES = (RDP_AXIS, EP_AXIS, CP_AXIS)
+
+
+def _letter_axes(letter):
+    if letter == "P":
+        return [PP_AXIS]
+    if letter == "T":
+        return [TP_AXIS]
+    return [RDP_AXIS, EP_AXIS, CP_AXIS]
+
+
+class DeviceTopology:
+    """Owns the Ranker, the device mesh, and degree bookkeeping."""
+
+    def __init__(self, cfg, devices=None):
+        self.cfg = cfg
+        if devices is None:
+            devices = jax.devices()
+        n = cfg._device_count_override or len(devices)
+        self.pp_size = cfg.pipeline_parallel_degree
+        self.tp_size = cfg.tensor_parallel_degree
+        self.cp_size = cfg.context_parallel_degree
+        self.ep_size = cfg.expert_parallel_degree
+        model_degree = self.pp_size * self.tp_size * self.cp_size * self.ep_size
+        if n % model_degree != 0:
+            raise DeviceCountError(model_degree, n)
+        self.rdp_size = n // model_degree
+        self.size = n
+        # Reference "D" dimension = everything that is not pp/tp.
+        self.d_size = self.rdp_size * self.cp_size * self.ep_size
+        self.dp_size = self.tp_size * self.d_size
+
+        self.placement = normalize_placement(cfg.placement_strategy)
+        self.ranker = Ranker(self.placement, self.d_size, self.pp_size, self.tp_size)
+
+        axis_names, axis_sizes = [], []
+        for letter in self.placement:
+            for ax in _letter_axes(letter):
+                axis_names.append(ax)
+                axis_sizes.append(getattr(self, f"{ax}_size"))
+        self.axis_names = tuple(axis_names)
+        self.axis_sizes = tuple(axis_sizes)
+
+        device_grid = np.asarray(devices[:n], dtype=object).reshape(axis_sizes)
+        self.mesh = Mesh(device_grid, self.axis_names)
+
+    # -- sub-axis coordinates for a global rank -------------------------
+
+    def coords(self, rank):
+        """Dict of mesh-axis name -> coordinate for a global rank index."""
+        out = {}
+        rem = rank
+        # Unravel in placement (mesh) order: later axes vary fastest.
+        for name, size in zip(reversed(self.axis_names), reversed(self.axis_sizes)):
+            out[name] = rem % size
+            rem //= size
+        return out
+
+    def cp_rank(self, rank):
+        return self.coords(rank)[CP_AXIS]
+
+    def ep_rank(self, rank):
+        return self.coords(rank)[EP_AXIS]
+
+    def __repr__(self):
+        dims = "x".join(
+            f"{n}={s}" for n, s in zip(self.axis_names, self.axis_sizes)
+        )
+        return f"DeviceTopology({dims}, placement={self.placement})"

@@ -1,0 +1,71 @@
+"""Typed exception hierarchy.
+
+Parity target: reference ``backend/exceptions.py:1-76`` (SMPValidationError /
+SMPRuntimeError hierarchy) and the ~70 typed errors of ``torch/exceptions.py``.
+Only the errors meaningful under an SPMD/XLA runtime are kept; the
+request/response-runtime errors of the reference (dummy-tensor misuse, link
+exhaustion, ...) have no TPU-native counterpart.
+"""
+
+
+class SMPError(Exception):
+    """Base class for all framework errors."""
+
+
+class SMPValidationError(SMPError):
+    """User-facing configuration / usage validation error."""
+
+
+class SMPRuntimeError(SMPError):
+    """Internal invariant violation."""
+
+
+class SMPUnsupportedError(SMPError):
+    """Feature exists in the reference API but is not supported in this build."""
+
+
+class NotInitializedError(SMPValidationError):
+    def __init__(self, what="smp"):
+        super().__init__(
+            f"{what} has not been initialized. Call smp.init(config) before using the framework."
+        )
+
+
+class ConfigError(SMPValidationError):
+    """Invalid configuration value or combination (schema validation)."""
+
+
+class DeviceCountError(SMPValidationError):
+    def __init__(self, required, available):
+        super().__init__(
+            f"Model-parallel degree product ({required} = pipeline * tensor * context "
+            f"* expert) must divide the device count ({available} available)."
+        )
+
+
+class MicrobatchError(SMPValidationError):
+    """Batch not divisible into the configured number of microbatches."""
+
+
+class PartitionError(SMPValidationError):
+    """Invalid manual partition assignment or partitioner failure."""
+
+
+class TensorParallelismError(SMPValidationError):
+    """Invalid tensor-parallelism registration or module distribution failure."""
+
+
+class StepUsageError(SMPValidationError):
+    """Misuse of @smp.step (e.g. model.backward never called, nested steps)."""
+
+
+class CheckpointError(SMPValidationError):
+    """Checkpoint save/load failure or incompatible smp config on resume."""
+
+
+class DelayedParamError(SMPRuntimeError):
+    """Materialization of delayed-initialized parameters failed."""
+
+
+class OffloadError(SMPRuntimeError):
+    """Activation offloading failure."""

@@ -1,0 +1,45 @@
+"""RNG management.
+
+Parity target: reference ``torch/random.py:8-34`` (``RngManager`` with
+``consistent_rng_state`` across tp_ranks) and the RNG fork contexts of
+``torch/state_mod.py:354-397``. JAX PRNG keys are explicit and splittable,
+which makes the reference's state save/restore dance unnecessary: we keep a
+named-stream key tree and fold axis indices in where per-rank divergence is
+wanted.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class RngManager:
+    def __init__(self, tensor_parallel_seed=0):
+        self.base_seed = int(tensor_parallel_seed)
+        self._root = jax.random.key(self.base_seed)
+        self._counters = {}
+
+    def next_key(self, stream="default"):
+        """A fresh key on a named stream; identical across all callers with
+        the same call history (the reference's 'consistent RNG across
+        tp_ranks' — in SPMD, sameness is automatic because there is one
+        trace)."""
+        count = self._counters.get(stream, 0)
+        self._counters[stream] = count + 1
+        return jax.random.fold_in(jax.random.fold_in(self._root, hash(stream) % (2**31)), count)
+
+    def per_rank_key(self, stream, axis_name):
+        """A key that differs along a mesh axis, for use inside shard_map
+        (e.g. dropout under tensor parallelism)."""
+        return jax.random.fold_in(self.next_key(stream), jax.lax.axis_index(axis_name))
+
+    def init_rngs(self, streams=("params", "dropout")):
+        return {s: self.next_key(s) for s in streams}
+
+    def reset(self):
+        self._counters.clear()
+
+
+def dropout_keys_consistent(key, shape):
+    """Helper for TP modules: dropout mask identical across tp ranks (weights
+    are sharded, activations replicated on the sharded dim)."""
+    return jax.random.bernoulli(key, shape=shape)

@@ -1,0 +1,84 @@
+"""Pipeline timeline: Chrome-trace (Perfetto-loadable) event recording.
+
+Parity target: reference C++ timeline (``smp_create_timeline`` /
+``smp_timeline_start_step`` / ``smp_timeline_end_step`` /
+``smp_timeline_record_pipeline_event`` — SURVEY §2.1 N5, called around every
+server action in ``torch/server.py:366-478``). The TPU build has no server
+loop; events bracket host-side phases (trace, partition, compile, step) and
+per-step device execution, and the JSON file loads in chrome://tracing or
+Perfetto alongside ``jax.profiler`` traces.
+"""
+
+import json
+import os
+import threading
+import time
+
+_DEFAULT_PATH = os.environ.get("SMP_TIMELINE_PATH", "")
+
+
+class Timeline:
+    def __init__(self, path=None):
+        self.path = path or _DEFAULT_PATH
+        self.enabled = bool(self.path)
+        self._events = []
+        self._lock = threading.Lock()
+        self._step = -1
+        self._t0 = time.perf_counter()
+
+    def _now_us(self):
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def start_step(self, step):
+        self._step = step
+        self.record_instant(f"step_{step}_begin")
+
+    def end_step(self, step):
+        self.record_instant(f"step_{step}_end")
+
+    def record_event(self, name, begin_us, end_us, microbatch=None, track="pipeline"):
+        if not self.enabled:
+            return
+        args = {"step": self._step}
+        if microbatch is not None:
+            args["microbatch"] = microbatch
+        with self._lock:
+            self._events.append(
+                {"name": name, "ph": "X", "ts": begin_us, "dur": end_us - begin_us,
+                 "pid": 0, "tid": track, "args": args}
+            )
+
+    def record_instant(self, name, track="pipeline"):
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(
+                {"name": name, "ph": "i", "ts": self._now_us(), "pid": 0,
+                 "tid": track, "s": "g"}
+            )
+
+    class _Span:
+        def __init__(self, timeline, name, microbatch, track):
+            self.timeline, self.name, self.microbatch, self.track = timeline, name, microbatch, track
+
+        def __enter__(self):
+            self.begin = self.timeline._now_us()
+            return self
+
+        def __exit__(self, *exc):
+            self.timeline.record_event(
+                self.name, self.begin, self.timeline._now_us(),
+                microbatch=self.microbatch, track=self.track,
+            )
+            return False
+
+    def span(self, name, microbatch=None, track="host"):
+        return self._Span(self, name, microbatch, track)
+
+    def flush(self):
+        if not self.enabled or not self._events:
+            return
+        with self._lock:
+            payload = {"traceEvents": self._events, "displayTimeUnit": "ms"}
+            with open(self.path, "w") as f:
+                json.dump(payload, f)

@@ -1,0 +1,135 @@
+"""Config engine tests. Mirrors reference ``test/backend/test_config.py``
+strategy: defaults, aliases, bounds, options, requires-chains, formulas."""
+
+import json
+
+import pytest
+
+from smdistributed_modelparallel_tpu.backend.config import ModelParallelConfig
+from smdistributed_modelparallel_tpu.utils.exceptions import ConfigError
+
+
+def test_defaults():
+    cfg = ModelParallelConfig({})
+    assert cfg.pipeline_parallel_degree == 1
+    assert cfg.tensor_parallel_degree == 1
+    assert cfg.microbatches == 1
+    assert cfg.pipeline == "interleaved"
+    assert cfg.placement_strategy == "cluster"
+    assert cfg.optimize == "speed"
+    assert cfg.memory_weight == 0.8
+    assert cfg.ddp is False
+    assert cfg.active_microbatches == 1  # capped by upper bound (microbatches)=1
+
+
+def test_active_microbatches_formula():
+    cfg = ModelParallelConfig({"pipeline_parallel_degree": 4, "microbatches": 8})
+    assert cfg.active_microbatches == 6  # pp + 2
+    cfg = ModelParallelConfig(
+        {"pipeline_parallel_degree": 4, "microbatches": 8, "active_microbatches": 3}
+    )
+    assert cfg.active_microbatches == 3
+
+
+def test_active_microbatches_default_capped_at_microbatches():
+    # default formula pp+2 = 6 > microbatches=4 must not raise; reference
+    # evaluates the default then bounds-checks explicit values only... we cap.
+    cfg = ModelParallelConfig({"pipeline_parallel_degree": 4, "microbatches": 4})
+    assert cfg.active_microbatches <= 4
+
+
+def test_partitions_alias():
+    cfg = ModelParallelConfig({"partitions": 4, "microbatches": 4})
+    assert cfg.pipeline_parallel_degree == 4
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"partitions": 2, "pipeline_parallel_degree": 2})
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"no_such_key": 1})
+
+
+def test_type_and_bounds():
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"pipeline_parallel_degree": 0})
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"pipeline_parallel_degree": "two"})
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"memory_weight": 1.5})
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"pipeline": "zigzag"})
+
+
+def test_tp_requires_ddp():
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"tensor_parallel_degree": 2})
+    cfg = ModelParallelConfig({"tensor_parallel_degree": 2, "ddp": True})
+    assert cfg.tensor_parallel_degree == 2
+
+
+def test_ddp_conflicts_horovod():
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"ddp": True, "horovod": True})
+
+
+def test_bf16_fp16_exclusive():
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"bf16": True, "fp16": True})
+    assert ModelParallelConfig({"bf16": True}).half_dtype == "bfloat16"
+    assert ModelParallelConfig({"fp16": True}).half_dtype == "float16"
+    assert ModelParallelConfig({}).half_dtype is None
+
+
+def test_sdp_requires():
+    with pytest.raises(ConfigError):
+        ModelParallelConfig(
+            {"sharded_data_parallel_degree": 4, "pipeline_parallel_degree": 2,
+             "microbatches": 2, "ddp": True}
+        )
+    cfg = ModelParallelConfig({"sharded_data_parallel_degree": 4, "ddp": True})
+    assert cfg.zero2d_enabled
+
+
+def test_auto_partition_off_needs_default_partition():
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"auto_partition": False})
+    cfg = ModelParallelConfig(
+        {"auto_partition": False, "default_partition": 1, "pipeline_parallel_degree": 2,
+         "microbatches": 2}
+    )
+    assert cfg.default_partition == 1
+    with pytest.raises(ConfigError):
+        ModelParallelConfig(
+            {"auto_partition": False, "default_partition": 3, "pipeline_parallel_degree": 2,
+             "microbatches": 2}
+        )
+
+
+def test_prescaled_batch_requires_speed():
+    with pytest.raises(ConfigError):
+        ModelParallelConfig({"prescaled_batch": True, "optimize": "memory"})
+
+
+def test_nccl_backend_coerced_to_xla():
+    cfg = ModelParallelConfig({"ddp_dist_backend": "nccl", "ddp": True})
+    assert cfg.ddp_dist_backend == "xla"
+
+
+def test_sagemaker_env_injection(monkeypatch):
+    monkeypatch.setenv(
+        "SM_HP_MP_PARAMETERS", json.dumps({"partitions": 2, "microbatches": 4})
+    )
+    cfg = ModelParallelConfig()
+    assert cfg.pipeline_parallel_degree == 2
+    assert cfg.microbatches == 4
+
+
+def test_bool_coercion_from_json_int():
+    cfg = ModelParallelConfig({"ddp": 1})
+    assert cfg.ddp is True
+
+
+def test_float_scientific_to_int():
+    cfg = ModelParallelConfig({"sdp_reduce_bucket_size": 5e8})
+    assert cfg.sdp_reduce_bucket_size == int(5e8)

@@ -1,0 +1,97 @@
+"""Ranker tests.
+
+Mirrors reference ``test/backend/test_ranker.py`` strategy (SURVEY §4):
+exhaustive checks over all placement permutations, plus the documented
+golden example from the reference config schema (placement_strategy docs:
+8 devices, DPT, all degrees 2 -> TP {0,1}..., PP {0,2}..., RDP {0,4}...).
+"""
+
+import itertools
+
+import pytest
+
+from smdistributed_modelparallel_tpu.backend.ranker import Ranker
+
+PERMS = ["".join(p) for p in itertools.permutations("PDT")] + ["cluster", "spread"]
+
+
+def test_documented_dpt_example():
+    r = Ranker("DPT", rdp_size=2, pp_size=2, tp_size=2)
+    assert r.get_tp_group(0) == [0, 1]
+    assert r.get_tp_group(3) == [2, 3]
+    assert r.get_tp_group(5) == [4, 5]
+    assert r.get_tp_group(7) == [6, 7]
+    assert r.get_pp_group(0) == [0, 2]
+    assert r.get_pp_group(1) == [1, 3]
+    assert r.get_pp_group(4) == [4, 6]
+    assert r.get_pp_group(5) == [5, 7]
+    assert r.get_rdp_group(0) == [0, 4]
+    assert r.get_rdp_group(1) == [1, 5]
+    assert r.get_rdp_group(2) == [2, 6]
+    assert r.get_rdp_group(3) == [3, 7]
+
+
+def test_aliases():
+    for alias, canonical in [("cluster", "DPT"), ("spread", "TPD")]:
+        a = Ranker(alias, 2, 2, 2)
+        c = Ranker(canonical, 2, 2, 2)
+        for rank in range(8):
+            assert a.get_pp_rank(rank) == c.get_pp_rank(rank)
+            assert a.get_tp_group(rank) == c.get_tp_group(rank)
+            assert a.get_dp_group(rank) == c.get_dp_group(rank)
+
+
+@pytest.mark.parametrize("ps", PERMS)
+@pytest.mark.parametrize("sizes", [(1, 1, 1), (2, 2, 2), (3, 2, 4), (1, 4, 2), (2, 1, 3)])
+def test_partition_properties(ps, sizes):
+    rdp, pp, tp = sizes
+    r = Ranker(ps, rdp, pp, tp)
+    world = set(range(r.size))
+
+    for dim, get_group, get_rank, dim_size in [
+        ("pp", r.get_pp_group, r.get_pp_rank, pp),
+        ("tp", r.get_tp_group, r.get_tp_rank, tp),
+        ("rdp", r.get_rdp_group, r.get_rdp_rank, rdp),
+        ("dp", r.get_dp_group, r.get_dp_rank, tp * rdp),
+        ("mp", r.get_mp_group, r.get_mp_rank, pp * tp),
+    ]:
+        seen = set()
+        for rank in range(r.size):
+            group = get_group(rank)
+            assert len(group) == dim_size, dim
+            assert rank in group, dim
+            # The member's rank-within-group must equal its position.
+            assert group[get_rank(rank)] == rank, dim
+            seen.update(group)
+            # Every member of the group must agree on the group.
+            for m in group:
+                assert get_group(m) == group, dim
+        assert seen == world, dim
+
+
+@pytest.mark.parametrize("ps", PERMS)
+def test_translate_roundtrip(ps):
+    r = Ranker(ps, rdp_size=2, pp_size=3, tp_size=2)
+    for rank in range(r.size):
+        assert r.translate(r.get_pp_rank(rank), r.get_tp_rank(rank), r.get_rdp_rank(rank)) == rank
+
+
+@pytest.mark.parametrize("ps", PERMS)
+def test_composite_decompositions(ps):
+    r = Ranker(ps, rdp_size=2, pp_size=2, tp_size=4)
+    for rank in range(r.size):
+        dp = r.get_dp_rank(rank)
+        assert r.get_rdp_rank_from_dp_rank(dp) == r.get_rdp_rank(rank)
+        assert r.get_tp_rank_from_dp_rank(dp) == r.get_tp_rank(rank)
+        mp = r.get_mp_rank(rank)
+        assert r.get_pp_rank_from_mp_rank(mp) == r.get_pp_rank(rank)
+        assert r.get_tp_rank_from_mp_rank(mp) == r.get_tp_rank(rank)
+
+
+def test_neighboring_ranks_vary_rightmost_letter():
+    # Right-most placement letter varies fastest: with TDP, neighboring ranks
+    # are PP neighbors.
+    r = Ranker("TDP", rdp_size=2, pp_size=2, tp_size=2)
+    assert r.get_pp_group(0) == [0, 1]
+    r2 = Ranker("PDT", rdp_size=2, pp_size=2, tp_size=2)
+    assert r2.get_tp_group(0) == [0, 1]

@@ -1,0 +1,91 @@
+"""TensorSplitter / StepOutput tests. Mirrors reference ``test_split.py``:
+nested structures, non_split_inputs, input_split_axes, smp_slice protocol,
+divisibility errors, and StepOutput reductions."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from smdistributed_modelparallel_tpu.backend.split import (
+    NonSplit,
+    StepOutput,
+    TensorSplitter,
+    microbatch_slice,
+)
+from smdistributed_modelparallel_tpu.utils.exceptions import MicrobatchError
+
+
+def test_basic_split():
+    sp = TensorSplitter(4)
+    x = jnp.arange(8 * 3).reshape(8, 3)
+    (stacked,), _ = sp.stack_microbatches((x,), {}, arg_names=["x"])
+    assert stacked.shape == (4, 2, 3)
+    np.testing.assert_array_equal(microbatch_slice(stacked, 1), np.asarray(x[2:4]))
+
+
+def test_nested_structures():
+    sp = TensorSplitter(2)
+    batch = {"ids": jnp.ones((4, 5)), "inner": [jnp.zeros((4,)), jnp.ones((4, 2))]}
+    (stacked,), _ = sp.stack_microbatches((batch,), {}, arg_names=["batch"])
+    assert stacked["ids"].shape == (2, 2, 5)
+    assert stacked["inner"][0].shape == (2, 2)
+    assert stacked["inner"][1].shape == (2, 2, 2)
+
+
+def test_non_split_inputs():
+    sp = TensorSplitter(2, non_split_inputs=["mask"])
+    args, kwargs = sp.stack_microbatches(
+        (jnp.ones((4, 2)),), {"mask": jnp.ones((3, 3))}, arg_names=["x"]
+    )
+    assert isinstance(kwargs["mask"], NonSplit)
+    mb0 = microbatch_slice(kwargs["mask"], 0)
+    assert mb0.shape == (3, 3)
+
+
+def test_input_split_axes():
+    sp = TensorSplitter(2, input_split_axes={"x": 1})
+    (stacked,), _ = sp.stack_microbatches((jnp.arange(12).reshape(3, 4),), {}, ["x"])
+    assert stacked.shape == (2, 3, 2)
+    np.testing.assert_array_equal(
+        np.asarray(microbatch_slice(stacked, 0)), np.arange(12).reshape(3, 4)[:, :2]
+    )
+
+
+def test_indivisible_raises():
+    sp = TensorSplitter(3)
+    with pytest.raises(MicrobatchError):
+        sp.stack_microbatches((jnp.ones((4, 2)),), {}, ["x"])
+
+
+def test_smp_slice_protocol():
+    class Custom:
+        def __init__(self):
+            self.data = np.arange(8)
+
+        def smp_slice(self, num_mb, mb, axis):
+            per = len(self.data) // num_mb
+            return self.data[mb * per:(mb + 1) * per]
+
+    sp = TensorSplitter(4)
+    (stacked,), _ = sp.stack_microbatches((Custom(),), {}, ["c"])
+    assert stacked.shape == (4, 2)
+    np.testing.assert_array_equal(np.asarray(stacked[2]), [4, 5])
+
+
+def test_scalars_broadcast():
+    sp = TensorSplitter(2)
+    args, _ = sp.stack_microbatches((3.5, "tag"), {}, ["lr", "name"])
+    assert microbatch_slice(args[0], 0) == 3.5
+    assert microbatch_slice(args[1], 1) == "tag"
+
+
+def test_step_output_reductions():
+    stacked = {"loss": jnp.asarray([1.0, 3.0]), "logits": jnp.ones((2, 4, 5))}
+    out = StepOutput(stacked)
+    assert float(out.reduce_mean()["loss"]) == 2.0
+    assert float(out.reduce_sum()["loss"]) == 4.0
+    assert out.concat()["logits"].shape == (8, 5)
+    assert out.stack()["logits"].shape == (2, 4, 5)
+    assert len(out.outputs) == 2
+    assert float(out.outputs[1]["loss"]) == 3.0

@@ -1,0 +1,99 @@
+"""DeviceTopology / mesh tests: mesh axis order matches placement strategy,
+Ranker and mesh agree on device placement, smp.init wiring."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.backend.config import ModelParallelConfig
+from smdistributed_modelparallel_tpu.backend.topology import DeviceTopology
+from smdistributed_modelparallel_tpu.utils.exceptions import DeviceCountError
+
+
+def test_mesh_axis_order_cluster():
+    cfg = ModelParallelConfig(
+        {"pipeline_parallel_degree": 2, "tensor_parallel_degree": 2, "ddp": True}
+    )
+    topo = DeviceTopology(cfg)
+    # cluster == DPT: D-block (rdp, ep, cp) first, then pp, then tp.
+    assert topo.axis_names == ("rdp", "ep", "cp", "pp", "tp")
+    assert topo.mesh.shape["pp"] == 2
+    assert topo.mesh.shape["tp"] == 2
+    assert topo.mesh.shape["rdp"] == 2
+
+
+def test_mesh_axis_order_spread():
+    cfg = ModelParallelConfig(
+        {"pipeline_parallel_degree": 2, "tensor_parallel_degree": 2, "ddp": True,
+         "placement_strategy": "spread"}
+    )
+    topo = DeviceTopology(cfg)
+    # spread == TPD
+    assert topo.axis_names == ("tp", "pp", "rdp", "ep", "cp")
+
+
+def test_mesh_matches_ranker():
+    cfg = ModelParallelConfig(
+        {"pipeline_parallel_degree": 2, "tensor_parallel_degree": 2, "ddp": True}
+    )
+    topo = DeviceTopology(cfg)
+    devices = list(jax.devices())
+    flat_mesh = list(topo.mesh.devices.flat)
+    # Mesh is laid out in placement order, so flat index == global rank and
+    # the ranker's grid must match device ids.
+    for rank in range(topo.size):
+        assert flat_mesh[rank] == devices[rank]
+        coords = topo.coords(rank)
+        assert coords["pp"] == topo.ranker.get_pp_rank(rank)
+        assert coords["tp"] == topo.ranker.get_tp_rank(rank)
+        assert coords["rdp"] == topo.ranker.get_rdp_rank(rank)
+
+
+def test_device_count_validation():
+    cfg = ModelParallelConfig({"pipeline_parallel_degree": 3, "microbatches": 3})
+    with pytest.raises(DeviceCountError):
+        DeviceTopology(cfg)
+
+
+def test_device_count_override():
+    cfg = ModelParallelConfig(
+        {"pipeline_parallel_degree": 2, "_device_count_override": 4}
+    )
+    topo = DeviceTopology(cfg, devices=list(jax.devices()))
+    assert topo.size == 4
+    assert topo.rdp_size == 2
+
+
+def test_cp_carved_from_dp():
+    cfg = ModelParallelConfig({"context_parallel_degree": 2, "ddp": True})
+    topo = DeviceTopology(cfg)
+    assert topo.cp_size == 2
+    assert topo.rdp_size == 4
+    assert topo.d_size == 8  # reference "D" dim includes cp/ep
+    for rank in range(8):
+        assert topo.coords(rank)["cp"] in (0, 1)
+
+
+def test_smp_init_api():
+    smp.init({"pipeline_parallel_degree": 2, "tensor_parallel_degree": 2, "ddp": True})
+    assert smp.is_initialized()
+    assert smp.size() == 8
+    assert smp.pp_size() == 2
+    assert smp.tp_size() == 2
+    assert smp.rdp_size() == 2
+    assert smp.dp_size() == 4
+    assert smp.mp_size() == 4
+    assert smp.rank() == 0
+    assert sorted(smp.get_world_group()) == list(range(8))
+    assert smp.get_mesh().shape["pp"] == 2
+    assert len(smp.get_pp_group()) == 2
+    assert len(smp.get_dp_group()) == 4
+
+
+def test_collective_communicator_single_process():
+    smp.init({})
+    comm = smp.CollectiveCommunicator()
+    assert comm.broadcast({"a": 1}) == {"a": 1}
+    assert comm.allgather([1, 2]) == [[1, 2]]
