@@ -41,6 +41,14 @@ from smdistributed_modelparallel_tpu.utils.exceptions import (
     SMPValidationError,
 )
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.model import DistributedModel
+from smdistributed_modelparallel_tpu.optimizer import DistributedOptimizer
+from smdistributed_modelparallel_tpu.step import step
+from smdistributed_modelparallel_tpu.nn.tp_registry import (
+    tp_register,
+    tp_register_with_module,
+)
+from smdistributed_modelparallel_tpu import nn
 
 __version__ = "0.1.0"
 
@@ -189,3 +197,81 @@ def process_index():
 
 def process_count():
     return state.core.process_count()
+
+
+# -- partition / tp / checkpoint annotation APIs ------------------------
+# Parity: reference smp.partition / smp.set_partition /
+# smp.tensor_parallelism / smp.set_tensor_parallelism /
+# smp.set_activation_checkpointing (torch/module_manager.py:969-1161).
+
+def _module_manager():
+    from smdistributed_modelparallel_tpu.module_manager import ModuleManager
+
+    if state.module_manager is None:
+        state.module_manager = ModuleManager(None)
+    return state.module_manager
+
+
+def partition(stage):
+    """Context manager assigning modules created inside to pipeline stage.
+
+    Module-construction interception lands with the TP registry wiring (M3);
+    until then this warns and the path-based ``smp.set_partition`` is the
+    effective API.
+    """
+    get_logger().warning(
+        "smp.partition(%s): construction-context assignment is not wired yet; "
+        "use smp.set_partition(module_path, stage).", stage
+    )
+    return _module_manager().partition(stage)
+
+
+def set_partition(module_prefix, stage):
+    _module_manager().set_partition(module_prefix, stage)
+
+
+def get_partition(module_prefix):
+    if not isinstance(module_prefix, str):
+        raise SMPValidationError(
+            "get_partition expects a '/'-joined module path string "
+            f"(got {type(module_prefix).__name__})."
+        )
+    return _module_manager().stage_of(_module_manager_norm(module_prefix))
+
+
+def _module_manager_norm(prefix):
+    from smdistributed_modelparallel_tpu.module_manager import _normalize_prefix
+
+    return _normalize_prefix(prefix)
+
+
+def set_tensor_parallelism(module_prefix, enabled=True, **tp_config):
+    _module_manager().set_tensor_parallelism(module_prefix, enabled, **tp_config)
+
+
+from contextlib import contextmanager as _contextmanager
+
+
+@_contextmanager
+def tensor_parallelism(enabled=True, **tp_config):
+    """Context manager marking modules created inside for TP distribution.
+
+    Construction interception lands with the TP registry wiring (M3); until
+    then this warns and ``smp.set_tensor_parallelism(path, ...)`` is the
+    effective API.
+    """
+    get_logger().warning(
+        "smp.tensor_parallelism(): construction-context marking is not wired "
+        "yet; use smp.set_tensor_parallelism(module_path, ...)."
+    )
+    mm = _module_manager()
+    prev = getattr(mm, "_active_tp", None)
+    mm._active_tp = {"enabled": enabled, **tp_config}
+    try:
+        yield
+    finally:
+        mm._active_tp = prev
+
+
+def set_activation_checkpointing(module_prefix, **config):
+    _module_manager().set_activation_checkpointing(module_prefix, **config)

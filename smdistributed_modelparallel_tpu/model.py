@@ -1,0 +1,275 @@
+"""DistributedModel: the central model wrapper.
+
+Parity target: reference ``torch/model.py:110-1608`` (``DistributedModel``).
+The reference wraps an ``nn.Module`` tree, re-instantiates TP-marked modules,
+wraps in a DDP fork, patches forwards to route cross-partition calls through
+the module-server, and manages parameter placement after partitioning.
+
+TPU-native re-design: the wrapped module is a Flax module; parameters are an
+explicit pytree initialized lazily on the first ``@smp.step`` call (the
+reference's first-step trace/partition moment, ``torch/server.py:345-352``).
+Instead of moving parameters between processes, partitioning produces a
+``NamedSharding`` per parameter over the mesh (pp stage assignment -> pp
+axis specs in M2, TP specs in M3, ZeRO/rdp specs in M4); XLA moves the data.
+``model(...)`` inside a step function applies the module with the parameters
+of the current trace, and ``model.backward(loss)`` records the loss tracer
+so the step engine can differentiate — the SPMD replacement for the
+reference's autograd-graph-driven distributed backward
+(``torch/patches/execution.py:400-441``).
+"""
+
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.module_manager import path_key
+from smdistributed_modelparallel_tpu.utils.exceptions import (
+    SMPValidationError,
+    StepUsageError,
+)
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+class DistributedModel:
+    """Wraps a Flax module for distributed execution under @smp.step.
+
+    Args:
+      module: a ``flax.linen.Module`` (including ``smp.nn`` modules).
+      loss_scale / dtype policy are handled by the step engine via config
+      (fp16/bf16 keys), not here.
+      rngs: names of RNG streams the module needs besides "params"
+        (e.g. ("dropout",)).
+      trace_device: device used for the one-time eager init run.
+    """
+
+    def __init__(self, module, rngs=("dropout",), name="main"):
+        if state.cfg is None:
+            raise SMPValidationError("Call smp.init(config) before DistributedModel().")
+        self.module = module
+        self.name = name
+        self.rng_streams = tuple(rngs)
+        self._params = None               # materialized param pytree (jax.Arrays)
+        self._param_shardings = None      # pytree of NamedSharding
+        self._grads = None                # latest accumulated grads (set by step)
+        self._tls = threading.local()     # per-trace bound params / backward loss
+        self._partition_result = None     # set by the pipeline partitioner (M2)
+        self._post_partition_hooks = []
+        self._train = True
+        state.model = self
+
+        from smdistributed_modelparallel_tpu.module_manager import ModuleManager
+
+        # Annotations (set_partition / set_tensor_parallelism / ...) may have
+        # been made before DistributedModel construction; adopt the existing
+        # manager rather than dropping them.
+        if state.module_manager is not None and state.module_manager.root_module is None:
+            self.module_manager = state.module_manager
+            self.module_manager.root_module = module
+        else:
+            self.module_manager = ModuleManager(module)
+        state.module_manager = self.module_manager
+
+    # ------------------------------------------------------------------
+    # Tracing-time interface (used inside @smp.step user functions)
+    # ------------------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        params = getattr(self._tls, "bound_params", None)
+        if params is None:
+            # Eager call outside a step: use materialized params (init first).
+            if self._params is None:
+                self._eager_init(args, kwargs)
+            params = self._params
+        rngs = getattr(self._tls, "rngs", None)
+        variables = {"params": params}
+        mutable = False
+        out = self.module.apply(variables, *args, rngs=rngs, mutable=mutable, **kwargs)
+        return out
+
+    def backward(self, loss):
+        """Record the scalar to differentiate for this microbatch.
+
+        Parity: reference ``model.backward(loss)`` inside @smp.step
+        (``torch/model.py:1113-1146``). Under the functional design this
+        marks the loss; actual differentiation happens in the step engine.
+        """
+        if getattr(self._tls, "in_step", False):
+            if getattr(self._tls, "backward_loss", None) is not None:
+                raise StepUsageError("model.backward() called twice in one microbatch.")
+            self._tls.backward_loss = loss
+        else:
+            # Outside a step: reference raises; we record for forward-only use.
+            raise StepUsageError("model.backward() must be called inside an @smp.step function.")
+        return loss
+
+    # -- step-engine hooks ---------------------------------------------
+
+    def _begin_step_trace(self, params, rngs):
+        self._tls.bound_params = params
+        self._tls.rngs = rngs
+        self._tls.backward_loss = None
+        self._tls.in_step = True
+
+    def _end_step_trace(self):
+        loss = getattr(self._tls, "backward_loss", None)
+        self._tls.bound_params = None
+        self._tls.rngs = None
+        self._tls.backward_loss = None
+        self._tls.in_step = False
+        return loss
+
+    # ------------------------------------------------------------------
+    # Initialization / partitioning
+    # ------------------------------------------------------------------
+
+    @property
+    def initialized(self):
+        return self._params is not None
+
+    def _init_rngs(self):
+        mgr = state.rng_manager
+        rngs = {"params": mgr.next_key("params")}
+        for s in self.rng_streams:
+            rngs[s] = mgr.next_key(s)
+        return rngs
+
+    def _eager_init(self, args, kwargs):
+        """Materialize parameters from example inputs (first model call).
+
+        Parity note: this is the reference's first-step tracing moment
+        (``torch/worker.py:248-278``); here it both creates params and
+        gives the partitioner concrete shapes.
+        """
+        logger.info("Initializing model parameters from first batch shapes.")
+        variables = jax.jit(self.module.init)(self._init_rngs(), *args, **kwargs)
+        params = variables["params"]
+        self._set_params(params)
+
+    def _set_params(self, params):
+        self._params = params
+        self.module_manager.record_param_tree(params)
+        self._apply_shardings()
+        for hook in self._post_partition_hooks:
+            hook(self)
+
+    def _apply_shardings(self):
+        """Compute and apply parameter shardings.
+
+        M1: replicate everything (DP only). M2/M3/M4 refine this with
+        pp-stage, tp, and ZeRO specs via the module_manager's partition
+        and the nn modules' sharding metadata.
+        """
+        mesh = state.mesh
+        self._param_shardings = self.module_manager.param_shardings(mesh, self._params)
+        self._params = jax.device_put(self._params, self._param_shardings)
+
+    def post_partition(self, partition_result):
+        """Install a pipeline-partition result (M2)."""
+        self._partition_result = partition_result
+        if self._params is not None:
+            self._apply_shardings()
+
+    def register_post_partition_hook(self, hook):
+        """Parity: reference ``smp.register_post_partition_hook``."""
+        self._post_partition_hooks.append(hook)
+        return hook
+
+    # ------------------------------------------------------------------
+    # Parameter access / state_dict
+    # ------------------------------------------------------------------
+
+    @property
+    def params(self):
+        return self._params
+
+    @params.setter
+    def params(self, new_params):
+        self._params = new_params
+
+    @property
+    def grads(self):
+        return self._grads
+
+    def parameters(self):
+        """Flat list of parameter arrays (reference-compat-ish)."""
+        return jax.tree_util.tree_leaves(self._params)
+
+    def local_parameters(self):
+        """Parity: reference ``local_parameters`` — params owned by this
+        rank's partition. Under SPMD all params are mesh-sharded; the local
+        view is the addressable shards."""
+        return jax.tree_util.tree_leaves(self._params)
+
+    def num_parameters(self):
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+    def state_dict(self):
+        """Full (gathered) state dict of numpy arrays, keyed by '/'-joined
+        paths. Parity: reference ``torch/model.py:863-932``."""
+        flat = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self._params)[0]:
+            key = path_key(path)
+            flat[key] = np.asarray(jax.device_get(leaf))
+        return flat
+
+    def local_state_dict(self):
+        """Per-process shard view. Parity: reference ``local_state_dict``
+        (``torch/model.py:1482+``); here the shards addressable from this
+        process."""
+        flat = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self._params)[0]:
+            key = path_key(path)
+            shards = [s.data for s in leaf.addressable_shards]
+            flat[key] = np.asarray(shards[0]) if len(shards) == 1 else [
+                np.asarray(s) for s in shards
+            ]
+        return flat
+
+    def load_state_dict(self, flat_dict):
+        """Load a '/'-keyed flat dict into the param tree (resharding as
+        needed)."""
+        if self._params is None:
+            raise SMPValidationError(
+                "Model parameters are not initialized; run a step or call "
+                "init_from_state_dict with example inputs first."
+            )
+        leaves, treedef = jax.tree_util.tree_flatten_with_path(self._params)
+        new_leaves = []
+        for path, old in leaves:
+            key = path_key(path)
+            if key not in flat_dict:
+                raise SMPValidationError(f"Missing parameter '{key}' in state dict.")
+            arr = jnp.asarray(flat_dict[key], dtype=old.dtype)
+            if arr.shape != old.shape:
+                raise SMPValidationError(
+                    f"Shape mismatch for '{key}': {arr.shape} vs {old.shape}"
+                )
+            new_leaves.append(arr)
+        params = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self._params), new_leaves
+        )
+        self._params = jax.device_put(params, self._param_shardings)
+
+    # ------------------------------------------------------------------
+    # train / eval mode (dropout etc. is explicit in flax; kept for parity)
+    # ------------------------------------------------------------------
+
+    def train(self):
+        self._train = True
+        return self
+
+    def eval(self):
+        self._train = False
+        return self
+
+    @property
+    def training(self):
+        return self._train
+

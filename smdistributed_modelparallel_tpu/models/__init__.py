@@ -1,0 +1,1 @@
+"""Model zoo: flax implementations of the reference's supported families."""

@@ -1,0 +1,162 @@
+"""ModuleManager: module-tree naming, annotations, and sharding resolution.
+
+Parity target: reference ``torch/module_manager.py:60-1392`` — names the
+module tree, stores partition assignments, TP markings, and activation-
+checkpoint configs, and feeds the partitioner. The reference's runtime
+bookkeeping (per-microbatch output stacks, pending-backward counters,
+execution traces) has no SPMD counterpart and is dropped; what remains is
+the *annotation registry* keyed by parameter-tree paths, plus resolution of
+each parameter's PartitionSpec from (tp metadata, pipeline stage, ZeRO).
+
+Module identity: flax parameter trees are nested dicts; a "module" is a
+'/'-joined path prefix (e.g. "transformer/h_3/attn"). Annotation APIs accept
+such prefixes (with the reference's "main" root alias).
+"""
+
+import re
+from contextlib import contextmanager
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+def path_key(path):
+    """Canonical '/'-joined string for a jax pytree key path. The single
+    stringifier used for model and optimizer state_dict keys."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _normalize_prefix(prefix):
+    if prefix in ("main", "", "/"):
+        return ""
+    return prefix.strip("/")
+
+
+def _prefix_matches(path, prefix):
+    """Component-boundary prefix match: 'h_1' matches 'h_1/...' but not 'h_10'."""
+    if prefix == "":
+        return True
+    return path == prefix or path.startswith(prefix + "/")
+
+
+class ModuleManager:
+    def __init__(self, root_module):
+        self.root_module = root_module
+        self.param_paths = []            # flat list of '/'-joined param paths
+        self._manual_partitions = {}     # path prefix -> stage id
+        self._tp_marks = {}              # path prefix -> tp_config dict
+        self._ckpt_configs = {}          # path prefix -> checkpoint config
+        self._spec_providers = []        # callables: path -> PartitionSpec | None
+        self._partition_assignment = None  # path prefix -> stage (after partitioning)
+
+    # -- param tree recording ------------------------------------------
+
+    def record_param_tree(self, params):
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        self.param_paths = [path_key(p) for p, _ in flat]
+
+    # -- manual pipeline partition (parity: smp.partition ctx) ----------
+
+    @contextmanager
+    def partition(self, stage):
+        """Parity: reference ``smp.partition(i)`` context
+        (``torch/module_manager.py:1161``). Module constructions inside the
+        context are assigned to pipeline stage `i`; in the flax design the
+        context records a pending prefix registered at DistributedModel
+        construction via ``assign_partition``."""
+        prev = getattr(self, "_active_partition", None)
+        self._active_partition = stage
+        try:
+            yield
+        finally:
+            self._active_partition = prev
+
+    def set_partition(self, prefix, stage):
+        pp = state.cfg.pipeline_parallel_degree if state.cfg else 1
+        if not (0 <= stage < pp):
+            raise PartitionError(f"Partition {stage} out of range [0, {pp}).")
+        self._manual_partitions[_normalize_prefix(prefix)] = stage
+
+    def get_manual_partitions(self):
+        return dict(self._manual_partitions)
+
+    def set_partition_assignment(self, assignment):
+        self._partition_assignment = {
+            _normalize_prefix(k): v for k, v in assignment.items()
+        }
+
+    def stage_of(self, path):
+        if self._partition_assignment is None:
+            return 0
+        best, best_len = 0, -1
+        for prefix, stage in self._partition_assignment.items():
+            if _prefix_matches(path, prefix) and len(prefix) > best_len:
+                best, best_len = stage, len(prefix)
+        return best
+
+    # -- tensor parallelism marking ------------------------------------
+
+    def set_tensor_parallelism(self, prefix, enabled=True, **tp_config):
+        if enabled:
+            self._tp_marks[_normalize_prefix(prefix)] = tp_config
+        else:
+            self._tp_marks.pop(_normalize_prefix(prefix), None)
+
+    def tp_marked(self, prefix):
+        return _normalize_prefix(prefix) in self._tp_marks
+
+    def tp_config(self, prefix):
+        return self._tp_marks.get(_normalize_prefix(prefix), {})
+
+    @property
+    def tp_marks(self):
+        return dict(self._tp_marks)
+
+    # -- activation checkpointing registry ------------------------------
+
+    def set_activation_checkpointing(self, prefix, **config):
+        self._ckpt_configs[_normalize_prefix(prefix)] = config
+
+    def checkpoint_config(self, prefix):
+        return self._ckpt_configs.get(_normalize_prefix(prefix))
+
+    @property
+    def checkpoint_configs(self):
+        return dict(self._ckpt_configs)
+
+    # -- sharding resolution -------------------------------------------
+
+    def register_spec_provider(self, fn):
+        """fn(path: str, leaf) -> PartitionSpec | None. Later providers win.
+        Used by the TP layer (M3) and ZeRO (M4)."""
+        self._spec_providers.append(fn)
+
+    def spec_for(self, path, leaf):
+        spec = None
+        for provider in self._spec_providers:
+            got = provider(path, leaf)
+            if got is not None:
+                spec = got
+        return spec if spec is not None else P()
+
+    def param_shardings(self, mesh, params):
+        def leaf_sharding(path, leaf):
+            return NamedSharding(mesh, self.spec_for(path_key(path), leaf))
+
+        return jax.tree_util.tree_map_with_path(leaf_sharding, params)

@@ -1,0 +1,11 @@
+"""smp.nn — tensor-parallel module library.
+
+Parity target: reference ``torch/nn/__init__.py:24-35`` exports. Populated
+across M3; the registry is available from M0.
+"""
+
+from smdistributed_modelparallel_tpu.nn.tp_registry import (
+    TensorParallelismRegistry,
+    tp_register,
+    tp_register_with_module,
+)

@@ -1,0 +1,1 @@
+"""Compute ops: Pallas kernels and collective wrappers."""

@@ -1,0 +1,139 @@
+"""DistributedOptimizer: optax-backed optimizer with smp semantics.
+
+Parity target: reference ``torch/optimizers/optimizer.py:437-549``
+(``DistributedOptimizer``): wraps the user optimizer, makes ``step()``
+distribution-aware (sharded update + allgather under
+``shard_optimizer_state``), and provides TP/shard-aware state_dicts. Here
+the user optimizer is an ``optax.GradientTransformation``; ``step()``
+consumes the gradients stashed by the last ``@smp.step`` call and applies a
+jit-compiled donated update. Under ``shard_optimizer_state`` (M4) the
+optimizer state carries rdp-sharded PartitionSpecs — the reference's
+contiguous-buffer/virtual-parameter machinery (``torch/model.py:1237-1340``)
+reduces to sharding annotations, and XLA emits the reduce-scatter/allgather
+pair of a sharded update.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.module_manager import path_key
+from smdistributed_modelparallel_tpu.utils.exceptions import (
+    SMPValidationError,
+    StepUsageError,
+)
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+class DistributedOptimizer:
+    def __init__(self, tx, model=None, grad_clip_norm=None):
+        if not isinstance(tx, optax.GradientTransformation):
+            raise SMPValidationError(
+                "DistributedOptimizer expects an optax.GradientTransformation "
+                f"(got {type(tx).__name__})."
+            )
+        self.tx = tx
+        self.model = model if model is not None else state.model
+        if self.model is None:
+            raise SMPValidationError("Create smp.DistributedModel before the optimizer.")
+        self.grad_clip_norm = grad_clip_norm
+        self._opt_state = None
+        self._update = None
+        state.optimizer = self
+
+    # ------------------------------------------------------------------
+
+    def _ensure_state(self):
+        if self._opt_state is not None:
+            return
+        if self.model.params is None:
+            raise StepUsageError(
+                "Optimizer state is created lazily from model parameters; run a "
+                "step (or initialize the model) before optimizer.step()."
+            )
+        from smdistributed_modelparallel_tpu.parallel.zero import opt_state_shardings
+
+        self._opt_state = jax.jit(self.tx.init)(self.model.params)
+        shardings = opt_state_shardings(self._opt_state, self.model)
+        if shardings is not None:
+            self._opt_state = jax.device_put(self._opt_state, shardings)
+
+        clip = self.grad_clip_norm
+
+        def update(params, opt_state, grads):
+            if clip is not None:
+                gnorm = optax.global_norm(grads)
+                scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            updates, new_opt_state = self.tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt_state
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+
+    def step(self):
+        """Apply the gradients stashed by the last @smp.step call.
+
+        Parity: reference patched ``step()``
+        (``torch/optimizers/optimizer.py:355-391``) — sharded update then
+        param allgather; under XLA both emerge from the sharding specs.
+        """
+        grads = self.model._grads
+        if grads is None:
+            raise StepUsageError(
+                "No gradients available: run an @smp.step function with "
+                "model.backward(loss) before optimizer.step()."
+            )
+        self._ensure_state()
+        with jax.set_mesh(state.mesh):
+            new_params, self._opt_state = self._update(
+                self.model.params, self._opt_state, grads
+            )
+        self.model.params = new_params
+        self.model._grads = None
+
+    def zero_grad(self):
+        self.model._grads = None
+
+    # ------------------------------------------------------------------
+
+    @property
+    def opt_state(self):
+        return self._opt_state
+
+    def state_dict(self):
+        """Gathered optimizer state as numpy arrays keyed by pytree path."""
+        self._ensure_state()
+        flat = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(self._opt_state)[0]:
+            key = path_key(path)
+            flat[key] = np.asarray(jax.device_get(leaf)) if isinstance(
+                leaf, jax.Array
+            ) else leaf
+        return flat
+
+    def local_state_dict(self):
+        return self.state_dict()
+
+    def load_state_dict(self, flat_dict):
+        self._ensure_state()
+        leaves, _ = jax.tree_util.tree_flatten_with_path(self._opt_state)
+        new = []
+        for path, old in leaves:
+            key = path_key(path)
+            if key in flat_dict and isinstance(old, jax.Array):
+                arr = jnp.asarray(flat_dict[key], dtype=old.dtype)
+                new.append(jax.device_put(arr, old.sharding))
+            else:
+                new.append(old)
+        self._opt_state = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self._opt_state), new
+        )
+

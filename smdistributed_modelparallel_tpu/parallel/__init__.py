@@ -1,0 +1,1 @@
+"""Parallelism strategies: sharding, pipeline, zero, context parallelism."""

@@ -1,0 +1,28 @@
+"""Pipeline auto-partitioner.
+
+Parity target: reference ``torch/module_partition.py:182-905``
+(``ModulePartitioner``): cost-model-driven assignment of modules to pipeline
+stages (memory+time costs, tree BFS, d'Hondt device allocation). Fleshed out
+in M2 (``parallel/pipeline.py`` consumes the assignment); M1 only needs the
+single-stage fast path.
+"""
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+def maybe_auto_partition(model):
+    """Run after the first-step init/trace pass. With pp == 1 everything is
+    stage 0; with pp > 1 the partitioner assigns layers to stages (M2)."""
+    cfg = state.cfg
+    if cfg.pipeline_parallel_degree == 1:
+        model.module_manager.set_partition_assignment({"": 0})
+        model.post_partition({"": 0})
+        return
+    from smdistributed_modelparallel_tpu.parallel.pipeline import partition_for_pipeline
+
+    assignment = partition_for_pipeline(model)
+    model.module_manager.set_partition_assignment(assignment)
+    model.post_partition(assignment)

@@ -1,0 +1,56 @@
+"""Sharding helpers: batch specs, data axes, replication.
+
+TPU-native core with no single reference counterpart: encodes where the
+reference's implicit "each rank gets its own batch shard" placement
+(``backend/split.py`` + per-rank data loaders) becomes explicit
+PartitionSpecs over the mesh.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from smdistributed_modelparallel_tpu.backend.topology import (
+    CP_AXIS,
+    EP_AXIS,
+    RDP_AXIS,
+    TP_AXIS,
+)
+
+
+def data_axes(cfg):
+    """Mesh axes across which distinct batch elements live.
+
+    Parity: reference dp = tp x rdp (``backend/core.py:49-55``) — each GPU
+    gets its own batch unless ``prescaled_batch``; ep/cp are TPU extensions
+    carved from the data dimension (cp shards sequence, not batch, so it is
+    excluded here and applied to the sequence axis).
+    """
+    axes = [RDP_AXIS, EP_AXIS]
+    if cfg.tensor_parallel_degree > 1 and not cfg.prescaled_batch:
+        axes.append(TP_AXIS)
+    return tuple(axes)
+
+
+def batch_spec(cfg, ndim, batch_axis=0, stacked=False):
+    """PartitionSpec for a batch array: batch dim over data axes, sequence
+    dim over cp (if enabled), everything else replicated.
+
+    With ``stacked=True`` the array carries a leading [num_microbatches]
+    axis (never sharded) and `batch_axis` refers to the post-stack layout.
+    """
+    spec = [None] * ndim
+    offset = 1 if stacked else 0
+    spec_batch = batch_axis + offset
+    if spec_batch < ndim:
+        spec[spec_batch] = data_axes(cfg)
+    if cfg.context_parallel_degree > 1 and spec_batch + 1 < ndim:
+        spec[spec_batch + 1] = CP_AXIS
+    return P(*spec)
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def named(mesh, *spec):
+    return NamedSharding(mesh, P(*spec))

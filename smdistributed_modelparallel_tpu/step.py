@@ -1,0 +1,364 @@
+"""@smp.step — the compiled training-step engine.
+
+Parity target: reference ``torch/step.py:118-357`` (``StepFunction``): split
+args into microbatches, execute forward/backward per microbatch under the
+pipeline, reassemble ``StepOutput``. The reference dispatches each microbatch
+through the module-server event loop (``torch/server.py``); here the whole
+step — microbatch loop, forward, backward, gradient accumulation, data-
+parallel reduction — is ONE jit-compiled SPMD program:
+
+- the user step function runs under JAX tracing; ``model(...)`` applies the
+  flax module with the trace's parameters and ``model.backward(loss)``
+  records the loss to differentiate;
+- microbatches are a ``lax.scan`` over a stacked leading axis (gradient
+  accumulation with mean semantics, parity with
+  ``torch/allreduce/ddp.py:92-98``);
+- data parallelism comes from batch sharding over the mesh's data axes —
+  XLA inserts the gradient psum (the reference's bucketed NCCL allreduce,
+  SURVEY §2.1 N7, disappears);
+- pipeline parallelism (pp > 1) lowers the scan to a 1F1B schedule (M2,
+  ``parallel/pipeline.py``).
+
+First call = the reference's trace-and-partition moment
+(``torch/server.py:345-352``): parameters are materialized eagerly from the
+first microbatch, the partitioner runs, then the step compiles.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from smdistributed_modelparallel_tpu.backend.split import (
+    NonSplit,
+    StepOutput,
+    TensorSplitter,
+    microbatch_slice,
+)
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.model import DistributedModel
+from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
+from smdistributed_modelparallel_tpu.utils.exceptions import StepUsageError
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+class _ModelRef:
+    """Static placeholder for a DistributedModel inside traced args."""
+
+    def __init__(self, index):
+        self.index = index
+
+
+class StepFunction:
+    def __init__(self, fn, non_split_inputs=None, input_split_axes=None):
+        self.fn = fn
+        self.non_split_inputs = non_split_inputs
+        self.input_split_axes = input_split_axes
+        self._cache = {}
+        functools.update_wrapper(self, fn)
+
+    # ------------------------------------------------------------------
+
+    def __call__(self, *args, **kwargs):
+        if state.cfg is None:
+            raise StepUsageError("Call smp.init(config) before invoking an @smp.step function.")
+        cfg = state.cfg
+        model, clean_args, clean_kwargs = self._extract_model(args, kwargs)
+        splitter = TensorSplitter(
+            cfg.microbatches, self.non_split_inputs, self.input_split_axes
+        )
+        arg_names = _positional_names(self.fn, len(clean_args))
+        stacked_args, stacked_kwargs = splitter.stack_microbatches(
+            clean_args, clean_kwargs, arg_names
+        )
+
+        if model is not None and not model.initialized:
+            self._init_run(model, stacked_args, stacked_kwargs)
+        elif model is not None:
+            # Model may have been initialized by another step fn or an eager
+            # call: this StepFunction still needs to learn whether it calls
+            # backward, and the partitioner must have run.
+            self._discover_backward(model, stacked_args, stacked_kwargs)
+            if model._partition_result is None:
+                from smdistributed_modelparallel_tpu.parallel.partition import (
+                    maybe_auto_partition,
+                )
+
+                maybe_auto_partition(model)
+
+        grads, outputs = self._run_compiled(
+            model, stacked_args, stacked_kwargs
+        )
+        if model is not None and grads is not None:
+            model._grads = grads
+        state.step_count += 1
+        return StepOutput(outputs)
+
+    # ------------------------------------------------------------------
+
+    def _extract_model(self, args, kwargs):
+        model = None
+
+        def swap(v):
+            nonlocal model
+            if isinstance(v, DistributedModel):
+                model = v
+                return _ModelRef(0)
+            return v
+
+        args = tuple(swap(a) for a in args)
+        kwargs = {k: swap(v) for k, v in kwargs.items()}
+        if model is None:
+            model = state.model
+        return model, args, kwargs
+
+    def _init_run(self, model, stacked_args, stacked_kwargs):
+        """Eager run of microbatch 0: materializes params (lazy flax init),
+        discovers whether backward is used, and gives the partitioner
+        concrete shapes. Parity: the reference's first-step trace
+        (``torch/worker.py:248-278``)."""
+        logger.info("First @smp.step call: running init/trace pass on microbatch 0.")
+        mb_args = microbatch_slice(stacked_args, 0)
+        mb_kwargs = microbatch_slice(stacked_kwargs, 0)
+        mb_args, mb_kwargs = _resolve_model_refs(mb_args, mb_kwargs, model)
+        model._tls.in_step = True
+        model._tls.rngs = {s: state.rng_manager.next_key("init_" + s) for s in model.rng_streams}
+        try:
+            self.fn(*mb_args, **mb_kwargs)
+        finally:
+            self._has_backward = model._end_step_trace() is not None
+        from smdistributed_modelparallel_tpu.parallel.partition import maybe_auto_partition
+
+        maybe_auto_partition(model)
+
+    def _discover_backward(self, model, stacked_args, stacked_kwargs):
+        """Abstractly trace microbatch 0 to learn whether this step function
+        calls model.backward (cheap: jax.eval_shape, no compute)."""
+        if hasattr(self, "_has_backward"):
+            return
+        mb_args = microbatch_slice(stacked_args, 0)
+        mb_kwargs = microbatch_slice(stacked_kwargs, 0)
+        step_fn = self
+
+        def probe(params):
+            rngs = {s: jax.random.key(0) for s in model.rng_streams}
+            model._begin_step_trace(params, rngs)
+            try:
+                args, kwargs = _resolve_model_refs(mb_args, mb_kwargs, model)
+                step_fn.fn(*args, **kwargs)
+            finally:
+                loss = model._end_step_trace()
+            step_fn._has_backward = loss is not None
+            return jnp.zeros(())
+
+        jax.eval_shape(probe, model.params)
+
+    # ------------------------------------------------------------------
+
+    def _run_compiled(self, model, stacked_args, stacked_kwargs):
+        cfg = state.cfg
+        mesh = state.mesh
+        num_mb = cfg.microbatches
+
+        # Partition the stacked-arg tree into scan leaves (stacked arrays),
+        # broadcast array leaves, and static leaves.
+        tree = (stacked_args, stacked_kwargs)
+        leaves, treedef = jax.tree_util.tree_flatten(
+            tree, is_leaf=lambda x: isinstance(x, (NonSplit, _ModelRef))
+        )
+        scan_idx, bcast_idx, static = [], [], {}
+        scan_vals, bcast_vals = [], []
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, _ModelRef):
+                static[i] = leaf
+            elif isinstance(leaf, NonSplit):
+                if _is_jax_type(leaf.value):
+                    bcast_idx.append(i)
+                    bcast_vals.append(leaf.value)
+                else:
+                    static[i] = leaf.value
+            else:
+                scan_idx.append(i)
+                scan_vals.append(leaf)
+
+        key = (treedef, tuple(scan_idx), tuple(bcast_idx),
+               tuple((i, _static_key(v)) for i, v in sorted(static.items())),
+               getattr(self, "_has_backward", True))
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._build(model, treedef, scan_idx, bcast_idx, static, num_mb)
+            self._cache[key] = compiled
+
+        # Device placement: params already sharded; shard batch over data axes
+        # (replicate arrays whose dims don't divide the mesh axes, e.g. tiny
+        # test batches).
+        scan_vals = [
+            jax.device_put(v, _best_batch_sharding(mesh, cfg, v))
+            for v in scan_vals
+        ]
+        rng = state.rng_manager.next_key("step")
+        return compiled(model.params, scan_vals, bcast_vals, rng)
+
+    def _build(self, model, treedef, scan_idx, bcast_idx, static, num_mb):
+        has_backward = getattr(self, "_has_backward", True)
+        cfg = state.cfg
+        half = cfg.half_dtype
+        fn = self.fn
+
+        def reconstruct(mb_scan_leaves, bcast_leaves):
+            leaves = [None] * treedef.num_leaves
+            for i, v in zip(scan_idx, mb_scan_leaves):
+                leaves[i] = v
+            for i, v in zip(bcast_idx, bcast_leaves):
+                leaves[i] = v
+            for i, v in static.items():
+                leaves[i] = v
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+            return _resolve_model_refs(args, kwargs, model)
+
+        def mb_forward(params, mb_scan_leaves, bcast_leaves, key):
+            run_params = params
+            if half is not None:
+                run_params = jax.tree_util.tree_map(
+                    lambda p: p.astype(half) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                    params,
+                )
+            rngs = {
+                s: jax.random.fold_in(key, h)
+                for h, s in enumerate(model.rng_streams)
+            }
+            model._begin_step_trace(run_params, rngs)
+            try:
+                args, kwargs = reconstruct(mb_scan_leaves, bcast_leaves)
+                out = fn(*args, **kwargs)
+            finally:
+                loss = model._end_step_trace()
+            if has_backward and loss is None:
+                raise StepUsageError(
+                    "model.backward(loss) was not called in the step function."
+                )
+            return (loss if has_backward else jnp.zeros(())), out
+
+        def step_impl(params, scan_leaves, bcast_leaves, rng):
+            keys = jax.random.split(rng, num_mb)
+            if has_backward:
+                grad_fn = jax.value_and_grad(mb_forward, has_aux=True)
+
+                def body(acc, xs):
+                    mb_leaves, key = xs
+                    (_, out), grads = grad_fn(params, mb_leaves, bcast_leaves, key)
+                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                    return acc, out
+
+                acc0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, _acc_dtype(p.dtype, cfg)), params
+                )
+                grads, outs = jax.lax.scan(body, acc0, (scan_leaves, keys))
+                # Microbatch averaging: parity with reference
+                # torch/allreduce/ddp.py:92-98 (grads divided by num_mb).
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: (g / num_mb).astype(p.dtype), grads, params
+                )
+                return grads, outs
+
+            def body(carry, xs):
+                mb_leaves, key = xs
+                _, out = mb_forward(params, mb_leaves, bcast_leaves, key)
+                return carry, out
+
+            _, outs = jax.lax.scan(body, 0, (scan_leaves, keys))
+            return None, outs
+
+        jitted = jax.jit(step_impl, donate_argnums=())
+        mesh = state.mesh
+
+        def run(params, scan_vals, bcast_vals, rng):
+            with jax.set_mesh(mesh):
+                return jitted(params, scan_vals, bcast_vals, rng)
+
+        return run
+
+
+def _best_batch_sharding(mesh, cfg, arr):
+    """Batch sharding for a stacked array, dropping mesh axes that don't
+    divide the corresponding dim (falls back to replication)."""
+    spec = list(batch_spec(cfg, arr.ndim, stacked=True))
+    for dim, axes in enumerate(spec):
+        if axes is None:
+            continue
+        axes_tuple = axes if isinstance(axes, tuple) else (axes,)
+        size = 1
+        for a in axes_tuple:
+            size *= mesh.shape[a]
+        if arr.shape[dim] % size != 0:
+            spec[dim] = None
+    return NamedSharding(mesh, P(*spec))
+
+
+def _acc_dtype(dtype, cfg):
+    if jnp.issubdtype(dtype, jnp.floating) and cfg._fp32_grad_accumulation:
+        return jnp.float32
+    return dtype
+
+
+def _resolve_model_refs(args, kwargs, model):
+    def res(v):
+        return model if isinstance(v, _ModelRef) else v
+
+    args = jax.tree_util.tree_map(
+        res, args, is_leaf=lambda x: isinstance(x, _ModelRef)
+    )
+    kwargs = jax.tree_util.tree_map(
+        res, kwargs, is_leaf=lambda x: isinstance(x, _ModelRef)
+    )
+    return args, kwargs
+
+
+def _is_jax_type(v):
+    # Python scalars stay static (hashable cache keys): users branch on them
+    # (`if training:`) and flax takes them as static flags; tracing them
+    # would raise TracerBoolConversionError.
+    import numpy as np
+
+    return isinstance(v, (jax.Array, np.ndarray, jnp.ndarray))
+
+
+def _static_key(v):
+    try:
+        hash(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+def _positional_names(fn, n):
+    import inspect
+
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):
+        return [None] * n
+    names = []
+    for p in params:
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            names.append(p.name)
+    while len(names) < n:
+        names.append(None)
+    return names[:n]
+
+
+def step(fn=None, *, non_split_inputs=None, input_split_axes=None):
+    """Decorator: ``@smp.step`` or ``@smp.step(non_split_inputs=[...])``.
+
+    Parity: reference ``torch/step.py:118`` / ``backend/split.py`` options.
+    """
+    if fn is not None:
+        return StepFunction(fn)
+
+    def wrap(f):
+        return StepFunction(f, non_split_inputs, input_split_axes)
+
+    return wrap

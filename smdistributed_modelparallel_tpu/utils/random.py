@@ -8,8 +8,16 @@ named-stream key tree and fold axis indices in where per-rank divergence is
 wanted.
 """
 
+import zlib
+
 import jax
 import jax.numpy as jnp
+
+
+def _stream_id(stream):
+    # Stable across processes (Python's hash() is salted per process and
+    # would desynchronize multi-host key derivation).
+    return zlib.crc32(str(stream).encode())
 
 
 class RngManager:
@@ -25,7 +33,7 @@ class RngManager:
         trace)."""
         count = self._counters.get(stream, 0)
         self._counters[stream] = count + 1
-        return jax.random.fold_in(jax.random.fold_in(self._root, hash(stream) % (2**31)), count)
+        return jax.random.fold_in(jax.random.fold_in(self._root, _stream_id(stream)), count)
 
     def per_rank_key(self, stream, axis_name):
         """A key that differs along a mesh axis, for use inside shard_map

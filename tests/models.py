@@ -1,0 +1,60 @@
+"""Shared test model zoo.
+
+Mirrors reference ``test/torch/model_zoo/`` (SURVEY §4): small models used
+by parity tests, plus standard @smp.step train functions.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: tuple = (32, 16, 4)
+
+    @nn.compact
+    def __call__(self, x):
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, name=f"dense_{i}")(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x
+
+
+class TinyTransformerLM(nn.Module):
+    """Small decoder-only LM exercising the same structure as GPT-2."""
+
+    vocab: int = 64
+    d_model: int = 32
+    n_layers: int = 2
+    n_heads: int = 4
+    max_len: int = 16
+
+    @nn.compact
+    def __call__(self, ids, deterministic=True):
+        x = nn.Embed(self.vocab, self.d_model, name="wte")(ids)
+        pos = nn.Embed(self.max_len, self.d_model, name="wpe")(
+            jnp.arange(ids.shape[-1])[None, :]
+        )
+        x = x + pos
+        mask = nn.make_causal_mask(ids)
+        for i in range(self.n_layers):
+            h = nn.LayerNorm(name=f"ln1_{i}")(x)
+            h = nn.MultiHeadDotProductAttention(
+                num_heads=self.n_heads, deterministic=deterministic,
+                name=f"attn_{i}"
+            )(h, mask=mask)
+            x = x + h
+            h = nn.LayerNorm(name=f"ln2_{i}")(x)
+            h = nn.Dense(4 * self.d_model, name=f"fc_{i}")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.d_model, name=f"proj_{i}")(h)
+            x = x + h
+        x = nn.LayerNorm(name="ln_f")(x)
+        return nn.Dense(self.vocab, use_bias=False, name="lm_head")(x)
+
+
+def softmax_xent(logits, labels):
+    logp = logits - jnp.max(logits, axis=-1, keepdims=True)
+    logp = logp - jnp.log(jnp.sum(jnp.exp(logp), axis=-1, keepdims=True))
+    onehot = jnp.eye(logits.shape[-1])[labels]
+    return -jnp.sum(onehot * logp, axis=-1)

@@ -1,0 +1,276 @@
+"""Step-engine end-to-end tests.
+
+Mirrors the reference's central fixture strategy (``SMPTestBase``,
+``test/torch/smp_test_base.py``, SURVEY §4): run the same model with and
+without the framework and compare losses/gradients/parameters.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import smdistributed_modelparallel_tpu as smp
+from tests.models import MLP, TinyTransformerLM, softmax_xent
+
+
+def make_data(key, n=16, din=8):
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, (n, din))
+    y = jax.random.randint(k2, (n,), 0, 4)
+    return x, y
+
+
+def baseline_train(module, params, x, y, lr, steps, num_mb=1):
+    """Plain-JAX reference: full-batch grad = mean over microbatch grads."""
+    tx = optax.sgd(lr)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, xb, yb):
+        logits = module.apply({"params": p}, xb)
+        return jnp.mean(softmax_xent(logits, yb))
+
+    losses = []
+    for _ in range(steps):
+        # microbatched grad accumulation with mean semantics
+        grads = None
+        per_mb = x.shape[0] // num_mb
+        total = 0.0
+        for mb in range(num_mb):
+            xb, yb = x[mb * per_mb:(mb + 1) * per_mb], y[mb * per_mb:(mb + 1) * per_mb]
+            l, g = jax.value_and_grad(loss_fn)(params, xb, yb)
+            total += l / num_mb
+            grads = g if grads is None else jax.tree_util.tree_map(jnp.add, grads, g)
+        grads = jax.tree_util.tree_map(lambda v: v / num_mb, grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(float(total))
+    return params, losses
+
+
+@pytest.mark.parametrize("num_mb", [1, 4])
+def test_mlp_parity_vs_plain_jax(num_mb):
+    smp.init({"microbatches": num_mb})
+    module = MLP()
+    x, y = make_data(jax.random.key(0))
+
+    model = smp.DistributedModel(module)
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+
+    @smp.step
+    def train_step(model, xb, yb):
+        logits = model(xb)
+        loss = jnp.mean(softmax_xent(logits, yb))
+        model.backward(loss)
+        return loss
+
+    # First call materializes params; its grads are w.r.t. those init params.
+    out = train_step(model, x, y)
+    init_params = jax.device_get(model.params)
+    smp_losses = [float(out.reduce_mean())]
+    optimizer.step()
+    for _ in range(4):
+        out = train_step(model, x, y)
+        smp_losses.append(float(out.reduce_mean()))
+        optimizer.step()
+
+    ref_params, ref_losses = baseline_train(module, init_params, x, y, 0.1, 5, num_mb)
+    np.testing.assert_allclose(smp_losses, ref_losses, rtol=2e-4, atol=2e-5)
+    sd = model.state_dict()
+    for k, ref in _flat(ref_params).items():
+        np.testing.assert_allclose(sd[k], ref, rtol=2e-3, atol=2e-4, err_msg=k)
+
+
+def _flat(params, prefix=""):
+    out = {}
+    for k, v in params.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flat(v, key + "/"))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def test_loss_decreases_transformer():
+    smp.init({"microbatches": 2})
+    module = TinyTransformerLM()
+    model = smp.DistributedModel(module)
+    optimizer = smp.DistributedOptimizer(optax.adam(1e-2), model)
+
+    ids = jax.random.randint(jax.random.key(0), (8, 16), 0, 64)
+
+    @smp.step
+    def train_step(model, batch):
+        logits = model(batch)
+        loss = jnp.mean(softmax_xent(logits[:, :-1], batch[:, 1:]))
+        model.backward(loss)
+        return loss
+
+    losses = []
+    for _ in range(10):
+        out = train_step(model, ids)
+        losses.append(float(out.reduce_mean()))
+        optimizer.step()
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_forward_only_step():
+    smp.init({"microbatches": 2})
+    module = MLP()
+    model = smp.DistributedModel(module)
+    x, _ = make_data(jax.random.key(0))
+
+    @smp.step
+    def eval_step(model, xb):
+        return model(xb)
+
+    out = eval_step(model, x)
+    assert out.stack().shape == (2, 8, 4)
+    assert out.concat().shape == (16, 4)
+    assert model.grads is None
+
+
+def test_step_output_accessors_and_kwargs():
+    smp.init({"microbatches": 2})
+    module = MLP()
+    model = smp.DistributedModel(module)
+    x, y = make_data(jax.random.key(0))
+
+    @smp.step
+    def train_step(model, xb, yb=None, scale=1.0):
+        logits = model(xb)
+        loss = jnp.mean(softmax_xent(logits, yb)) * scale
+        model.backward(loss)
+        return {"loss": loss, "logits": logits}
+
+    out = train_step(model, x, yb=y, scale=2.0)
+    assert set(out.reduce_mean().keys()) == {"loss", "logits"}
+    assert out.concat()["logits"].shape == (16, 4)
+
+
+def test_non_split_inputs_step():
+    smp.init({"microbatches": 4})
+    module = MLP()
+    model = smp.DistributedModel(module)
+    x, y = make_data(jax.random.key(0))
+    mask = jnp.ones((4,))
+
+    @smp.step(non_split_inputs=["mask"])
+    def train_step(model, xb, yb, mask):
+        logits = model(xb) * mask
+        loss = jnp.mean(softmax_xent(logits, yb))
+        model.backward(loss)
+        return loss
+
+    out = train_step(model, x, y, mask)
+    assert out.stack().shape == (4,)
+
+
+def test_eval_step_after_train_step():
+    """A forward-only step fn on an already-initialized model must not be
+    mistaken for a backward step (regression: per-StepFunction discovery)."""
+    smp.init({"microbatches": 2})
+    module = MLP()
+    model = smp.DistributedModel(module)
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    x, y = make_data(jax.random.key(0))
+
+    @smp.step
+    def train_step(model, xb, yb):
+        loss = jnp.mean(softmax_xent(model(xb), yb))
+        model.backward(loss)
+        return loss
+
+    @smp.step
+    def eval_step(model, xb):
+        return model(xb)
+
+    train_step(model, x, y)
+    optimizer.step()
+    out = eval_step(model, x)  # must not raise "backward was not called"
+    assert out.concat().shape == (16, 4)
+    assert model.grads is None
+
+
+def test_static_bool_kwarg_branching():
+    """Python scalars stay static: user code may branch on them."""
+    smp.init({"microbatches": 2})
+    module = MLP()
+    model = smp.DistributedModel(module)
+    x, y = make_data(jax.random.key(0))
+
+    @smp.step(non_split_inputs=["flip"])
+    def train_step(model, xb, yb, flip):
+        logits = model(xb)
+        if flip:  # TracerBoolConversionError if flip were traced
+            logits = -logits
+        loss = jnp.mean(softmax_xent(logits, yb))
+        model.backward(loss)
+        return loss
+
+    l_true = float(train_step(model, x, y, True).reduce_mean())
+    l_false = float(train_step(model, x, y, False).reduce_mean())
+    assert l_true != l_false
+
+
+def test_backward_outside_step_raises():
+    smp.init({})
+    module = MLP()
+    model = smp.DistributedModel(module)
+    with pytest.raises(smp.SMPValidationError):
+        model.backward(jnp.zeros(()))
+
+
+def test_optimizer_without_grads_raises():
+    smp.init({})
+    module = MLP()
+    model = smp.DistributedModel(module)
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    with pytest.raises(smp.SMPValidationError):
+        optimizer.step()
+
+
+def test_num_parameters_and_state_dict_roundtrip():
+    smp.init({})
+    module = MLP()
+    model = smp.DistributedModel(module)
+    x, y = make_data(jax.random.key(0))
+
+    @smp.step
+    def train_step(model, xb, yb):
+        loss = jnp.mean(softmax_xent(model(xb), yb))
+        model.backward(loss)
+        return loss
+
+    train_step(model, x, y)
+    sd = model.state_dict()
+    assert model.num_parameters() == sum(v.size for v in sd.values())
+    model.load_state_dict(sd)
+    sd2 = model.state_dict()
+    for k in sd:
+        np.testing.assert_array_equal(sd[k], sd2[k])
+
+
+def test_bf16_step_runs():
+    smp.init({"bf16": True, "microbatches": 2})
+    module = MLP()
+    model = smp.DistributedModel(module)
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    x, y = make_data(jax.random.key(0))
+
+    @smp.step
+    def train_step(model, xb, yb):
+        loss = jnp.mean(softmax_xent(model(xb), yb))
+        model.backward(loss)
+        return loss
+
+    l0 = float(train_step(model, x, y).reduce_mean())
+    optimizer.step()
+    # master params stay fp32
+    assert all(p.dtype == jnp.float32 for p in model.parameters())
+    l1 = float(train_step(model, x, y).reduce_mean())
+    optimizer.step()
+    assert l1 < l0
