@@ -1,0 +1,77 @@
+"""Benchmark: GPT-2 training throughput (tokens/sec/chip).
+
+Runs on whatever accelerator is available (the driver provides one real TPU
+chip). Single-chip benchmark = BASELINE config #1 (GPT-2 124M); the
+north-star PP4xTP2 GPT-2 1.5B configuration needs a v4-32 and is exercised
+multi-chip via ``__graft_entry__.dryrun_multichip``.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is vs the reference's published number for this metric; the
+reference ships none in-tree (BASELINE.md), so 1.0 is reported with the raw
+value carrying the signal.
+"""
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import smdistributed_modelparallel_tpu as smp
+    from smdistributed_modelparallel_tpu.models.gpt2 import gpt2_124m
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == "tpu"
+    seq_len = 1024 if on_tpu else 64
+    batch = 8 if on_tpu else 4
+    num_mb = 4
+
+    smp.init({"microbatches": num_mb, "bf16": True if on_tpu else False})
+    module = gpt2_124m(max_len=seq_len) if on_tpu else gpt2_124m(
+        max_len=seq_len, d_model=128, n_layers=2, n_heads=4
+    )
+    model = smp.DistributedModel(module)
+    optimizer = smp.DistributedOptimizer(optax.adamw(1e-4), model)
+
+    @smp.step
+    def train_step(model, batch_ids):
+        logits = model(batch_ids)
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        tgt = jax.nn.one_hot(batch_ids[:, 1:], logits.shape[-1])
+        loss = -jnp.mean(jnp.sum(logp * tgt, axis=-1))
+        model.backward(loss)
+        return loss
+
+    ids = jax.random.randint(jax.random.key(0), (batch, seq_len), 0, 50257)
+
+    # Warmup (compile).
+    for _ in range(2):
+        out = train_step(model, ids)
+        optimizer.step()
+    jax.block_until_ready(model.params)
+
+    iters = 5 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = train_step(model, ids)
+        optimizer.step()
+    jax.block_until_ready(model.params)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq_len * iters
+    tok_per_sec_chip = tokens / dt / max(n_chips, 1)
+    print(json.dumps({
+        "metric": "tokens/sec/chip GPT-2-124M train step"
+                  + ("" if on_tpu else " (CPU smoke, reduced model)"),
+        "value": round(tok_per_sec_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -60,6 +60,9 @@ class DistributedModel:
         self._grads = None                # latest accumulated grads (set by step)
         self._tls = threading.local()     # per-trace bound params / backward loss
         self._partition_result = None     # set by the pipeline partitioner (M2)
+        self._pipeline_spec = None        # PipelineSpec when pp > 1 (M2)
+        self._output_aval = None          # output shapes of the model call
+        self._input_aval = None
         self._post_partition_hooks = []
         self._train = True
         state.model = self
@@ -81,6 +84,20 @@ class DistributedModel:
     # ------------------------------------------------------------------
 
     def __call__(self, *args, **kwargs):
+        # Pipeline capture/force modes (pp > 1, see step.py): the step engine
+        # traces the user fn with the model call intercepted — 'capture'
+        # records the inputs and returns a dummy of the right shape; 'force'
+        # substitutes the pipelined output.
+        mode = getattr(self._tls, "call_mode", None)
+        if mode is not None:
+            kind, payload = mode
+            self._tls.captured_calls.append((args, kwargs))
+            if kind == "capture":
+                return jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), payload
+                )
+            return payload  # force
+
         params = getattr(self._tls, "bound_params", None)
         if params is None:
             # Eager call outside a step: use materialized params (init first).
@@ -91,6 +108,14 @@ class DistributedModel:
         variables = {"params": params}
         mutable = False
         out = self.module.apply(variables, *args, rngs=rngs, mutable=mutable, **kwargs)
+        self._output_aval = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), out
+        )
+        self._input_aval = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if hasattr(a, "shape") else a,
+            (args, kwargs),
+        )
         return out
 
     def backward(self, loss):
@@ -116,14 +141,33 @@ class DistributedModel:
         self._tls.rngs = rngs
         self._tls.backward_loss = None
         self._tls.in_step = True
+        self._tls.call_mode = None
+        self._tls.captured_calls = []
+
+    def _begin_capture(self, out_aval):
+        """Intercept the model call: record inputs, return zeros(out_aval)."""
+        self._begin_step_trace(None, None)
+        self._tls.call_mode = ("capture", out_aval)
+
+    def _begin_force(self, params, rngs, value):
+        """Intercept the model call: record inputs, return `value`."""
+        self._begin_step_trace(params, rngs)
+        self._tls.call_mode = ("force", value)
 
     def _end_step_trace(self):
         loss = getattr(self._tls, "backward_loss", None)
+        self._tls.captured = getattr(self._tls, "captured_calls", [])
         self._tls.bound_params = None
         self._tls.rngs = None
         self._tls.backward_loss = None
         self._tls.in_step = False
+        self._tls.call_mode = None
+        self._tls.captured_calls = []
         return loss
+
+    @property
+    def _last_captured(self):
+        return getattr(self._tls, "captured", [])
 
     # ------------------------------------------------------------------
     # Initialization / partitioning

@@ -1,0 +1,40 @@
+"""GPT-2 model family.
+
+Parity target: reference HF GPT-2 support
+(``torch/nn/huggingface/gpt2.py``): the reference auto-translates
+``GPT2LMHeadModel`` into ``DistributedTransformerLMHead``; here the family
+is provided natively as ``TransformerLM`` configs. HF state-dict translation
+lands with the checkpoint subsystem (M5).
+
+Sizes follow the published GPT-2 family; ``gpt2_1p5b`` is BASELINE config #2
+(the north-star benchmark model) and ``gpt2_124m`` BASELINE config #1.
+"""
+
+from smdistributed_modelparallel_tpu.models.transformer_lm import TransformerLM
+
+_CONFIGS = {
+    "gpt2_124m": dict(d_model=768, n_layers=12, n_heads=12),
+    "gpt2_350m": dict(d_model=1024, n_layers=24, n_heads=16),
+    "gpt2_774m": dict(d_model=1280, n_layers=36, n_heads=20),
+    "gpt2_1p5b": dict(d_model=1600, n_layers=48, n_heads=25),
+}
+
+
+def gpt2(size="gpt2_124m", vocab_size=50257, max_len=1024, **overrides):
+    cfg = dict(_CONFIGS[size])
+    cfg.update(
+        vocab_size=vocab_size,
+        max_len=max_len,
+        pos_type="learned",
+        tie_weights=True,
+    )
+    cfg.update(overrides)
+    return TransformerLM(**cfg)
+
+
+def gpt2_124m(**overrides):
+    return gpt2("gpt2_124m", **overrides)
+
+
+def gpt2_1p5b(**overrides):
+    return gpt2("gpt2_1p5b", **overrides)

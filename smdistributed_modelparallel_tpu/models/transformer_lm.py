@@ -1,0 +1,241 @@
+"""Decoder-only transformer LM — the flagship model family.
+
+Parity target: reference ``torch/nn/transformer.py:184-550``
+(``DistributedTransformerLMHead``: embeddings + transformer + tied LM head
+behind ~40 config keys) re-designed flax-first:
+
+- layers are built with ``flax.linen.scan`` so parameters carry a leading
+  [num_layers] axis — one layer is traced/compiled once, and the stacked
+  layout is exactly what the pipeline executor (``parallel/pipeline.py``)
+  and per-layer rematerialization need;
+- ``embed`` / ``head`` are standalone methods so the pipeline can run them
+  around the layer stack (``PipelineSpec`` protocol);
+- attention/MLP internals route through ``smp.nn`` functional ops so tensor
+  parallelism (M3) applies the Megatron-style sharding without touching
+  this file.
+
+Model-zoo configs for GPT-2 sizes are in ``models/gpt2.py``.
+"""
+
+from dataclasses import field
+from typing import Optional
+
+import numpy as np
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from smdistributed_modelparallel_tpu.parallel.pipeline import PipelineSpec
+
+
+def _gelu(x):
+    return nn.gelu(x, approximate=True)
+
+
+class CausalSelfAttention(nn.Module):
+    """Multi-head causal self-attention.
+
+    Parity: reference ``DistributedAttentionLayer``
+    (``torch/nn/transformer.py:1176-1835``); TP sharding lands in M3 via
+    sharding constraints on the head dimension.
+    """
+
+    d_model: int
+    n_heads: int
+    dropout: float = 0.0
+    attention_in_fp32: bool = False
+    rotary: bool = False
+    rotary_dim: Optional[int] = None
+    window: Optional[int] = None
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, attn_bias=None):
+        B, T, D = x.shape
+        H = self.n_heads
+        hd = D // H
+        qkv = nn.Dense(3 * D, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, hd)
+        k = k.reshape(B, T, H, hd)
+        v = v.reshape(B, T, H, hd)
+        if self.rotary:
+            rd = self.rotary_dim or hd
+            q, k = _apply_rotary(q, k, rd)
+        scale = 1.0 / np.sqrt(hd)
+        if self.attention_in_fp32:
+            q, k = q.astype(jnp.float32), k.astype(jnp.float32)
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) * scale
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        if self.window is not None:
+            mask = mask & (
+                jnp.arange(T)[:, None] - jnp.arange(T)[None, :] < self.window
+            )
+        scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(scores.dtype).min)
+        if attn_bias is not None:
+            scores = scores + attn_bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        if self.dropout > 0.0 and not self.deterministic:
+            probs = nn.Dropout(self.dropout, deterministic=False)(probs)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, D)
+        return nn.Dense(D, name="proj")(out)
+
+
+class TransformerLayer(nn.Module):
+    """One pre/post-LN transformer block; applied per pipeline stage."""
+
+    d_model: int
+    n_heads: int
+    d_ff: int
+    dropout: float = 0.0
+    pre_layernorm: bool = True
+    post_layernorm: bool = False
+    attention_in_fp32: bool = False
+    rotary: bool = False
+    rotary_dim: Optional[int] = None
+    window: Optional[int] = None
+    parallel_block: bool = False  # GPT-J style parallel attn+mlp
+    deterministic: bool = True
+    ln_eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        attn = CausalSelfAttention(
+            self.d_model, self.n_heads, self.dropout, self.attention_in_fp32,
+            self.rotary, self.rotary_dim, self.window, self.deterministic,
+            name="attn",
+        )
+
+        def mlp(h):
+            h = nn.Dense(self.d_ff, name="fc")(h)
+            h = _gelu(h)
+            h = nn.Dense(self.d_model, name="proj")(h)
+            return h
+
+        if self.parallel_block:
+            h = nn.LayerNorm(epsilon=self.ln_eps, name="ln1")(x)
+            x = x + attn(h) + mlp(h)
+        else:
+            h = nn.LayerNorm(epsilon=self.ln_eps, name="ln1")(x) if self.pre_layernorm else x
+            x = x + attn(h)
+            if self.post_layernorm:
+                x = nn.LayerNorm(epsilon=self.ln_eps, name="ln1_post")(x)
+            h = nn.LayerNorm(epsilon=self.ln_eps, name="ln2")(x) if self.pre_layernorm else x
+            x = x + mlp(h)
+            if self.post_layernorm:
+                x = nn.LayerNorm(epsilon=self.ln_eps, name="ln2_post")(x)
+        if self.dropout > 0.0 and not self.deterministic:
+            x = nn.Dropout(self.dropout, deterministic=False)(x)
+        return x
+
+
+class _ScanBody(nn.Module):
+    """Carry-protocol wrapper for nn.scan over TransformerLayer."""
+
+    layer_kwargs: dict
+
+    @nn.compact
+    def __call__(self, x, _):
+        return TransformerLayer(**self.layer_kwargs, name="block")(x), None
+
+
+class TransformerLM(nn.Module):
+    """Embeddings + scanned transformer stack + (tied) LM head."""
+
+    vocab_size: int
+    max_len: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    d_ff: Optional[int] = None
+    dropout: float = 0.0
+    pos_type: str = "learned"      # learned | rotary | none
+    tie_weights: bool = True
+    parallel_block: bool = False
+    attention_in_fp32: bool = False
+    window: Optional[int] = None
+    rotary_dim: Optional[int] = None
+    deterministic: bool = True
+    ln_eps: float = 1e-5
+
+    @nn.nowrap
+    def _layer_kwargs(self):
+        return dict(
+            d_model=self.d_model,
+            n_heads=self.n_heads,
+            d_ff=self.d_ff or 4 * self.d_model,
+            dropout=self.dropout,
+            attention_in_fp32=self.attention_in_fp32,
+            rotary=self.pos_type == "rotary",
+            rotary_dim=self.rotary_dim,
+            window=self.window,
+            parallel_block=self.parallel_block,
+            deterministic=self.deterministic,
+            ln_eps=self.ln_eps,
+        )
+
+    def setup(self):
+        self.wte = nn.Embed(self.vocab_size, self.d_model, name="wte")
+        if self.pos_type == "learned":
+            self.wpe = nn.Embed(self.max_len, self.d_model, name="wpe")
+        ScanLayers = nn.scan(
+            _ScanBody,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=self.n_layers,
+        )
+        self.layers = ScanLayers(self._layer_kwargs(), name="layers")
+        self.ln_f = nn.LayerNorm(epsilon=self.ln_eps, name="ln_f")
+        if not self.tie_weights:
+            self.lm_head = nn.Dense(self.vocab_size, use_bias=False, name="lm_head")
+
+    # -- pipeline decomposition ----------------------------------------
+
+    def embed(self, ids):
+        x = self.wte(ids)
+        if self.pos_type == "learned":
+            x = x + self.wpe(jnp.arange(ids.shape[-1])[None, :])
+        return x
+
+    def head(self, x):
+        x = self.ln_f(x)
+        if self.tie_weights:
+            return self.wte.attend(x)
+        return self.lm_head(x)
+
+    def __call__(self, ids):
+        x = self.embed(ids)
+        x, _ = self.layers(x, None)
+        return self.head(x)
+
+    @nn.nowrap
+    def pipeline_spec(self):
+        return PipelineSpec(
+            layer_path="layers/block",
+            num_layers=self.n_layers,
+            layer_module=TransformerLayer(**self._layer_kwargs()),
+        )
+
+
+def _apply_rotary(q, k, rotary_dim):
+    """Rotary position embedding (GPT-J/NeoX style) on the first rotary_dim
+    channels of each head. Parity: reference ``torch/nn/transformer.py:114-183``."""
+
+    def rot(x):
+        T = x.shape[1]
+        d = rotary_dim
+        x_rot, x_pass = x[..., :d], x[..., d:]
+        half = d // 2
+        freqs = 1.0 / (10000 ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+        t = jnp.arange(T, dtype=jnp.float32)
+        angles = jnp.einsum("t,f->tf", t, freqs)
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
+        x1, x2 = x_rot[..., :half], x_rot[..., half:]
+        rotated = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).astype(x.dtype)
+        return jnp.concatenate([rotated, x_pass], axis=-1)
+
+    return rot(q), rot(k)

@@ -142,9 +142,15 @@ class ModuleManager:
 
     # -- sharding resolution -------------------------------------------
 
-    def register_spec_provider(self, fn):
+    def register_spec_provider(self, fn, name=None):
         """fn(path: str, leaf) -> PartitionSpec | None. Later providers win.
-        Used by the TP layer (M3) and ZeRO (M4)."""
+        Used by the pipeline (M2), TP layer (M3) and ZeRO (M4). A named
+        provider replaces any previous provider of the same name."""
+        if name is not None:
+            self._spec_providers = [
+                p for p in self._spec_providers if getattr(p, "_smp_name", None) != name
+            ]
+            fn._smp_name = name
         self._spec_providers.append(fn)
 
     def spec_for(self, path, leaf):

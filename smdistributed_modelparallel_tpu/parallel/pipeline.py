@@ -1,0 +1,275 @@
+"""Pipeline parallelism: compiled SPMD microbatch pipelining.
+
+Parity target: reference pipeline subsystem — ``torch/pipeline.py:24-145``
+(microbatch state machine), ``torch/server.py`` (the MPMD event loop that
+*creates* pipelining by task ordering), ``active_microbatches`` windowing.
+
+TPU-native re-design (SURVEY §7-M2): the pipeline is not a server loop but a
+``lax.scan`` over ticks inside the one compiled step:
+
+- layer parameters live stacked with a leading ``[num_layers]`` axis (the
+  model builds them with ``flax.linen.scan``), resharded per-stage as
+  ``[S, layers_per_stage, ...]`` with the stage axis on the mesh's ``pp``
+  axis;
+- each tick ``vmap``s the stage body over the stage axis — GSPMD partitions
+  the vmapped computation so each device executes only its own stage — and
+  shifts the carry buffer one stage forward with ``jnp.roll`` on the
+  pp-sharded axis, which XLA lowers to a collective-permute over ICI (the
+  reference's NCCL P2P "links", SURVEY §2.1 N3);
+- stage 0 consumes microbatch ``t`` at tick ``t``; the last stage emits
+  microbatch ``t - (S-1)``; total ticks = num_microbatches + S - 1;
+- backward is JAX AD through the tick scan (reverse-time pipeline). Both
+  ``pipeline: simple`` and ``interleaved`` lower to this schedule; the
+  interleaved memory advantage is recovered with per-layer rematerialization
+  (``jax.checkpoint``) rather than schedule reordering.
+
+Models opt in by exposing ``pipeline_spec()`` (see ``PipelineSpec``); the
+``smp.nn`` transformer family and the model zoo implement it. Non-layered
+modules cannot be pipelined under SPMD and raise a clear error.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.backend.topology import PP_AXIS
+from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+@dataclass
+class PipelineSpec:
+    """How a module decomposes into embed -> repeated layer -> head.
+
+    Attributes:
+      layer_path: '/'-joined path of the parameter subtree whose leaves carry
+        a leading [num_layers] axis (built with ``flax.linen.scan``).
+      num_layers: total layer count L (must be divisible by pp_degree).
+      layer_module: unbound flax module for ONE layer; applied per-slice
+        during pipelining.
+      embed_method / head_method: method names on the root module computing
+        the pre-layer carry and the post-layer output. Both may use any
+        non-layer parameters (they run replicated across stages; their
+        parameters stay replicated on the pp axis).
+      carry_remat: rematerialize each layer application (activation
+        checkpointing inside the pipeline).
+    """
+
+    layer_path: str
+    num_layers: int
+    layer_module: Any
+    embed_method: str = "embed"
+    head_method: str = "head"
+    carry_remat: bool = False
+
+
+def get_pipeline_spec(module):
+    fn = getattr(module, "pipeline_spec", None)
+    if fn is None:
+        return None
+    return fn() if callable(fn) else fn
+
+
+def partition_for_pipeline(model):
+    """Produce the stage assignment for a pipelineable model.
+
+    Uniform contiguous ranges (layers L/S per stage) — the layout the stacked
+    executor requires. The generic cost-model partitioner
+    (``parallel/module_partition.py``) covers reference-parity assignment of
+    arbitrary module trees and is used for reporting/validation.
+    """
+    cfg = state.cfg
+    pp = cfg.pipeline_parallel_degree
+    spec = get_pipeline_spec(model.module)
+    if spec is None:
+        raise PartitionError(
+            "pipeline_parallel_degree > 1 requires a pipelineable model: one "
+            "exposing pipeline_spec() (smp.nn.DistributedTransformer* and the "
+            "smp model zoo do). Arbitrary module graphs cannot be pipelined "
+            "under SPMD."
+        )
+    if spec.num_layers % pp != 0:
+        raise PartitionError(
+            f"num_layers={spec.num_layers} must be divisible by "
+            f"pipeline_parallel_degree={pp} for the stacked pipeline executor."
+        )
+    per_stage = spec.num_layers // pp
+    assignment = {}
+    for layer in range(spec.num_layers):
+        assignment[f"{spec.layer_path}#{layer}"] = layer // per_stage
+    model._pipeline_spec = spec
+    model.module_manager.register_spec_provider(
+        layer_param_sharding_provider(spec), name="pipeline_layers"
+    )
+    logger.info(
+        "Pipeline partition: %d layers -> %d stages (%d layers/stage).",
+        spec.num_layers, pp, per_stage,
+    )
+    return assignment
+
+
+def layer_param_sharding_provider(spec):
+    """Spec provider: stacked layer params get their leading (layer) axis
+    sharded over pp; everything else replicated across pp."""
+    from jax.sharding import PartitionSpec as P
+
+    prefix = spec.layer_path.strip("/")
+
+    def provider(path, leaf):
+        if path == prefix or path.startswith(prefix + "/"):
+            ndim = getattr(leaf, "ndim", 0)
+            if ndim >= 1:
+                return P(PP_AXIS, *([None] * (ndim - 1)))
+        return None
+
+    return provider
+
+
+# ----------------------------------------------------------------------
+# Execution
+# ----------------------------------------------------------------------
+
+
+def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
+    """Run the full pipelined forward for all microbatches.
+
+    Args:
+      model: DistributedModel with ``_pipeline_spec`` installed.
+      params: full parameter tree; layer subtree leaves have leading [L].
+      stacked_inputs: pytree of arrays with leading [num_microbatches] —
+        the captured inputs of the user's single ``model(...)`` call.
+      rngs_key: PRNG key for dropout etc. (folded per microbatch and layer).
+
+    Returns:
+      stacked outputs with leading [num_microbatches].
+    """
+    spec = model._pipeline_spec
+    cfg = state.cfg
+    S = cfg.pipeline_parallel_degree
+    num_mb = cfg.microbatches
+    L = spec.num_layers
+    per_stage = L // S
+    module = model.module
+    layer_module = spec.layer_module
+
+    layer_params = _get_subtree(params, spec.layer_path)
+
+    def embed_mb(mb_input, key):
+        args, kwargs = mb_input
+        return module.apply(
+            {"params": params},
+            *args,
+            rngs=_mk_rngs(model, key, "embed"),
+            method=spec.embed_method,
+            **kwargs,
+        )
+
+    def head_mb(carry, key):
+        return module.apply(
+            {"params": params},
+            carry,
+            rngs=_mk_rngs(model, key, "head"),
+            method=spec.head_method,
+        )
+
+    def apply_one_layer(lp, carry, key):
+        out = layer_module.apply({"params": lp}, carry, rngs=_mk_rngs(model, key, "layer"))
+        return out
+
+    if spec.carry_remat:
+        apply_one_layer = jax.checkpoint(apply_one_layer)
+
+    def stage_body(stage_layer_params, carry, key):
+        """Apply this stage's per_stage layers sequentially (scan over the
+        local layer axis)."""
+
+        def body(c, xs):
+            lp, i = xs
+            return apply_one_layer(lp, c, jax.random.fold_in(key, i)), None
+
+        idx = jnp.arange(per_stage)
+        out, _ = jax.lax.scan(body, carry, (stage_layer_params, idx))
+        return out
+
+    mb_keys = jax.random.split(rngs_key, num_mb)
+
+    # Embed all microbatches upfront (the pipeline's input queue).
+    embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
+
+    # [L, ...] -> [S, per_stage, ...]; dim 0 stays sharded on pp.
+    staged_params = jax.tree_util.tree_map(
+        lambda x: x.reshape((S, per_stage) + x.shape[1:]), layer_params
+    )
+
+    n_ticks = num_mb + S - 1
+    carry_shape = jax.tree_util.tree_map(lambda x: x[0], embedded)
+    # Stage input buffer: [S, ...carry]; buf[s] is the input consumed by
+    # stage s at the next tick.
+    buf0 = jax.tree_util.tree_map(
+        lambda x: jnp.zeros((S,) + x.shape, x.dtype), carry_shape
+    )
+
+    vmapped_stages = jax.vmap(stage_body, in_axes=(0, 0, 0))
+    stage_keys = jax.random.split(rngs_key, S)
+
+    def tick(buf, t):
+        # Feed stage 0 with microbatch t (clamped; invalid ticks produce
+        # garbage that is never collected).
+        mb_idx = jnp.minimum(t, num_mb - 1)
+        feed = jax.tree_util.tree_map(
+            lambda e, b: b.at[0].set(
+                jax.lax.dynamic_index_in_dim(e, mb_idx, 0, keepdims=False)
+            ),
+            embedded, buf,
+        )
+        # Distinct dropout keys per (stage, tick).
+        tick_keys = jax.vmap(lambda k: jax.random.fold_in(k, t))(stage_keys)
+        outs = vmapped_stages(staged_params, feed, tick_keys)
+        # Collect last stage's output (microbatch t - (S-1) when valid).
+        tail = jax.tree_util.tree_map(lambda o: o[S - 1], outs)
+        # Shift stage outputs forward one stage: collective-permute on pp.
+        nxt = jax.tree_util.tree_map(
+            lambda o: jnp.roll(o, shift=1, axis=0), outs
+        )
+        return nxt, tail
+
+    _, tails = jax.lax.scan(tick, buf0, jnp.arange(n_ticks))
+    # tails[t] is microbatch t-(S-1); keep the last num_mb ticks.
+    collected = jax.tree_util.tree_map(lambda x: x[S - 1:], tails)
+
+    outputs = _scan_map(head_mb, collected, mb_keys)
+    return outputs
+
+
+def _scan_map(fn, stacked, keys):
+    """Map fn over the leading microbatch axis via lax.scan (sequential, so
+    per-microbatch activations do not coexist)."""
+
+    def body(_, xs):
+        tree, key = xs
+        return 0, fn(tree, key)
+
+    _, out = jax.lax.scan(body, 0, (stacked, keys))
+    return out
+
+
+def _mk_rngs(model, key, tag):
+    import zlib
+
+    return {
+        s: jax.random.fold_in(key, zlib.crc32(f"{tag}/{s}".encode()))
+        for s in model.rng_streams
+    }
+
+
+def _get_subtree(params, path):
+    node = params
+    for part in path.strip("/").split("/"):
+        if part:
+            node = node[part]
+    return node

@@ -202,12 +202,8 @@ class StepFunction:
         rng = state.rng_manager.next_key("step")
         return compiled(model.params, scan_vals, bcast_vals, rng)
 
-    def _build(self, model, treedef, scan_idx, bcast_idx, static, num_mb):
-        has_backward = getattr(self, "_has_backward", True)
-        cfg = state.cfg
-        half = cfg.half_dtype
-        fn = self.fn
-
+    @staticmethod
+    def _make_reconstruct(model, treedef, scan_idx, bcast_idx, static):
         def reconstruct(mb_scan_leaves, bcast_leaves):
             leaves = [None] * treedef.num_leaves
             for i, v in zip(scan_idx, mb_scan_leaves):
@@ -218,6 +214,25 @@ class StepFunction:
                 leaves[i] = v
             args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
             return _resolve_model_refs(args, kwargs, model)
+
+        return reconstruct
+
+    def _build(self, model, treedef, scan_idx, bcast_idx, static, num_mb):
+        cfg = state.cfg
+        if (
+            cfg.pipeline_parallel_degree > 1
+            and model is not None
+            and model._pipeline_spec is not None
+            and model._output_aval is not None
+        ):
+            return self._build_pipeline(
+                model, treedef, scan_idx, bcast_idx, static, num_mb
+            )
+        has_backward = getattr(self, "_has_backward", True)
+        half = cfg.half_dtype
+        fn = self.fn
+
+        reconstruct = self._make_reconstruct(model, treedef, scan_idx, bcast_idx, static)
 
         def mb_forward(params, mb_scan_leaves, bcast_leaves, key):
             run_params = params
@@ -270,6 +285,99 @@ class StepFunction:
                 return carry, out
 
             _, outs = jax.lax.scan(body, 0, (scan_leaves, keys))
+            return None, outs
+
+        jitted = jax.jit(step_impl, donate_argnums=())
+        mesh = state.mesh
+
+        def run(params, scan_vals, bcast_vals, rng):
+            with jax.set_mesh(mesh):
+                return jitted(params, scan_vals, bcast_vals, rng)
+
+        return run
+
+    def _build_pipeline(self, model, treedef, scan_idx, bcast_idx, static, num_mb):
+        """pp > 1: one pipelined forward over all microbatches.
+
+        The user fn is traced twice per microbatch: once with the model call
+        intercepted to *capture* its inputs (loss math on the dummy output is
+        dead code XLA eliminates), and once with the call *forced* to the
+        pipeline's output for that microbatch to compute loss/outputs.
+        Requires exactly one model(...) call per step function.
+        """
+        from smdistributed_modelparallel_tpu.parallel.pipeline import pipeline_forward
+
+        has_backward = getattr(self, "_has_backward", True)
+        cfg = state.cfg
+        half = cfg.half_dtype
+        fn = self.fn
+        out_aval = model._output_aval
+        reconstruct = self._make_reconstruct(model, treedef, scan_idx, bcast_idx, static)
+
+        def step_impl(params, scan_leaves, bcast_leaves, rng):
+            keys = jax.random.split(rng, num_mb)
+
+            def cap_body(_, xs):
+                mb_leaves, key = xs
+                model._begin_capture(out_aval)
+                try:
+                    args, kwargs = reconstruct(mb_leaves, bcast_leaves)
+                    fn(*args, **kwargs)
+                finally:
+                    model._end_step_trace()
+                captured = model._last_captured
+                if len(captured) != 1:
+                    raise StepUsageError(
+                        "pipeline_parallel_degree > 1 requires exactly one "
+                        f"model(...) call per step function (got {len(captured)})."
+                    )
+                return 0, captured[0]
+
+            _, stacked_inputs = jax.lax.scan(cap_body, 0, (scan_leaves, keys))
+
+            def forward_all(p):
+                run_p = p
+                if half is not None:
+                    run_p = jax.tree_util.tree_map(
+                        lambda x: x.astype(half)
+                        if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                        p,
+                    )
+                outs = pipeline_forward(model, run_p, stacked_inputs, rng)
+
+                def post_body(_, xs):
+                    mb_leaves, out, key = xs
+                    rngs = {
+                        s: jax.random.fold_in(key, h)
+                        for h, s in enumerate(model.rng_streams)
+                    }
+                    model._begin_force(run_p, rngs, out)
+                    try:
+                        args, kwargs = reconstruct(mb_leaves, bcast_leaves)
+                        user_out = fn(*args, **kwargs)
+                    finally:
+                        loss = model._end_step_trace()
+                    if has_backward and loss is None:
+                        raise StepUsageError(
+                            "model.backward(loss) was not called in the step function."
+                        )
+                    return 0, (
+                        loss if has_backward else jnp.zeros(()),
+                        user_out,
+                    )
+
+                _, (losses, user_outs) = jax.lax.scan(
+                    post_body, 0, (scan_leaves, outs, keys)
+                )
+                return jnp.mean(losses), user_outs
+
+            if has_backward:
+                (_, outs), grads = jax.value_and_grad(forward_all, has_aux=True)(params)
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g.astype(p.dtype), grads, params
+                )
+                return grads, outs
+            _, outs = forward_all(params)
             return None, outs
 
         jitted = jax.jit(step_impl, donate_argnums=())

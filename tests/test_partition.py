@@ -1,0 +1,104 @@
+"""Auto-partitioner algorithm tests (mirrors reference ``test_partition.py``:
+cost normalization, DP segmentation, d'Hondt)."""
+
+import pytest
+
+from smdistributed_modelparallel_tpu.parallel.module_partition import (
+    ModuleNode,
+    ModulePartitioner,
+    dhondt_allocate,
+    min_max_segments,
+    populate_costs,
+    subtree_cost,
+    uniform_layer_boundaries,
+)
+
+
+def test_dhondt_basic():
+    assert dhondt_allocate(4, [1.0, 1.0]) == [2, 2]
+    assert dhondt_allocate(4, [3.0, 1.0]) == [3, 1]
+    assert sum(dhondt_allocate(7, [5.0, 3.0, 1.0])) == 7
+    # d'Hondt favors larger parties on ties of quotients
+    assert dhondt_allocate(3, [4.0, 2.0]) == [2, 1]
+
+
+def test_dhondt_zero_cost():
+    alloc = dhondt_allocate(4, [1.0, 0.0, 1.0])
+    assert sum(alloc) == 4
+    assert alloc[1] == 0
+
+
+def test_min_max_segments_balanced():
+    segs = min_max_segments([1, 1, 1, 1], 2)
+    assert segs == [(0, 2), (2, 4)]
+    segs = min_max_segments([4, 1, 1, 1, 1], 2)
+    assert segs == [(0, 1), (1, 5)]
+
+
+def test_min_max_segments_k_larger_than_n():
+    segs = min_max_segments([1, 2], 4)
+    assert segs == [(0, 1), (1, 2)]
+
+
+def test_populate_costs_blend():
+    root = ModuleNode("root", param_bytes=0, activation_bytes=0, time=0, children=[
+        ModuleNode("a", param_bytes=100, activation_bytes=0, time=1.0),
+        ModuleNode("b", param_bytes=100, activation_bytes=0, time=3.0),
+    ])
+    populate_costs(root, memory_weight=1.0)
+    a, b = root.children
+    assert a.cost == pytest.approx(b.cost)  # pure memory: equal
+    populate_costs(root, memory_weight=0.0)
+    assert b.cost > a.cost  # pure time: b dominates
+
+
+def test_partitioner_uniform_layers():
+    layers = [ModuleNode(f"h_{i}", param_bytes=10, time=1.0) for i in range(8)]
+    root = ModuleNode("main", children=layers)
+    assignment = ModulePartitioner(root, num_stages=4, memory_weight=0.5).partition()
+    # contiguous, 2 layers per stage
+    stages = [assignment[f"h_{i}"] for i in range(8)]
+    assert stages == [0, 0, 1, 1, 2, 2, 3, 3]
+
+
+def test_partitioner_heavy_layer_gets_own_stage():
+    costs = [10, 1, 1, 1]
+    layers = [
+        ModuleNode(f"h_{i}", param_bytes=c, time=float(c)) for i, c in enumerate(costs)
+    ]
+    root = ModuleNode("main", children=layers)
+    assignment = ModulePartitioner(root, num_stages=2, memory_weight=0.5).partition()
+    assert assignment["h_0"] == 0
+    assert assignment["h_1"] == assignment["h_2"] == assignment["h_3"] == 1
+
+
+def test_partitioner_manual_pin():
+    layers = [ModuleNode(f"h_{i}", param_bytes=1, time=1.0) for i in range(4)]
+    root = ModuleNode("main", children=layers)
+    assignment = ModulePartitioner(
+        root, num_stages=2, memory_weight=0.5, manual={"h_0": 1}
+    ).partition()
+    assert assignment["h_0"] == 1
+
+
+def test_partitioner_nested_tree():
+    def block(name):
+        return ModuleNode(name, children=[
+            ModuleNode(f"{name}/attn", param_bytes=4, time=2.0),
+            ModuleNode(f"{name}/mlp", param_bytes=8, time=2.0),
+        ])
+
+    root = ModuleNode("main", children=[block(f"b{i}") for i in range(4)])
+    assignment = ModulePartitioner(root, num_stages=2, memory_weight=0.8).partition()
+    # children within one block stay together
+    for i in range(4):
+        assert assignment[f"b{i}"] == assignment[f"b{i}/attn"] == assignment[f"b{i}/mlp"]
+    assert assignment["b0"] == 0
+    assert assignment["b3"] == 1
+
+
+def test_uniform_layer_boundaries():
+    segs = uniform_layer_boundaries([1.0] * 8, 4)
+    assert segs == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    segs = uniform_layer_boundaries([1, 1, 1, 1, 10, 1, 1, 1], 2)
+    assert len(segs) == 2

@@ -1,0 +1,140 @@
+"""Pipeline-parallelism tests: pp=2/4 loss+grad parity vs pp=1 on the
+8-device CPU mesh (the reference's MPI-tier substitute, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.models.transformer_lm import TransformerLM
+from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
+from tests.models import MLP, softmax_xent
+
+
+def tiny_lm(n_layers=4):
+    return TransformerLM(
+        vocab_size=32, max_len=12, d_model=16, n_layers=n_layers, n_heads=2,
+    )
+
+
+def run_training(pp, num_mb, steps=3, n_layers=4, seed=0):
+    smp.reset()
+    smp.init({
+        "pipeline_parallel_degree": pp,
+        "microbatches": num_mb,
+        "ddp": True,
+    })
+    module = tiny_lm(n_layers)
+    model = smp.DistributedModel(module)
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    ids = jax.random.randint(jax.random.key(seed), (8, 12), 0, 32)
+
+    @smp.step
+    def train_step(model, batch):
+        logits = model(batch)
+        loss = jnp.mean(softmax_xent(logits[:, :-1], batch[:, 1:]))
+        model.backward(loss)
+        return loss
+
+    losses, first_grads = [], None
+    for i in range(steps):
+        out = train_step(model, ids)
+        if i == 0:
+            first_grads = jax.device_get(model.grads)
+        losses.append(float(out.reduce_mean()))
+        optimizer.step()
+    return losses, first_grads, jax.device_get(model.params)
+
+
+def test_pp_matches_single_stage():
+    base_losses, base_grads, base_params = run_training(pp=1, num_mb=4)
+    pp_losses, pp_grads, pp_params = run_training(pp=4, num_mb=4)
+    np.testing.assert_allclose(pp_losses, base_losses, rtol=1e-4, atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5),
+        pp_grads, base_grads,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5),
+        pp_params, base_params,
+    )
+
+
+def test_pp2_with_more_microbatches():
+    base_losses, _, _ = run_training(pp=1, num_mb=8, steps=2)
+    pp_losses, _, _ = run_training(pp=2, num_mb=8, steps=2)
+    np.testing.assert_allclose(pp_losses, base_losses, rtol=1e-4, atol=1e-5)
+
+
+def test_pp_layer_params_sharded_on_pp_axis():
+    smp.reset()
+    smp.init({"pipeline_parallel_degree": 4, "microbatches": 4, "ddp": True})
+    module = tiny_lm(n_layers=4)
+    model = smp.DistributedModel(module)
+    ids = jax.random.randint(jax.random.key(0), (8, 12), 0, 32)
+
+    @smp.step
+    def train_step(model, batch):
+        loss = jnp.mean(model(batch))
+        model.backward(loss)
+        return loss
+
+    train_step(model, ids)
+    flat = model.state_dict()  # forces gather; shapes intact
+    # layer subtree leaves lead with [n_layers]; sharding spec has pp first.
+    qkv = model.params["layers"]["block"]["attn"]["qkv"]["kernel"]
+    assert qkv.shape[0] == 4
+    assert "pp" in str(qkv.sharding.spec)
+    # non-layer params replicated over pp
+    wte = model.params["wte"]["embedding"]
+    assert "pp" not in str(wte.sharding.spec)
+
+
+def test_pp_requires_divisible_layers():
+    smp.reset()
+    smp.init({"pipeline_parallel_degree": 4, "microbatches": 4, "ddp": True})
+    module = tiny_lm(n_layers=6)  # 6 % 4 != 0
+    model = smp.DistributedModel(module)
+    ids = jax.random.randint(jax.random.key(0), (8, 12), 0, 32)
+
+    @smp.step
+    def train_step(model, batch):
+        loss = jnp.mean(model(batch))
+        model.backward(loss)
+        return loss
+
+    with pytest.raises(PartitionError):
+        train_step(model, ids)
+
+
+def test_pp_requires_pipelineable_model():
+    smp.reset()
+    smp.init({"pipeline_parallel_degree": 2, "microbatches": 2})
+    model = smp.DistributedModel(MLP())
+
+    @smp.step
+    def train_step(model, xb):
+        loss = jnp.mean(model(xb))
+        model.backward(loss)
+        return loss
+
+    with pytest.raises(PartitionError):
+        train_step(model, jnp.ones((4, 8)))
+
+
+def test_pp_forward_only():
+    smp.reset()
+    smp.init({"pipeline_parallel_degree": 2, "microbatches": 2, "ddp": True})
+    module = tiny_lm(n_layers=4)
+    model = smp.DistributedModel(module)
+    ids = jax.random.randint(jax.random.key(0), (4, 12), 0, 32)
+
+    @smp.step
+    def eval_step(model, batch):
+        return model(batch)
+
+    out = eval_step(model, ids)
+    assert out.concat().shape == (4, 12, 32)
