@@ -344,6 +344,12 @@ SCHEMA = {
         "lower_bound": 1,
         "description": "TPU extension: expert parallelism degree for MoE layers.",
     },
+    "use_pallas_kernels": {
+        "type": bool,
+        "default": True,
+        "description": "TPU extension: dispatch attention/softmax to Pallas "
+        "kernels on TPU (jnp fallback elsewhere or when shapes don't tile).",
+    },
     "_device_count_override": {
         "type": (int, type(None)),
         "default": None,

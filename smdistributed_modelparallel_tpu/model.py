@@ -197,11 +197,48 @@ class DistributedModel:
         self._set_params(params)
 
     def _set_params(self, params):
+        params = self._adopt_param_metadata(params)
         self._params = params
         self.module_manager.record_param_tree(params)
         self._apply_shardings()
         for hook in self._post_partition_hooks:
             hook(self)
+
+    def _adopt_param_metadata(self, params):
+        """Unbox flax ``Partitioned`` metadata (smp.nn modules attach tp axis
+        names via ``nn.with_partitioning``) and register the resulting specs
+        with the module manager.
+
+        TPU-native counterpart of the reference's ``parameter_creation_scope``
+        distribution-axis registry (``torch/nn/utils.py:120-154``,
+        ``torch/module_manager.py:240-277``): where the reference records
+        which dim of each param is sliced across tp_ranks, here the record is
+        the param's PartitionSpec, consumed during ``_apply_shardings``.
+        """
+        import flax.linen as fnn
+        from flax.core import meta as flax_meta
+
+        boxed = [
+            leaf for leaf in jax.tree_util.tree_leaves(
+                params, is_leaf=lambda x: isinstance(x, flax_meta.AxisMetadata)
+            )
+            if isinstance(leaf, flax_meta.AxisMetadata)
+        ]
+        if not boxed:
+            return params
+        spec_tree = fnn.get_partition_spec(params)
+        flat_specs = {}
+        for path, spec in jax.tree_util.tree_flatten_with_path(
+            spec_tree, is_leaf=lambda x: isinstance(x, P)
+        )[0]:
+            if any(axis is not None for axis in spec):
+                flat_specs[path_key(path)] = spec
+
+        def provider(path, leaf):
+            return flat_specs.get(path)
+
+        self.module_manager.register_spec_provider(provider, name="tp_params")
+        return flax_meta.unbox(params)
 
     def _apply_shardings(self):
         """Compute and apply parameter shardings.

@@ -61,8 +61,10 @@ class CausalSelfAttention(nn.Module):
         k = k.reshape(B, T, H, hd)
         v = v.reshape(B, T, H, hd)
         if self.rotary:
+            from smdistributed_modelparallel_tpu.nn.transformer import apply_rotary
+
             rd = self.rotary_dim or hd
-            q, k = _apply_rotary(q, k, rd)
+            q, k = apply_rotary(q, k, rd, neox_style=True)
         scale = 1.0 / np.sqrt(hd)
         if self.attention_in_fp32:
             q, k = q.astype(jnp.float32), k.astype(jnp.float32)
@@ -218,24 +220,3 @@ class TransformerLM(nn.Module):
         )
 
 
-def _apply_rotary(q, k, rotary_dim):
-    """Rotary position embedding (GPT-J/NeoX style) on the first rotary_dim
-    channels of each head. Parity: reference ``torch/nn/transformer.py:114-183``."""
-
-    def rot(x):
-        T = x.shape[1]
-        d = rotary_dim
-        x_rot, x_pass = x[..., :d], x[..., d:]
-        half = d // 2
-        freqs = 1.0 / (10000 ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-        t = jnp.arange(T, dtype=jnp.float32)
-        angles = jnp.einsum("t,f->tf", t, freqs)
-        cos = jnp.cos(angles)[None, :, None, :]
-        sin = jnp.sin(angles)[None, :, None, :]
-        x1, x2 = x_rot[..., :half], x_rot[..., half:]
-        rotated = jnp.concatenate(
-            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
-        ).astype(x.dtype)
-        return jnp.concatenate([rotated, x_pass], axis=-1)
-
-    return rot(q), rot(k)

@@ -154,12 +154,29 @@ class ModuleManager:
         self._spec_providers.append(fn)
 
     def spec_for(self, path, leaf):
-        spec = None
+        """Merge provider specs dimension-wise: a later provider's axis wins
+        on a dim where both name axes; None dims are transparent. This is
+        how the pipeline's stage sharding (dim 0 of stacked layer params on
+        'pp') composes with TP axes on inner dims ('tp' from flax
+        with_partitioning metadata) and ZeRO sharding (M4)."""
+        ndim = getattr(leaf, "ndim", 0)
+        merged = [None] * ndim
+        seen = False
         for provider in self._spec_providers:
             got = provider(path, leaf)
-            if got is not None:
-                spec = got
-        return spec if spec is not None else P()
+            if got is None:
+                continue
+            if len(got) > ndim:
+                raise PartitionError(
+                    f"Sharding spec {got} from provider "
+                    f"'{getattr(provider, '_smp_name', provider)}' has more "
+                    f"dims than parameter '{path}' (ndim={ndim})."
+                )
+            seen = True
+            for i, axes in enumerate(got):
+                if axes is not None:
+                    merged[i] = axes
+        return P(*merged) if seen else P()
 
     def param_shardings(self, mesh, params):
         def leaf_sharding(path, leaf):

@@ -9,3 +9,29 @@ from smdistributed_modelparallel_tpu.nn.tp_registry import (
     tp_register,
     tp_register_with_module,
 )
+from smdistributed_modelparallel_tpu.nn.linear import (
+    ColumnParallelLinear,
+    DistributedLinear,
+    RowParallelLinear,
+)
+from smdistributed_modelparallel_tpu.nn.embedding import DistributedEmbedding
+from smdistributed_modelparallel_tpu.nn.layer_norm import (
+    DistributedLayerNorm,
+    FusedLayerNorm,
+)
+from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+    DistributedCrossEntropy,
+    vocab_parallel_cross_entropy,
+)
+from smdistributed_modelparallel_tpu.nn.softmax import (
+    scaled_causal_masked_softmax,
+    scaled_masked_softmax,
+)
+from smdistributed_modelparallel_tpu.nn.gelu import bias_gelu, gelu
+from smdistributed_modelparallel_tpu.nn.transformer import (
+    DistributedAttentionLayer,
+    DistributedTransformer,
+    DistributedTransformerLayer,
+    DistributedTransformerLMHead,
+    DistributedTransformerOutputLayer,
+)

@@ -1,0 +1,62 @@
+"""DistributedCrossEntropy — vocab-parallel cross-entropy.
+
+Parity target: reference ``torch/nn/cross_entropy.py:28-112``
+(Megatron-style): local max -> allreduce-max -> mask local target logits ->
+allreduce of target-logit and sum-exp -> loss.
+
+TPU-native re-design: written as a numerically-stable log-softmax over the
+(tp-sharded) vocab axis with sharding constraints; GSPMD emits the same
+max/sum allreduces the reference codes explicitly. The target-logit gather
+is a one-hot contraction (MXU-friendly, partitionable over the sharded
+vocab dim).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from smdistributed_modelparallel_tpu.backend.topology import TP_AXIS
+from smdistributed_modelparallel_tpu.nn.utils import shard_activation
+
+
+def vocab_parallel_cross_entropy(logits, targets, label_smoothing=0.0):
+    """Per-token cross-entropy loss.
+
+    Args:
+      logits: [..., vocab] (vocab axis may be tp-sharded).
+      targets: [...] int ids.
+    Returns:
+      [...] per-token losses (fp32).
+    """
+    vocab = logits.shape[-1]
+    spec = [None] * (logits.ndim - 1) + [TP_AXIS]
+    logits = shard_activation(logits, *spec)
+    logits_f = logits.astype(jnp.float32)
+    # Stable logsumexp over the sharded vocab axis: GSPMD lowers max/sum to
+    # the reference's allreduce(max)/allreduce(sum) pair
+    # (torch/nn/cross_entropy.py:42-71).
+    m = jax.lax.stop_gradient(jnp.max(logits_f, axis=-1, keepdims=True))
+    lse = jnp.log(jnp.sum(jnp.exp(logits_f - m), axis=-1)) + m[..., 0]
+    one_hot = jax.nn.one_hot(targets, vocab, dtype=logits_f.dtype)
+    target_logit = jnp.sum(logits_f * one_hot, axis=-1)
+    loss = lse - target_logit
+    if label_smoothing > 0.0:
+        smooth = -jnp.mean(jax.nn.log_softmax(logits_f, axis=-1), axis=-1)
+        loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth
+    return loss
+
+
+class DistributedCrossEntropy(nn.Module):
+    """Module wrapper matching the reference class surface
+    (``torch/nn/cross_entropy.py:28``); reduction over all tokens."""
+
+    reduction: str = "mean"
+    label_smoothing: float = 0.0
+
+    def __call__(self, logits, targets):
+        loss = vocab_parallel_cross_entropy(logits, targets, self.label_smoothing)
+        if self.reduction == "mean":
+            return jnp.mean(loss)
+        if self.reduction == "sum":
+            return jnp.sum(loss)
+        return loss

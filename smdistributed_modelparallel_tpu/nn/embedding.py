@@ -1,0 +1,81 @@
+"""DistributedEmbedding — vocab- or dim-parallel embedding.
+
+Parity target: reference ``torch/nn/embedding.py:26-290``:
+``split="vocab"`` shards the vocabulary across tp ranks
+(``DistVocabSplitFunction`` masks out-of-range ids and allreduces,
+``:204-289``); ``split="dim"`` (``_distribute_embedding_dim``) shards the
+embedding dimension and allgathers.
+
+TPU-native re-design: the table carries the tp axis on the chosen dim; the
+lookup is expressed as a one-hot matmul so the contraction maps onto the
+MXU *and* GSPMD turns the vocab-sharded case into exactly the reference's
+mask+partial-lookup+allreduce pattern — no hand-written masking. For
+``split="dim"`` a plain take with the hidden axis sharded suffices.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from smdistributed_modelparallel_tpu.backend.topology import TP_AXIS
+from smdistributed_modelparallel_tpu.nn.utils import partitioned, shard_activation
+from smdistributed_modelparallel_tpu.utils.exceptions import SMPValidationError
+
+
+class DistributedEmbedding(nn.Module):
+    """Tensor-parallel embedding table [num_embeddings, features]."""
+
+    num_embeddings: int
+    features: int
+    split: str = "vocab"           # "vocab" | "dim"  (reference: split arg)
+    dtype: Optional[jnp.dtype] = None
+    init_scale: float = 0.02
+    one_hot_lookup: Optional[bool] = None  # default: on for vocab-split
+
+    def setup(self):
+        if self.split not in ("vocab", "dim"):
+            raise SMPValidationError(
+                f"DistributedEmbedding split must be 'vocab' or 'dim', got {self.split!r}"
+            )
+        names = (TP_AXIS, None) if self.split == "vocab" else (None, TP_AXIS)
+        self.embedding = self.param(
+            "embedding",
+            partitioned(nn.initializers.normal(stddev=self.init_scale), names),
+            (self.num_embeddings, self.features),
+            self.dtype or jnp.float32,
+        )
+
+    def __call__(self, ids):
+        table = self.embedding
+        use_one_hot = (
+            self.one_hot_lookup
+            if self.one_hot_lookup is not None
+            else self.split == "vocab"
+        )
+        if use_one_hot:
+            # One-hot contraction: MXU-friendly and GSPMD-partitionable on
+            # the sharded vocab dim (each rank contracts only its slab; the
+            # psum is the reference's allreduce, torch/nn/embedding.py:267).
+            one_hot = jax.nn.one_hot(ids, self.num_embeddings, dtype=table.dtype)
+            out = one_hot @ table
+        else:
+            out = jnp.take(table, ids, axis=0)
+        if self.split == "dim":
+            out = shard_activation(out, *([None] * (out.ndim - 1) + [TP_AXIS]))
+        else:
+            out = shard_activation(out, *([None] * out.ndim))
+        return out
+
+    def attend(self, x):
+        """Tied-weights logits: x @ table.T — the LM head over the (possibly
+        vocab-sharded) table; output vocab axis sharded over tp. Parity:
+        tied lm_head in ``DistributedTransformerLMHead``
+        (``torch/nn/transformer.py:520-548``)."""
+        table = self.embedding
+        logits = x @ table.astype(x.dtype).T
+        if self.split == "vocab":
+            spec = [None] * (logits.ndim - 1) + [TP_AXIS]
+            logits = shard_activation(logits, *spec)
+        return logits

@@ -1,0 +1,17 @@
+"""Fused bias-gelu.
+
+Parity target: reference ``torch/nn/gelu.py:29-64`` (torchscript-fused
+bias+gelu forward/backward). On TPU, XLA fuses the bias add and gelu into
+the producing matmul's epilogue; the function exists for API parity and to
+pin the tanh approximation the reference uses.
+"""
+
+import flax.linen as nn
+
+
+def bias_gelu(x, bias):
+    return nn.gelu(x + bias, approximate=True)
+
+
+def gelu(x):
+    return nn.gelu(x, approximate=True)

@@ -1,0 +1,68 @@
+"""DistributedLayerNorm — layernorm over a tp-sharded hidden dimension.
+
+Parity target: reference ``torch/nn/layer_norm.py:24-152``: two-phase CUDA
+layernorm (per-rank partial mean/var -> allreduce -> finish; kernels
+``forward_affine_mean_var`` / ``backward_affine_local_sums`` /
+``backward_affine_finish``, SURVEY §2.1 N8) plus a re-export of apex
+``FusedLayerNorm``.
+
+TPU-native re-design: the moments are plain ``mean`` reductions over the
+(possibly tp-sharded) hidden axis — GSPMD decomposes them into exactly the
+partial-sums + cross-rank reduce + finish phases of the reference's kernel
+pair, and XLA fuses the normalization arithmetic. The affine params carry
+the same tp sharding as the activation's hidden axis so no gather is
+needed.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from smdistributed_modelparallel_tpu.backend.topology import TP_AXIS
+from smdistributed_modelparallel_tpu.nn.utils import partitioned
+
+
+class DistributedLayerNorm(nn.Module):
+    """LayerNorm whose scale/bias (and input hidden axis) may be tp-sharded.
+
+    Args:
+      sharded: hidden axis of the input is sharded over tp (affine params
+        follow). With sharded=False this is a standard LayerNorm kept for
+        API parity with the reference's FusedLayerNorm re-export.
+    """
+
+    epsilon: float = 1e-5
+    use_scale: bool = True
+    use_bias: bool = True
+    sharded: bool = False
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x):
+        features = x.shape[-1]
+        dtype = self.dtype or x.dtype
+        # Moments in fp32 regardless of activation dtype (parity: reference
+        # kernels accumulate in fp32).
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
+        names = (TP_AXIS,) if self.sharded else (None,)
+        if self.use_scale:
+            scale = self.param(
+                "scale", partitioned(nn.initializers.ones, names), (features,), dtype
+            )
+            y = y * scale.astype(jnp.float32)
+        if self.use_bias:
+            bias = self.param(
+                "bias", partitioned(nn.initializers.zeros, names), (features,), dtype
+            )
+            y = y + bias.astype(jnp.float32)
+        return y.astype(x.dtype)
+
+
+# Reference also exposes apex FusedLayerNorm under this module; the XLA-fused
+# DistributedLayerNorm covers both surfaces.
+FusedLayerNorm = DistributedLayerNorm

@@ -1,0 +1,45 @@
+"""Fused scaled-masked softmax surfaces.
+
+Parity target: reference ``torch/nn/softmax.py:15-93``
+(``ScaledMaskedSoftmax`` / ``ScaledCausalMaskedSoftmax`` wrapping the
+``smp_torch_cuda_lib`` fused kernels, SURVEY §2.1 N8; fp16/bf16 only, with
+``can_use_fused_kernel`` dispatch at ``torch/nn/transformer.py:83-112``).
+
+TPU-native re-design: the default path is plain jnp — XLA fuses
+scale+mask+softmax into one HBM pass on TPU, which is what the reference's
+hand-written CUDA kernel buys on GPU. A Pallas flash-attention kernel
+(``ops/pallas_attention.py``) goes further and never materializes the
+[T, T] score matrix; ``DistributedAttentionLayer`` dispatches to it when
+``cfg.use_pallas_kernels`` and shapes allow.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def scaled_masked_softmax(scores, mask, scale=1.0):
+    """softmax(scores * scale + mask_bias) over the last axis.
+
+    ``mask``: bool (True = keep) or additive-bias array broadcastable to
+    ``scores``; None for no masking.
+    """
+    s = scores.astype(jnp.float32) * scale
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+        else:
+            s = s + mask
+    return jax.nn.softmax(s, axis=-1).astype(scores.dtype)
+
+
+def scaled_causal_masked_softmax(scores, scale=1.0, window=None):
+    """Causal (optionally windowed) variant; scores [..., T, S].
+
+    Parity: ``ScaledCausalMaskedSoftmax`` + the windowed causal mask buffer
+    (``torch/nn/transformer.py:1331-1352``).
+    """
+    from smdistributed_modelparallel_tpu.ops.attention import causal_window_mask
+
+    T, S = scores.shape[-2], scores.shape[-1]
+    mask = causal_window_mask(T, S, window)
+    return scaled_masked_softmax(scores, mask, scale)

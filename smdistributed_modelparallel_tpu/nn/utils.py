@@ -1,0 +1,141 @@
+"""TP utilities: parameter partitioning metadata + activation sharding.
+
+Parity target: reference ``torch/nn/utils.py`` — ``parameter_creation_scope``
+(marks params distributed/scaled-batch, ``:120-154``),
+``initialize_with_input_partition`` / ``initialize_with_output_partition``
+(slice fan-in/fan-out per tp_rank, ``:155-249``), and the autograd
+collectives ``NarrowForTP`` / ``AllgatherForTP`` / ``ForwardAllreduceForTP``
+/ ``BackwardAllreduceForTP`` / ``ReduceScatterForTP`` /
+``ScatterAndMergeForTP`` (``:465-663``).
+
+TPU-native re-design: none of those collectives are written by hand. A
+parameter is "input/output partitioned" by carrying a PartitionSpec with the
+``tp`` mesh axis on the corresponding dimension (flax ``with_partitioning``
+metadata, unboxed by ``DistributedModel``); activations are steered with
+``with_sharding_constraint``. GSPMD then inserts exactly the
+allgather/reduce-scatter/allreduce pairs the reference implements as
+autograd Functions — including their transposes for backward. The explicit
+collectives that remain (Ulysses all-to-all, ring permute) live in
+``smp.ops``.
+"""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.backend.topology import (
+    CP_AXIS,
+    RDP_AXIS,
+    EP_AXIS,
+    TP_AXIS,
+)
+
+
+def tp_size():
+    if state.cfg is None:
+        return 1
+    return state.cfg.tensor_parallel_degree
+
+
+def tp_enabled():
+    return tp_size() > 1
+
+
+def _mesh():
+    return state.mesh if state.initialized else None
+
+
+def shard_activation(x, *spec):
+    """Constrain an activation to a PartitionSpec over the mesh.
+
+    No-op when the framework is uninitialized or the mesh axes named in the
+    spec are all size 1 (e.g. tp_degree=1) — the constraint would be a
+    trivial replication and only add noise to the jaxpr.
+    """
+    mesh = _mesh()
+    if mesh is None:
+        return x
+    sizes = mesh.shape
+    used = [a for axes in spec if axes is not None
+            for a in (axes if isinstance(axes, tuple) else (axes,))]
+    if not used or all(sizes.get(a, 1) == 1 for a in used):
+        return x
+    # Drop axes that don't divide the dim (tiny test shapes).
+    fixed = []
+    for dim, axes in enumerate(spec):
+        if axes is None:
+            fixed.append(None)
+            continue
+        axes_t = axes if isinstance(axes, tuple) else (axes,)
+        total = 1
+        for a in axes_t:
+            total *= sizes.get(a, 1)
+        if dim < x.ndim and x.shape[dim] % total == 0:
+            fixed.append(axes)
+        else:
+            fixed.append(None)
+    full = fixed + [None] * (x.ndim - len(fixed))
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(*full))
+    )
+
+
+def batch_seq_spec(extra=()):
+    """Leading (batch, seq) axes of an activation: batch over the data axes,
+    sequence over cp. ``extra`` appends trailing-dim axes."""
+    return (( RDP_AXIS, EP_AXIS), CP_AXIS) + tuple(extra)
+
+
+def partitioned(init_fn, names):
+    """Wrap a flax param init with tp partitioning metadata.
+
+    ``names`` is a tuple with one entry per dim: a mesh axis name or None.
+    When tp is disabled the init is returned unwrapped so parameter trees
+    are plain arrays in the single-device path.
+    """
+    if not tp_enabled() or not any(n for n in names):
+        return init_fn
+    return nn.with_partitioning(init_fn, tuple(names))
+
+
+def dense_init(scale=None, stddev=0.02):
+    if scale is not None:
+        return nn.initializers.normal(stddev=scale)
+    return nn.initializers.normal(stddev=stddev)
+
+
+def resolve_deterministic(explicit):
+    """Whether dropout should be skipped.
+
+    ``explicit`` is a module's ``deterministic`` field: an explicit bool
+    wins; None defers to the wrapping ``DistributedModel``'s train/eval
+    mode (parity: the reference's modules are nn.Modules following
+    ``model.train()``/``.eval()``; flax needs the flag threaded).
+    """
+    if explicit is not None:
+        return explicit
+    model = state.model
+    if model is not None:
+        return not model.training
+    return True
+
+
+# ----------------------------------------------------------------------
+# Sequence sharding helpers (parity: reference torch/nn/utils.py:45-70
+# shard_sequence / unshard_sequence).
+# ----------------------------------------------------------------------
+
+
+def shard_sequence(x, axis=1):
+    """Constrain the sequence axis over the tp axis (the reference slices
+    the sequence per tp_rank; here it is a resharding constraint)."""
+    spec = [None] * x.ndim
+    spec[axis] = TP_AXIS
+    return shard_activation(x, *spec)
+
+
+def unshard_sequence(x, axis=1):
+    spec = [None] * x.ndim
+    return shard_activation(x, *spec)

@@ -1,0 +1,159 @@
+"""Attention compute core.
+
+Parity target: reference attention math in ``DistributedAttentionLayer``
+(``torch/nn/transformer.py:1352-1444``) and the fused softmax kernels it
+dispatches to (``torch/nn/softmax.py``, ``can_use_fused_kernel``
+``torch/nn/transformer.py:83-112``, SURVEY §2.1 N8).
+
+TPU-native design: one functional entry point ``attention_core`` over
+[B, T, H, hd] tensors. Dispatch order:
+  1. Pallas flash-attention kernel (TPU, shapes tile, no bias/dropout) —
+     never materializes the [T, S] score matrix;
+  2. jnp path — XLA fuses scale+mask+softmax into one HBM pass.
+Ring-attention context parallelism (M6) wraps this core with a ppermute
+loop over KV blocks.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def causal_window_mask(T, S, window=None, dtype=jnp.bool_):
+    """[T, S] lower-triangular mask, optionally banded to ``window``.
+
+    Parity: causal-mask buffer + windowed attention
+    (``torch/nn/transformer.py:1331-1352``).
+    """
+    rows = jnp.arange(T)[:, None]
+    cols = jnp.arange(S)[None, :]
+    offset = S - T
+    mask = cols <= rows + offset
+    if window is not None:
+        mask = mask & (rows + offset - cols < window)
+    return mask.astype(dtype)
+
+
+def attention_core(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+    local_select=None,
+    scale: Optional[float] = None,
+    extra_scale=None,
+    qk_compensation=None,
+    bias=None,
+    mask=None,
+    mask_value: float = -1e4,
+    attention_in_fp32: bool = False,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
+    use_pallas: bool = True,
+):
+    """Multi-head attention over [B, T, H, hd] q and [B, S, H, hd] k/v.
+
+    Args:
+      causal/window: static masking (window = local attention band).
+      local_select: optional traced bool scalar — when given, the window
+        band applies only if True (per-layer local/global selection under
+        ``lax.scan``, GPT-Neo ``attention_layers_type``).
+      scale: score scale; default 1/sqrt(hd). Applied to q BEFORE the
+        matmul so half-precision scores cannot overflow.
+      extra_scale: optional traced scalar multiplier on the scale
+        (scale_attn_by_layer_idx).
+      qk_compensation: optional traced scalar c — q is pre-scaled by 1/c
+        before the matmul and the fp32 scores multiplied back by c
+        (parity: reference query_key_layer_scaling, a numerics-only
+        protection for half-precision score matmuls,
+        ``torch/nn/transformer.py:1804-1836``).
+      bias: additive [B|1, H|1, T, S] bias (e.g. relative position).
+      mask: additive or boolean attention mask broadcastable to
+        [B, 1, T, S] (True/0 = keep).
+      mask_value: additive value for masked positions (parity: reference
+        ``mask_value`` key, default -1e4).
+    Returns: [B, T, H, hd].
+    """
+    hd = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(hd)
+    if extra_scale is not None:
+        scale = scale * extra_scale
+
+    if (
+        use_pallas
+        and _pallas_ok(q, k, v)
+        and bias is None
+        and mask is None
+        and local_select is None
+        and (dropout_rate == 0.0 or dropout_rng is None)
+        and causal
+        and window is None
+        and not attention_in_fp32  # kernel already computes scores in fp32
+        and extra_scale is None
+        and qk_compensation is None  # kernel matmul is fp32; no overflow
+    ):
+        from smdistributed_modelparallel_tpu.ops.pallas_attention import (
+            flash_attention,
+        )
+
+        return flash_attention(q, k, v, scale=scale)
+
+    T, S = q.shape[1], k.shape[1]
+    compute_dtype = jnp.float32 if attention_in_fp32 else q.dtype
+    # Pre-scale q so the half-precision score matmul cannot overflow
+    # (reference applies the norm factor inside the baddbmm alpha).
+    pre = jnp.asarray(scale, jnp.float32)
+    if qk_compensation is not None:
+        pre = pre / qk_compensation
+    qc = (q.astype(jnp.float32) * pre).astype(compute_dtype)
+    kc = k.astype(compute_dtype)
+    scores = jnp.einsum("bthd,bshd->bhts", qc, kc).astype(jnp.float32)
+    if qk_compensation is not None:
+        scores = scores * qk_compensation
+
+    if causal:
+        cmask = causal_window_mask(T, S)
+        if window is not None:
+            if local_select is not None:
+                wmask = causal_window_mask(T, S, window)
+                cmask = jnp.where(local_select, wmask, cmask)
+            else:
+                cmask = causal_window_mask(T, S, window)
+        scores = jnp.where(cmask[None, None], scores, mask_value)
+    elif window is not None:
+        # Non-causal local attention: symmetric band of width `window`.
+        rows = jnp.arange(T)[:, None]
+        cols = jnp.arange(S)[None, :]
+        band = jnp.abs(rows + (S - T) - cols) < window
+        scores = jnp.where(band[None, None], scores, mask_value)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, mask_value)
+        else:
+            scores = scores + mask.astype(scores.dtype)
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def _pallas_ok(q, k, v):
+    """Pallas flash kernel preconditions: TPU backend, self-attention, and a
+    sequence short enough that K/V fit VMEM per (batch, head) — the kernel
+    pads hd/T to tile boundaries itself (``pallas_attention._flash_fwd``)."""
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        return False
+    T, S, hd = q.shape[1], k.shape[1], q.shape[-1]
+    return T == S and T >= 128 and T <= 8192 and hd <= 256

@@ -55,17 +55,26 @@ class PipelineSpec:
       embed_method / head_method: method names on the root module computing
         the pre-layer carry and the post-layer output. Both may use any
         non-layer parameters (they run replicated across stages; their
-        parameters stay replicated on the pp axis).
+        parameters stay replicated on the pp axis). ``None`` = identity
+        (the module IS the layer stack, e.g. DistributedTransformer).
       carry_remat: rematerialize each layer application (activation
         checkpointing inside the pipeline).
+      layer_xs: optional pytree of stacked [num_layers, ...] per-layer
+        inputs threaded into each layer application (e.g. layer_idx,
+        is_local for GPT-Neo alternating attention).
+      carry_is_tuple: carry is (hidden, cross_states, attention_mask) and
+        the layer takes them as separate arguments (the smp.nn transformer
+        family's calling convention).
     """
 
     layer_path: str
     num_layers: int
     layer_module: Any
-    embed_method: str = "embed"
-    head_method: str = "head"
+    embed_method: Optional[str] = "embed"
+    head_method: Optional[str] = "head"
     carry_remat: bool = False
+    layer_xs: Any = None
+    carry_is_tuple: bool = False
 
 
 def get_pipeline_spec(module):
@@ -161,6 +170,9 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
 
     def embed_mb(mb_input, key):
         args, kwargs = mb_input
+        if spec.embed_method is None:
+            # The module IS the layer stack; the model(...) input is the carry.
+            return args[0]
         return module.apply(
             {"params": params},
             *args,
@@ -170,6 +182,10 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
         )
 
     def head_mb(carry, key):
+        # `carry` here is the collected hidden only (side values never
+        # leave the layer stack).
+        if spec.head_method is None:
+            return carry
         return module.apply(
             {"params": params},
             carry,
@@ -177,23 +193,32 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
             method=spec.head_method,
         )
 
-    def apply_one_layer(lp, carry, key):
-        out = layer_module.apply({"params": lp}, carry, rngs=_mk_rngs(model, key, "layer"))
-        return out
+    def apply_one_layer(lp, carry, layer_xs, key):
+        rngs = _mk_rngs(model, key, "layer")
+        if spec.carry_is_tuple:
+            x, cross, amask = carry
+            out = layer_module.apply(
+                {"params": lp}, x, cross_states=cross, attention_mask=amask,
+                xs=layer_xs, rngs=rngs,
+            )
+            return (out, cross, amask)
+        if spec.layer_xs is not None:
+            return layer_module.apply({"params": lp}, carry, xs=layer_xs, rngs=rngs)
+        return layer_module.apply({"params": lp}, carry, rngs=rngs)
 
     if spec.carry_remat:
-        apply_one_layer = jax.checkpoint(apply_one_layer)
+        apply_one_layer = jax.checkpoint(apply_one_layer, static_argnums=())
 
-    def stage_body(stage_layer_params, carry, key):
+    def stage_body(stage_layer_params, stage_layer_xs, carry, key):
         """Apply this stage's per_stage layers sequentially (scan over the
         local layer axis)."""
 
         def body(c, xs):
-            lp, i = xs
-            return apply_one_layer(lp, c, jax.random.fold_in(key, i)), None
+            lp, lxs, i = xs
+            return apply_one_layer(lp, c, lxs, jax.random.fold_in(key, i)), None
 
         idx = jnp.arange(per_stage)
-        out, _ = jax.lax.scan(body, carry, (stage_layer_params, idx))
+        out, _ = jax.lax.scan(body, carry, (stage_layer_params, stage_layer_xs, idx))
         return out
 
     mb_keys = jax.random.split(rngs_key, num_mb)
@@ -205,17 +230,31 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     staged_params = jax.tree_util.tree_map(
         lambda x: x.reshape((S, per_stage) + x.shape[1:]), layer_params
     )
+    staged_xs = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x).reshape((S, per_stage) + jnp.asarray(x).shape[1:]),
+        spec.layer_xs,
+    )
 
     n_ticks = num_mb + S - 1
-    carry_shape = jax.tree_util.tree_map(lambda x: x[0], embedded)
+    # Only the hidden flows stage-to-stage over the pp permute; tuple-carry
+    # side values (cross_states, attention_mask) are static per-microbatch
+    # inputs, gathered per stage per tick instead of rolled through ICI.
+    if spec.carry_is_tuple:
+        rolled = embedded[0]
+        sides = embedded[1:]
+    else:
+        rolled = embedded
+        sides = None
+    carry_shape = jax.tree_util.tree_map(lambda x: x[0], rolled)
     # Stage input buffer: [S, ...carry]; buf[s] is the input consumed by
     # stage s at the next tick.
     buf0 = jax.tree_util.tree_map(
         lambda x: jnp.zeros((S,) + x.shape, x.dtype), carry_shape
     )
 
-    vmapped_stages = jax.vmap(stage_body, in_axes=(0, 0, 0))
+    vmapped_stages = jax.vmap(stage_body, in_axes=(0, 0, 0, 0))
     stage_keys = jax.random.split(rngs_key, S)
+    stage_ids = jnp.arange(S)
 
     def tick(buf, t):
         # Feed stage 0 with microbatch t (clamped; invalid ticks produce
@@ -225,16 +264,34 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
             lambda e, b: b.at[0].set(
                 jax.lax.dynamic_index_in_dim(e, mb_idx, 0, keepdims=False)
             ),
-            embedded, buf,
+            rolled, buf,
         )
+        if sides is not None:
+            # Stage s processes microbatch t - s at tick t.
+            stage_mbs = jnp.clip(t - stage_ids, 0, num_mb - 1)
+            stage_sides = tuple(
+                jax.tree_util.tree_map(
+                    lambda a: jax.vmap(
+                        lambda i: jax.lax.dynamic_index_in_dim(
+                            a, i, 0, keepdims=False
+                        )
+                    )(stage_mbs),
+                    side,
+                )
+                for side in sides
+            )
+            carry_in = (feed,) + stage_sides
+        else:
+            carry_in = feed
         # Distinct dropout keys per (stage, tick).
         tick_keys = jax.vmap(lambda k: jax.random.fold_in(k, t))(stage_keys)
-        outs = vmapped_stages(staged_params, feed, tick_keys)
+        outs = vmapped_stages(staged_params, staged_xs, carry_in, tick_keys)
+        x_outs = outs[0] if sides is not None else outs
         # Collect last stage's output (microbatch t - (S-1) when valid).
-        tail = jax.tree_util.tree_map(lambda o: o[S - 1], outs)
+        tail = jax.tree_util.tree_map(lambda o: o[S - 1], x_outs)
         # Shift stage outputs forward one stage: collective-permute on pp.
         nxt = jax.tree_util.tree_map(
-            lambda o: jnp.roll(o, shift=1, axis=0), outs
+            lambda o: jnp.roll(o, shift=1, axis=0), x_outs
         )
         return nxt, tail
 

@@ -186,7 +186,8 @@ class StepFunction:
 
         key = (treedef, tuple(scan_idx), tuple(bcast_idx),
                tuple((i, _static_key(v)) for i, v in sorted(static.items())),
-               getattr(self, "_has_backward", True))
+               getattr(self, "_has_backward", True),
+               model.training if model is not None else None)
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._build(model, treedef, scan_idx, bcast_idx, static, num_mb)
