@@ -44,6 +44,12 @@ from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.model import DistributedModel
 from smdistributed_modelparallel_tpu.optimizer import DistributedOptimizer
 from smdistributed_modelparallel_tpu.step import step
+from smdistributed_modelparallel_tpu.checkpoint import (
+    load,
+    resume_from_checkpoint,
+    save,
+    save_checkpoint,
+)
 from smdistributed_modelparallel_tpu.nn.tp_registry import (
     tp_register,
     tp_register_with_module,
@@ -213,16 +219,10 @@ def _module_manager():
 
 
 def partition(stage):
-    """Context manager assigning modules created inside to pipeline stage.
-
-    Module-construction interception lands with the TP registry wiring (M3);
-    until then this warns and the path-based ``smp.set_partition`` is the
-    effective API.
-    """
-    get_logger().warning(
-        "smp.partition(%s): construction-context assignment is not wired yet; "
-        "use smp.set_partition(module_path, stage).", stage
-    )
+    """Context manager: flax modules constructed inside are assigned to
+    pipeline stage `stage` (stamped at construction; harvested when
+    DistributedModel walks the tree). Parity: reference ``smp.partition(i)``
+    (``torch/module_manager.py:1161``)."""
     return _module_manager().partition(stage)
 
 
@@ -254,16 +254,11 @@ from contextlib import contextmanager as _contextmanager
 
 @_contextmanager
 def tensor_parallelism(enabled=True, **tp_config):
-    """Context manager marking modules created inside for TP distribution.
-
-    Construction interception lands with the TP registry wiring (M3); until
-    then this warns and ``smp.set_tensor_parallelism(path, ...)`` is the
-    effective API.
+    """Context manager: flax modules constructed inside are marked for TP
+    distribution (stamped at construction; swapped for their registered
+    smp.nn counterparts when DistributedModel walks the tree). Parity:
+    reference ``smp.tensor_parallelism`` (``torch/module_manager.py:1095``).
     """
-    get_logger().warning(
-        "smp.tensor_parallelism(): construction-context marking is not wired "
-        "yet; use smp.set_tensor_parallelism(module_path, ...)."
-    )
     mm = _module_manager()
     prev = getattr(mm, "_active_tp", None)
     mm._active_tp = {"enabled": enabled, **tp_config}
@@ -275,3 +270,19 @@ def tensor_parallelism(enabled=True, **tp_config):
 
 def set_activation_checkpointing(module_prefix, **config):
     _module_manager().set_activation_checkpointing(module_prefix, **config)
+
+
+def checkpoint(fn, *args, **kwargs):
+    """Rematerialize `fn` (parity: reference ``smp.checkpoint``)."""
+    from smdistributed_modelparallel_tpu.parallel.memory import checkpoint as _ckpt
+
+    return _ckpt(fn, *args, **kwargs)
+
+
+def checkpoint_sequential(fns, input, strategy="each"):
+    """Remat a chain (parity: reference ``smp.checkpoint_sequential``)."""
+    from smdistributed_modelparallel_tpu.parallel.memory import (
+        checkpoint_sequential as _ckpt_seq,
+    )
+
+    return _ckpt_seq(fns, input, strategy)

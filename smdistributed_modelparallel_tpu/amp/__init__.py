@@ -1,0 +1,40 @@
+"""AMP-style grad scaler.
+
+Parity target: reference ``torch/amp/scaler.py:22-194`` — a
+``torch.cuda.amp.GradScaler`` subclass whose found_inf flag is allgathered
+across the PP group so all pp_ranks skip steps together. Under SPMD the
+flag is computed once inside the compiled step; this class adapts the
+torch-style scale/step/update API onto the framework's scaler.
+"""
+
+from smdistributed_modelparallel_tpu.fp16.loss_scaler import DynamicLossScaler
+
+
+class GradScaler(DynamicLossScaler):
+    """torch.cuda.amp.GradScaler-shaped surface over DynamicLossScaler."""
+
+    def __init__(self, init_scale=2.0 ** 16, growth_factor=2.0,
+                 backoff_factor=0.5, growth_interval=2000, enabled=True):
+        super().__init__(
+            init_scale=init_scale,
+            scale_factor=growth_factor,
+            scale_window=growth_interval,
+        )
+        self.backoff_factor = backoff_factor
+        self.enabled = enabled
+
+    def scale(self, loss):
+        return loss * self.loss_scale if self.enabled else loss
+
+    def get_scale(self):
+        return self.loss_scale
+
+    def step(self, optimizer):
+        # The framework's DistributedOptimizer.step already consults the
+        # step's finite flag; delegate.
+        optimizer.step()
+
+    def unscale_(self, optimizer):
+        # Grad unscaling happens inside the compiled step; kept for API
+        # parity with the reference's torch surface.
+        pass

@@ -21,6 +21,9 @@ class ModelParallelState:
         self.module_manager = None  # set by model.py on DistributedModel creation
         self.tp_registry = None     # lazily created TensorParallelismRegistry
         self.rng_manager = None
+        self.loss_scaler = None     # DynamicLossScaler when cfg.fp16
+        self.timeline = None        # Timeline (SMP_TIMELINE_PATH)
+        self.memory_metrics = None  # StepMemoryMetricsCollector
         self.step_count = 0
         self.loaded_model_state = None      # deferred checkpoint payloads
         self.loaded_optimizer_state = None
@@ -39,6 +42,28 @@ class ModelParallelState:
 
         if self.tp_registry is None:
             self.tp_registry = TensorParallelismRegistry()
+        from smdistributed_modelparallel_tpu.nn.auto_distribute import (
+            install_construction_hooks,
+            register_builtins,
+        )
+
+        register_builtins(self.tp_registry)
+        install_construction_hooks()
+        if cfg.fp16:
+            from smdistributed_modelparallel_tpu.fp16.loss_scaler import (
+                DynamicLossScaler,
+            )
+
+            self.loss_scaler = DynamicLossScaler()
+        else:
+            self.loss_scaler = None
+        from smdistributed_modelparallel_tpu.utils.metrics import (
+            StepMemoryMetricsCollector,
+        )
+        from smdistributed_modelparallel_tpu.utils.timeline import Timeline
+
+        self.timeline = Timeline()
+        self.memory_metrics = StepMemoryMetricsCollector()
 
     def _check(self):
         if not self.initialized:

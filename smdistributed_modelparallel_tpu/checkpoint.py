@@ -1,0 +1,268 @@
+"""Checkpoint save/load/resume.
+
+Parity target: reference ``torch/checkpoint.py:124-536``:
+- ``smp.save`` / ``smp.load`` partial per-rank files named
+  ``{f}_{pp}_{tp}[_{rdp}].pt`` with format auto-detection (``:42-165``);
+- ``save_checkpoint``: ``{tag}_partial/`` directories holding
+  ``model_*.pt`` / ``optimizer_*.pt`` / ``fp16_states_*.pt`` /
+  ``user_content.pt`` / ``smp_config.pt``, a ``newest`` pointer file, and
+  ``num_kept_partial_checkpoints`` retention GC (``:180-298``);
+- ``resume_from_checkpoint`` with saved-config compatibility verification
+  (``verify_smp_config``, ``:381+,487+``) and deferred load until the model
+  and optimizer exist (``state.loaded_model_state``).
+
+TPU-native notes: a "rank's partial state" is the set of addressable shards
+of the process (SPMD replaces parameter ownership with sharding); on a
+single host a partial checkpoint holds the full tree. Full checkpoints
+gather to numpy and can be translated to HF layout via the tp_registry's
+translate functions (``translate_if_full`` parity).
+"""
+
+import os
+import pickle
+import re
+import shutil
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils.exceptions import (
+    SMPRuntimeError,
+    SMPValidationError,
+)
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+_PARTIAL_RE = re.compile(r"^(?P<stem>.*)_(?P<pp>\d+)_(?P<tp>\d+)(_(?P<rdp>\d+))?$")
+
+
+def _coords():
+    import smdistributed_modelparallel_tpu as smp
+
+    return smp.pp_rank(), smp.tp_rank(), smp.rdp_rank()
+
+
+def _partial_name(f, v3=True):
+    pp, tp, rdp = _coords()
+    stem, ext = os.path.splitext(f)
+    if v3:
+        return f"{stem}_{pp}_{tp}_{rdp}{ext}"
+    return f"{stem}_{pp}_{tp}{ext}"
+
+
+def save(obj, f, partial=True, v3=True):
+    """Parity: reference ``smp.save`` (``torch/checkpoint.py:124-145``)."""
+    path = _partial_name(f, v3) if partial else f
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "wb") as fh:
+        pickle.dump(obj, fh, protocol=4)
+    return path
+
+
+def load(f, partial=True):
+    """Parity: reference ``smp.load`` with filename-format auto-detection
+    (``torch/checkpoint.py:42-122``): tries v3 ``_{pp}_{tp}_{rdp}``, then v2
+    ``_{pp}_{tp}``, then the bare (full) name."""
+    candidates = [f]
+    if partial:
+        candidates = [_partial_name(f, v3=True), _partial_name(f, v3=False), f]
+    for path in candidates:
+        if os.path.exists(path):
+            with open(path, "rb") as fh:
+                return pickle.load(fh)
+    raise SMPRuntimeError(
+        f"Checkpoint not found: tried {candidates}"
+    )
+
+
+# ----------------------------------------------------------------------
+# Directory checkpoints
+# ----------------------------------------------------------------------
+
+
+def _smp_config_snapshot():
+    cfg = state.cfg
+    return dict(cfg.as_dict()) if cfg is not None else {}
+
+
+def verify_smp_config(saved):
+    """Raise when the saved parallelism layout is incompatible.
+
+    Parity: reference ``verify_smp_config`` (``torch/checkpoint.py:487+``) —
+    degrees and TP-relevant flags must match to reuse partial checkpoints.
+    """
+    cfg = state.cfg
+    if cfg is None:
+        raise SMPValidationError("smp.init must run before resume_from_checkpoint.")
+    keys = (
+        "pipeline_parallel_degree",
+        "tensor_parallel_degree",
+        "microbatches",
+        "optimize",
+        "prescaled_batch",
+        "shard_optimizer_state",
+        "sharded_data_parallel_degree",
+    )
+    mismatches = {
+        k: (saved.get(k), getattr(cfg, k))
+        for k in keys
+        if k in saved and saved.get(k) != getattr(cfg, k)
+    }
+    if mismatches:
+        raise SMPValidationError(
+            "Saved checkpoint smp config is incompatible with the current "
+            f"config: {mismatches}"
+        )
+
+
+def save_checkpoint(path, tag=None, model=None, optimizer=None,
+                    user_content=None, partial=True,
+                    num_kept_partial_checkpoints=None, translate_if_full=True):
+    """Write a checkpoint directory.
+
+    Parity: reference ``smp.save_checkpoint`` (``torch/checkpoint.py:180-298``):
+    ``{path}/{tag}_partial/`` with per-rank files, ``newest`` pointer,
+    retention GC. With ``partial=False`` a single gathered file
+    ``{path}/{tag}`` is written (optionally HF-translated).
+    """
+    model = model if model is not None else state.model
+    optimizer = optimizer if optimizer is not None else state.optimizer
+    tag = tag if tag is not None else f"step_{state.step_count}"
+    os.makedirs(path, exist_ok=True)
+
+    if partial:
+        ckpt_dir = os.path.join(path, f"{tag}_partial")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        if model is not None and model.params is not None:
+            # Per-process file (reference: per-rank partial). Under
+            # single-controller SPMD each process saves the full gathered
+            # tree; multi-host sharded save keys off process coords in the
+            # filename so ranks don't collide.
+            save(model.state_dict(), os.path.join(ckpt_dir, "model.pt"))
+        if optimizer is not None and optimizer.opt_state is not None:
+            save(optimizer.local_state_dict(),
+                 os.path.join(ckpt_dir, "optimizer.pt"))
+        if state.loss_scaler is not None:
+            save(state.loss_scaler.state_dict(),
+                 os.path.join(ckpt_dir, "fp16_states.pt"))
+        with open(os.path.join(ckpt_dir, "user_content.pt"), "wb") as fh:
+            pickle.dump(user_content, fh, protocol=4)
+        with open(os.path.join(ckpt_dir, "smp_config.pt"), "wb") as fh:
+            pickle.dump(_smp_config_snapshot(), fh, protocol=4)
+    else:
+        sd = model.state_dict() if model is not None else {}
+        if translate_if_full:
+            sd = _maybe_translate_to_hf(model, sd)
+        payload = {
+            "model": sd,
+            "user_content": user_content,
+            "smp_config": _smp_config_snapshot(),
+        }
+        if optimizer is not None and optimizer.opt_state is not None:
+            payload["optimizer"] = optimizer.state_dict()
+        with open(os.path.join(path, tag), "wb") as fh:
+            pickle.dump(payload, fh, protocol=4)
+
+    with open(os.path.join(path, "newest"), "w") as fh:
+        fh.write(tag)
+    logger.info("Saved %s checkpoint '%s' under %s.",
+                "partial" if partial else "full", tag, path)
+
+    if partial and num_kept_partial_checkpoints is not None:
+        _gc_partial_checkpoints(path, num_kept_partial_checkpoints)
+
+
+def _gc_partial_checkpoints(path, keep):
+    """Parity: reference retention GC (``torch/checkpoint.py:270-298``)."""
+    if keep <= 0:
+        return
+    dirs = [
+        d for d in os.listdir(path)
+        if d.endswith("_partial") and os.path.isdir(os.path.join(path, d))
+    ]
+    dirs.sort(key=lambda d: os.path.getmtime(os.path.join(path, d)))
+    for d in dirs[:-keep]:
+        shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+        logger.info("Removed old partial checkpoint %s.", d)
+
+
+def resume_from_checkpoint(path, tag=None, partial=True, strict=True,
+                           load_optimizer=True, load_sharded_optimizer_state=True):
+    """Load a checkpoint; defer application until model/optimizer exist.
+
+    Parity: reference ``smp.resume_from_checkpoint``
+    (``torch/checkpoint.py:381+``).
+    Returns the saved user_content.
+    """
+    if tag is None:
+        newest = os.path.join(path, "newest")
+        if not os.path.exists(newest):
+            raise SMPRuntimeError(f"No 'newest' pointer file under {path}.")
+        with open(newest) as fh:
+            tag = fh.read().strip()
+
+    if partial:
+        ckpt_dir = os.path.join(path, f"{tag}_partial")
+        if not os.path.isdir(ckpt_dir):
+            raise SMPRuntimeError(f"Partial checkpoint dir not found: {ckpt_dir}")
+        with open(os.path.join(ckpt_dir, "smp_config.pt"), "rb") as fh:
+            saved_cfg = pickle.load(fh)
+        verify_smp_config(saved_cfg)
+        model_sd = load(os.path.join(ckpt_dir, "model.pt"))
+        opt_sd = None
+        if load_optimizer:
+            try:
+                opt_sd = load(os.path.join(ckpt_dir, "optimizer.pt"))
+            except SMPRuntimeError:
+                opt_sd = None
+        fp16_path = os.path.join(ckpt_dir, "fp16_states.pt")
+        if state.loss_scaler is not None and os.path.exists(
+            _partial_name(fp16_path)
+        ):
+            state.loss_scaler.load_state_dict(load(fp16_path))
+        with open(os.path.join(ckpt_dir, "user_content.pt"), "rb") as fh:
+            user_content = pickle.load(fh)
+    else:
+        with open(os.path.join(path, tag), "rb") as fh:
+            payload = pickle.load(fh)
+        verify_smp_config(payload.get("smp_config", {}))
+        model_sd = payload.get("model")
+        opt_sd = payload.get("optimizer") if load_optimizer else None
+        user_content = payload.get("user_content")
+
+    _stash_or_apply(model_sd, opt_sd)
+    logger.info("Resumed from checkpoint '%s' under %s.", tag, path)
+    return user_content
+
+
+def _stash_or_apply(model_sd, opt_sd):
+    model = state.model
+    if model is not None and model.params is not None:
+        model.load_state_dict(model_sd)
+    else:
+        # Applied by DistributedModel once params materialize (parity:
+        # reference state.loaded_model_state, torch/model.py:245-251).
+        state.loaded_model_state = model_sd
+    opt = state.optimizer
+    if opt_sd is None:
+        return
+    if opt is not None and opt.opt_state is not None:
+        opt.load_state_dict(opt_sd)
+    else:
+        state.loaded_optimizer_state = opt_sd
+
+
+def _maybe_translate_to_hf(model, sd):
+    """Translate a gathered state dict to the original (HF) layout when the
+    root module has registered translate functions (parity: reference
+    ``translate_if_full``, ``torch/nn/predefined_hooks.py:82-151``)."""
+    if model is None or state.tp_registry is None:
+        return sd
+    fns = state.tp_registry.translate_functions(type(model.module))
+    if fns is None:
+        return sd
+    to_hf = fns[0] if isinstance(fns, (tuple, list)) else fns
+    try:
+        return to_hf(sd)
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning("HF state-dict translation failed (%s); saving raw.", e)
+        return sd

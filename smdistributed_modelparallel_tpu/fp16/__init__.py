@@ -1,0 +1,17 @@
+"""fp16 training subsystem.
+
+Parity target: reference ``torch/fp16/`` (``Bit16_Module``,
+``Bit16_Optimizer``, ``LossScaler``/``DynamicLossScaler``,
+``clip_grad_norm_fp32``). Under the SPMD design the module/optimizer
+wrappers dissolve: parameter casting happens in the step engine
+(``step.py``: master params stay fp32, the forward runs on half casts) and
+distributed grad-norm clipping is a plain ``optax.global_norm`` over the
+sharded grad tree (XLA inserts the cross-rank reductions the reference's
+``clip_grad_norm_fp32`` performs by hand). What remains explicit is loss
+scaling.
+"""
+
+from smdistributed_modelparallel_tpu.fp16.loss_scaler import (
+    DynamicLossScaler,
+    LossScaler,
+)

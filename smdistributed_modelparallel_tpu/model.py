@@ -58,6 +58,7 @@ class DistributedModel:
         self._params = None               # materialized param pytree (jax.Arrays)
         self._param_shardings = None      # pytree of NamedSharding
         self._grads = None                # latest accumulated grads (set by step)
+        self._grads_finite = None         # device bool under fp16 loss scaling
         self._tls = threading.local()     # per-trace bound params / backward loss
         self._partition_result = None     # set by the pipeline partitioner (M2)
         self._pipeline_spec = None        # PipelineSpec when pp > 1 (M2)
@@ -78,6 +79,16 @@ class DistributedModel:
         else:
             self.module_manager = ModuleManager(module)
         state.module_manager = self.module_manager
+
+        # Re-instantiate tp-marked registered modules as their smp.nn
+        # counterparts (parity: reference _replace_tp_counterparts,
+        # torch/model.py:285-333).
+        from smdistributed_modelparallel_tpu.nn.auto_distribute import distribute_tree
+
+        self.module, self._tp_replaced = distribute_tree(
+            module, self.module_manager, state.tp_registry
+        )
+        self.module_manager.root_module = self.module
 
     # ------------------------------------------------------------------
     # Tracing-time interface (used inside @smp.step user functions)
@@ -201,6 +212,12 @@ class DistributedModel:
         self._params = params
         self.module_manager.record_param_tree(params)
         self._apply_shardings()
+        if state.loaded_model_state is not None:
+            # Deferred resume_from_checkpoint payload (parity: reference
+            # torch/model.py:245-251).
+            logger.info("Applying deferred checkpoint state to model.")
+            self.load_state_dict(state.loaded_model_state)
+            state.loaded_model_state = None
         for hook in self._post_partition_hooks:
             hook(self)
 

@@ -1,0 +1,206 @@
+"""Automatic module distribution: swap marked modules for smp.nn versions.
+
+Parity target: reference ``DistributedModel._replace_tp_counterparts``
+(``torch/model.py:285-333``) + ``TensorParallelismRegistry.distribute``
+(``torch/tp_registry.py:201-264``): modules marked for tensor parallelism
+(via ``smp.tensor_parallelism()`` context or ``smp.set_tensor_parallelism``)
+are re-instantiated as their Distributed* counterparts with translated
+constructor arguments. The reference records ctor args by patching
+``nn.Module.__init__`` (``torch/patches/__init__.py``).
+
+TPU-native re-design: flax modules are frozen dataclasses, so "recorded
+ctor args" are simply the dataclass fields. Construction-context marks are
+stamped onto instances by a ``flax.linen.Module.__post_init__`` patch
+(`install_construction_hooks`); `distribute_tree` then rebuilds the module
+tree with marked-and-registered children replaced. Children created inside
+``setup()``/``@nn.compact`` bodies are invisible pre-bind — those use
+``smp.nn`` classes directly (as the smp model zoo does), matching the
+reference's guidance to use smp.nn for custom internals.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+_hooks_installed = False
+_TP_MARK = "_smp_tp_mark"
+_PARTITION_MARK = "_smp_partition"
+
+
+def install_construction_hooks():
+    """Patch flax Module construction to stamp active smp context marks.
+
+    Parity: reference ``patch_manager.patch_constructor``
+    (``torch/__init__.py:137``) recording ctor args + tp/partition contexts.
+    """
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    orig = nn.Module.__post_init__
+
+    def post_init(self):
+        orig(self)
+        mm = state.module_manager
+        if mm is not None:
+            tp = getattr(mm, "_active_tp", None)
+            if tp and tp.get("enabled", True):
+                object.__setattr__(self, _TP_MARK, dict(tp))
+            part = getattr(mm, "_active_partition", None)
+            if part is not None:
+                object.__setattr__(self, _PARTITION_MARK, part)
+
+    nn.Module.__post_init__ = post_init
+    _hooks_installed = True
+
+
+def _module_fields(module):
+    """Dataclass fields of an unbound flax module, minus flax internals."""
+    out = {}
+    for f in dataclasses.fields(module):
+        if f.name in ("parent", "name"):
+            continue
+        out[f.name] = getattr(module, f.name)
+    return out
+
+
+def _ckpt_config_touches(mm, path):
+    """True if any activation-checkpoint config targets `path`, one of its
+    ancestors, or one of its descendants."""
+    for prefix in mm.checkpoint_configs:
+        if (
+            prefix == path
+            or prefix == ""
+            or path == ""
+            or path.startswith(prefix + "/")
+            or prefix.startswith(path + "/")
+        ):
+            return True
+    return False
+
+
+def _is_marked(child, path, mm):
+    mark = getattr(child, _TP_MARK, None)
+    if mark is None and mm is not None and mm.tp_marked(path):
+        mark = mm.tp_config(path)
+    if mark is None:
+        return None
+    cfg = dict(mark)
+    cfg.pop("enabled", None)
+    return cfg
+
+
+def distribute_tree(module, mm=None, registry=None, prefix=""):
+    """Rebuild `module` with tp-marked registered children distributed.
+
+    Returns (new_module, replaced_paths). Also harvests construction-context
+    partition stamps into the module manager.
+    """
+    registry = registry or state.tp_registry
+    mm = mm or state.module_manager
+    replaced = []
+
+    def visit(m, path):
+        part = getattr(m, _PARTITION_MARK, None)
+        if part is not None and mm is not None:
+            mm.set_partition(path or "", part)
+
+        updates = {}
+        # Activation-checkpoint configs turn on the module's own remat
+        # support where it exists (smp.nn transformer family, model zoo).
+        # A config targeting this module, an ancestor, or a setup()-defined
+        # descendant (invisible to the walk, e.g. "transformer" inside
+        # DistributedTransformerLMHead) all enable the module's remat.
+        if (
+            mm is not None
+            and _ckpt_config_touches(mm, path)
+            and any(
+                f.name == "activation_checkpointing" for f in dataclasses.fields(m)
+            )
+            and not getattr(m, "activation_checkpointing", False)
+        ):
+            updates["activation_checkpointing"] = True
+        for fname, value in _module_fields(m).items():
+            child_path = f"{path}/{fname}" if path else fname
+            new_value = _visit_value(value, child_path)
+            if new_value is not value:
+                updates[fname] = new_value
+        if updates:
+            m = type(m)(**{**_module_fields(m), **updates})
+        return m
+
+    def _visit_value(value, path):
+        if isinstance(value, nn.Module):
+            tp_cfg = _is_marked(value, path, mm)
+            if tp_cfg is not None and registry is not None and registry.is_supported(type(value)):
+                dist = registry.distribute(
+                    type(value), (), _module_fields(value), tp_config=tp_cfg
+                )
+                replaced.append(path)
+                return dist
+            return visit(value, path)
+        if isinstance(value, (list, tuple)):
+            new = [
+                _visit_value(v, f"{path}/{i}")
+                for i, v in enumerate(value)
+            ]
+            if any(a is not b for a, b in zip(new, value)):
+                return type(value)(new)
+            return value
+        if isinstance(value, dict):
+            new = {k: _visit_value(v, f"{path}/{k}") for k, v in value.items()}
+            if any(new[k] is not value[k] for k in value):
+                return new
+            return value
+        return value
+
+    root_cfg = _is_marked(module, prefix, mm) if mm is not None else None
+    if root_cfg is not None and registry is not None and registry.is_supported(type(module)):
+        dist = registry.distribute(
+            type(module), (), _module_fields(module), tp_config=root_cfg
+        )
+        replaced.append(prefix or "<root>")
+        return dist, replaced
+
+    new_module = visit(module, prefix)
+    if replaced:
+        logger.info("Distributed %d tp-marked module(s): %s", len(replaced), replaced)
+    return new_module, replaced
+
+
+# ----------------------------------------------------------------------
+# Built-in registrations (parity: reference torch/tp_registry.py:16-19 —
+# nn.Linear -> DistributedLinear, nn.Embedding -> DistributedEmbedding).
+# ----------------------------------------------------------------------
+
+
+def _dense_init_hook(*args, **fields):
+    from smdistributed_modelparallel_tpu.nn.linear import DistributedLinear
+
+    keep = {
+        "features": fields.get("features"),
+        "use_bias": fields.get("use_bias", True),
+    }
+    return (), keep
+
+
+def _embed_init_hook(*args, **fields):
+    keep = {
+        "num_embeddings": fields.get("num_embeddings"),
+        "features": fields.get("features"),
+    }
+    return (), keep
+
+
+def register_builtins(registry):
+    from smdistributed_modelparallel_tpu.nn.embedding import DistributedEmbedding
+    from smdistributed_modelparallel_tpu.nn.linear import DistributedLinear
+
+    if not registry.is_supported(nn.Dense):
+        registry.register(nn.Dense, DistributedLinear, init_hook=_dense_init_hook)
+    if not registry.is_supported(nn.Embed):
+        registry.register(nn.Embed, DistributedEmbedding, init_hook=_embed_init_hook)

@@ -1,0 +1,106 @@
+"""Shared helpers for HuggingFace config / state-dict translation.
+
+Parity target: reference ``torch/nn/huggingface/*`` (per-class init hooks +
+bidirectional state_dict translate functions, registered via
+``torch/nn/predefined_hooks.py:56-168``).
+
+TPU-native notes: our transformer stack is built with ``flax.linen.scan``,
+so per-layer HF tensors are STACKED into a leading [num_layers] axis; the
+flat key space is '/'-joined flax paths of
+``smp.nn.DistributedTransformerLMHead``.
+"""
+
+import numpy as np
+
+# Flat '/'-keyed paths of DistributedTransformerLMHead parameters.
+L = "transformer/seq_layers/layer"
+WTE = "word_embedding/embedding"
+WPE = "position_embedding/embedding"
+TTE = "token_type_embedding/embedding"
+EMB_LN = "embedding_layernorm"
+LN_F = "ln_f"
+LM_HEAD = "lm_head/kernel"
+
+ATTN_LN = f"{L}/attention/layernorm"
+ATTN_POST_LN = f"{L}/attention/post_layernorm"
+QKV_W = f"{L}/attention/qkv/kernel"
+QKV_B = f"{L}/attention/qkv/bias"
+ATTN_OUT_W = f"{L}/attention/dense/kernel"
+ATTN_OUT_B = f"{L}/attention/dense/bias"
+MLP_LN = f"{L}/output/layernorm"
+MLP_POST_LN = f"{L}/output/post_layernorm"
+FC_W = f"{L}/output/fc/kernel"
+FC_B = f"{L}/output/fc/bias"
+PROJ_W = f"{L}/output/proj/kernel"
+PROJ_B = f"{L}/output/proj/bias"
+
+
+def to_np(t):
+    """torch tensor / array -> numpy."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def stack_layers(per_layer):
+    """[{key: arr} per layer] -> {key: arr stacked on a new leading axis}."""
+    out = {}
+    for key in per_layer[0]:
+        out[key] = np.stack([d[key] for d in per_layer], axis=0)
+    return out
+
+
+def num_layers_in(sd, prefix, idx_pos):
+    """Highest layer index + 1 for keys like '{prefix}.{i}.'."""
+    last = -1
+    for key in sd:
+        if key.startswith(prefix):
+            try:
+                last = max(last, int(key.split(".")[idx_pos]))
+            except (ValueError, IndexError):
+                pass
+    return last + 1
+
+
+def fused_qkv_from_separate(qw, kw, vw, H, hd, transpose=False):
+    """Separate q/k/v [D, D] (or torch [out,in] with transpose=True) ->
+    our fused [D, 3, H, hd] kernel."""
+    mats = []
+    for w in (qw, kw, vw):
+        w = to_np(w)
+        if transpose:
+            w = w.T  # torch Linear stores [out, in]
+        D = w.shape[0]
+        mats.append(w.reshape(D, H, hd))
+    return np.stack(mats, axis=1)  # [D, 3, H, hd]
+
+
+def separate_qkv_from_fused(kernel, transpose=False):
+    """Our [D, 3, H, hd] -> three [D, D] (or [out, in] with transpose)."""
+    D = kernel.shape[0]
+    outs = []
+    for c in range(3):
+        w = kernel[:, c].reshape(D, -1)
+        outs.append(w.T if transpose else w)
+    return outs
+
+
+def attn_out_from_hf(w, H, hd, transpose=False):
+    """HF attention output proj [D_in, D_out] (Conv1D) or [out, in]
+    (Linear, transpose=True) -> our [H, hd, D]."""
+    w = to_np(w)
+    if transpose:
+        w = w.T
+    D_out = w.shape[1]
+    return w.reshape(H, hd, D_out)
+
+
+def linear_from_hf(w, transpose=False):
+    w = to_np(w)
+    return w.T if transpose else w
+
+
+def ln_from_hf(sd, hf_prefix, ours, out, layerwise=None):
+    """Map an HF LayerNorm (weight/bias) onto ours (scale/bias)."""
+    out[f"{ours}/scale"] = to_np(sd[f"{hf_prefix}.weight"])
+    out[f"{ours}/bias"] = to_np(sd[f"{hf_prefix}.bias"])

@@ -473,10 +473,15 @@ class _LayerScanBody(nn.Module):
 
     @nn.compact
     def __call__(self, carry, xs):
+        from smdistributed_modelparallel_tpu.parallel.memory import (
+            name_layer_activation,
+        )
+
         x, cross_states, attention_mask = carry
         out = DistributedTransformerLayer(**self.layer_kwargs, name="layer")(
             x, cross_states=cross_states, attention_mask=attention_mask, xs=xs
         )
+        out = name_layer_activation(out)
         return (out, cross_states, attention_mask), None
 
 
@@ -519,6 +524,7 @@ class DistributedTransformer(nn.Module):
     parallel_attn_output: bool = False
     causal_mask_size: Optional[int] = None
     attention_layers_type: Optional[tuple] = None
+    activation_checkpointing: bool = False
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -573,8 +579,16 @@ class DistributedTransformer(nn.Module):
         return {"layer_idx": idx, "is_local": is_local}
 
     def setup(self):
+        body = _LayerScanBody
+        if self.activation_checkpointing:
+            from smdistributed_modelparallel_tpu.parallel.memory import remat_policy
+
+            # Parity: reference set_activation_checkpointing on the layer
+            # container (torch/module_manager.py:969-1010) -> per-layer
+            # remat, optionally offloading the boundary activation.
+            body = nn.remat(body, policy=remat_policy())
         ScanLayers = nn.scan(
-            _LayerScanBody,
+            body,
             variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True},
             length=self.num_layers,
@@ -664,6 +678,8 @@ class DistributedTransformerLMHead(nn.Module):
     single_pre_layernorm: bool = False
     scale_attention_scores: bool = True
     scale_attn_by_layer_idx: bool = False
+    activation_checkpointing: bool = False
+    use_embedding_layernorm: bool = False  # BERT-family post-embedding LN
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -692,6 +708,10 @@ class DistributedTransformerLMHead(nn.Module):
                 self.num_token_types, self.hidden_size,
                 embedding_init=_init(self.initializer_range),
                 name="token_type_embedding",
+            )
+        if self.use_embedding_layernorm:
+            self.embedding_layernorm = DistributedLayerNorm(
+                epsilon=self.layernorm_epsilon, name="embedding_layernorm"
             )
         self.transformer = DistributedTransformer(
             **self._transformer_kwargs(), name="transformer"
@@ -740,6 +760,7 @@ class DistributedTransformerLMHead(nn.Module):
             parallel_attn_output=self.parallel_attn_output,
             causal_mask_size=self.causal_mask_size,
             attention_layers_type=self.attention_layers_type,
+            activation_checkpointing=self.activation_checkpointing,
             deterministic=self.deterministic,
             dtype=self.dtype,
         )
@@ -753,6 +774,8 @@ class DistributedTransformerLMHead(nn.Module):
             x = x + self.position_embedding(pos)
         if self.num_token_types > 0 and token_type_ids is not None:
             x = x + self.token_type_embedding(token_type_ids)
+        if self.use_embedding_layernorm:
+            x = self.embedding_layernorm(x)
         if self.embedding_dropout_prob > 0.0 and not resolve_deterministic(self.deterministic):
             x = nn.Dropout(self.embedding_dropout_prob, deterministic=False)(x)
         memory_opt = _cfg("optimize", "speed") == "memory"
@@ -786,7 +809,11 @@ class DistributedTransformerLMHead(nn.Module):
                 **{
                     k: v
                     for k, v in self._transformer_kwargs().items()
-                    if k not in ("num_layers", "attention_layers_type")
+                    if k not in (
+                        "num_layers",
+                        "attention_layers_type",
+                        "activation_checkpointing",
+                    )
                 }
             ),
             layer_xs=DistributedTransformer(

@@ -85,6 +85,32 @@ def attention_core(
     if extra_scale is not None:
         scale = scale * extra_scale
 
+    # Context parallelism (M6): sequence sharded over the cp mesh axis ->
+    # ring / Ulysses manual regions. Unsupported feature combinations fall
+    # through to the GSPMD path (allgather-KV semantics).
+    from smdistributed_modelparallel_tpu.ops.context_parallel import cp_size
+
+    if (
+        cp_size() > 1
+        and bias is None
+        and mask is None
+        and local_select is None
+        and (dropout_rate == 0.0 or dropout_rng is None)
+        and window is None
+        and qk_compensation is None
+        and not attention_in_fp32
+        and q.shape[1] == k.shape[1]
+        and q.shape[1] % cp_size() == 0
+    ):
+        from smdistributed_modelparallel_tpu.backend.state import state
+        from smdistributed_modelparallel_tpu.ops.context_parallel import (
+            cp_attention,
+        )
+
+        impl = state.cfg.context_parallel_impl
+        if impl in ("ring", "ulysses"):
+            return cp_attention(q, k, v, scale=scale, causal=causal, impl=impl)
+
     if (
         use_pallas
         and _pallas_ok(q, k, v)

@@ -1,0 +1,156 @@
+"""Context parallelism: ring attention and Ulysses sequence parallelism.
+
+New capability relative to the reference (SURVEY §5.7: absent there; its
+building blocks exist as the ``scatter_and_merge`` all-to-all —
+``torch/collectives.py:218-245``, exactly the Ulysses exchange — and the
+``shard_sequence`` helpers, ``torch/nn/utils.py:45-70``).
+
+TPU-native design: the sequence axis lives on the ``cp`` mesh axis.
+- **Ring attention**: inside a ``shard_map`` manual region over cp, each
+  device holds Q for its sequence block and rotates K/V blocks around the
+  ring with ``lax.ppermute`` (ICI neighbor traffic), merging per-block
+  partial attention with the online-softmax rule — full attention over the
+  global sequence without ever materializing it on one chip.
+- **Ulysses**: two ``lax.all_to_all``s re-shard [B, T/cp, H, hd] ->
+  [B, T, H/cp, hd] (heads scattered, sequence gathered), run plain local
+  attention, and shard back.
+- **allgather** (``context_parallel_impl: allgather``): no manual region;
+  GSPMD gathers K/V from the sharding constraints (the baseline).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.backend.topology import CP_AXIS
+from smdistributed_modelparallel_tpu.utils.exceptions import SMPValidationError
+
+NEG_INF = -1e30
+
+
+def cp_size():
+    if not state.initialized:
+        return 1
+    return state.mesh.shape.get(CP_AXIS, 1)
+
+
+def _block_scores(q, k, scale):
+    return jnp.einsum(
+        "bthd,bshd->bhts",
+        (q.astype(jnp.float32) * scale),
+        k.astype(jnp.float32),
+    )
+
+
+def ring_attention_local(q, k, v, *, scale, causal, n_blocks, axis_name=CP_AXIS):
+    """Per-shard ring attention body (runs inside shard_map).
+
+    q, k, v: [B, Tl, H, hd] — this device's sequence block.
+    Rotates K/V around the cp ring; merges blocks with online softmax.
+    """
+    B, Tl, H, hd = q.shape
+    me = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+
+    rows_local = jnp.arange(Tl)
+    cols_local = jnp.arange(Tl)
+
+    def body(i, carry):
+        acc, m, l, k_cur, v_cur = carry
+        src = (me - i) % n_blocks  # whose block we currently hold
+        s = _block_scores(q, k_cur, scale)  # [B, H, Tl, Tl]
+        if causal:
+            rows_g = me * Tl + rows_local[:, None]
+            cols_g = src * Tl + cols_local[None, :]
+            mask = cols_g <= rows_g
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # Guard fully-masked rows/blocks: keep m finite for the exp.
+        m_safe = jnp.maximum(m_new, -1e29)
+        p = jnp.exp(s - m_safe)
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        alpha = jnp.exp(jnp.maximum(m, -1e29) - m_safe) * (m > NEG_INF / 2)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhts,bshd->bthd", p, v_cur.astype(jnp.float32)
+        ).transpose(0, 2, 1, 3)
+        # Rotate K/V to the next device (ICI neighbor exchange).
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return acc_new, m_new, l_new, k_nxt, v_nxt
+
+    acc0 = jnp.zeros((B, H, Tl, hd), jnp.float32)
+    m0 = jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
+    acc, m, l, _, _ = jax.lax.fori_loop(
+        0, n_blocks, body, (acc0, m0, l0, k, v)
+    )
+    out = acc / jnp.maximum(l, 1e-30)  # [B, H, Tl, hd]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, *, scale, causal, n_blocks,
+                            axis_name=CP_AXIS):
+    """Per-shard Ulysses body: all_to_all heads<->sequence, local attention.
+
+    Parity note: the head/sequence exchange is the reference's
+    ``scatter_and_merge`` collective (``torch/collectives.py:218-245``).
+    """
+    H = q.shape[2]
+    if H % n_blocks != 0:
+        raise SMPValidationError(
+            f"Ulysses context parallelism needs heads ({H}) divisible by "
+            f"cp degree ({n_blocks})."
+        )
+
+    def exchange_fwd(x):  # [B, Tl, H, hd] -> [B, T, H/cp, hd]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    qg, kg, vg = exchange_fwd(q), exchange_fwd(k), exchange_fwd(v)
+    T = qg.shape[1]
+    s = _block_scores(qg, kg, scale)  # [B, H/cp, T, T]
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", p, vg.astype(jnp.float32))
+    out = out.astype(q.dtype)
+    # [B, T, H/cp, hd] -> [B, Tl, H, hd]
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def cp_attention(q, k, v, *, scale, causal, impl=None):
+    """Context-parallel attention over logically-full [B, T, H, hd] inputs
+    whose sequence axis is sharded over the cp mesh axis."""
+    n = cp_size()
+    mesh = state.mesh
+    impl = impl or state.cfg.context_parallel_impl
+    T = q.shape[1]
+    if T % n != 0:
+        raise SMPValidationError(
+            f"Sequence length {T} must be divisible by context_parallel_degree {n}."
+        )
+    body = {
+        "ring": ring_attention_local,
+        "ulysses": ulysses_attention_local,
+    }[impl]
+    fn = functools.partial(body, scale=scale, causal=causal, n_blocks=n)
+    spec = P(None, CP_AXIS, None, None)
+    shard_fn = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={CP_AXIS},
+        check_vma=False,
+    )
+    return shard_fn(q, k, v)

@@ -62,6 +62,12 @@ class DistributedOptimizer:
         shardings = opt_state_shardings(self._opt_state, self.model)
         if shardings is not None:
             self._opt_state = jax.device_put(self._opt_state, shardings)
+        if state.loaded_optimizer_state is not None:
+            # Deferred resume payload (parity: reference
+            # torch/optimizers/optimizer.py:545-547).
+            logger.info("Applying deferred checkpoint state to optimizer.")
+            self.load_state_dict(state.loaded_optimizer_state)
+            state.loaded_optimizer_state = None
 
         clip = self.grad_clip_norm
 
@@ -92,12 +98,26 @@ class DistributedOptimizer:
                 "model.backward(loss) before optimizer.step()."
             )
         self._ensure_state()
+        scaler = state.loss_scaler
+        finite = self.model._grads_finite
+        if finite is not None and not bool(finite):
+            # Overflow under fp16 loss scaling: skip the update, back the
+            # scale off (reference Bit16_Optimizer skip path; agreement
+            # across ranks is implicit — the flag is one SPMD value).
+            if scaler is not None:
+                scaler.update(True)
+            self.model._grads = None
+            self.model._grads_finite = None
+            return
         with jax.set_mesh(state.mesh):
             new_params, self._opt_state = self._update(
                 self.model.params, self._opt_state, grads
             )
         self.model.params = new_params
         self.model._grads = None
+        self.model._grads_finite = None
+        if scaler is not None:
+            scaler.update(False)
 
     def zero_grad(self):
         self.model._grads = None

@@ -15,14 +15,20 @@ logger = get_logger()
 
 def maybe_auto_partition(model):
     """Run after the first-step init/trace pass. With pp == 1 everything is
-    stage 0; with pp > 1 the partitioner assigns layers to stages (M2)."""
+    stage 0; with pp > 1 the partitioner assigns layers to stages (M2).
+    ZeRO param sharding (M4) registers last so it only claims dims the
+    pp/tp providers left free."""
     cfg = state.cfg
+    from smdistributed_modelparallel_tpu.parallel.zero import maybe_register_zero2d
+
     if cfg.pipeline_parallel_degree == 1:
+        maybe_register_zero2d(model)
         model.module_manager.set_partition_assignment({"": 0})
         model.post_partition({"": 0})
         return
     from smdistributed_modelparallel_tpu.parallel.pipeline import partition_for_pipeline
 
     assignment = partition_for_pipeline(model)
+    maybe_register_zero2d(model)
     model.module_manager.set_partition_assignment(assignment)
     model.post_partition(assignment)

@@ -107,6 +107,18 @@ def partition_for_pipeline(model):
             f"num_layers={spec.num_layers} must be divisible by "
             f"pipeline_parallel_degree={pp} for the stacked pipeline executor."
         )
+    # Honor activation-checkpoint configs inside the pipeline: the stacked
+    # executor applies layers directly (not via the module's own scan), so
+    # the remat lives on the executor's layer application.
+    if not spec.carry_remat:
+        mm = model.module_manager
+        if getattr(model.module, "activation_checkpointing", False):
+            spec.carry_remat = True
+        else:
+            for prefix in mm.checkpoint_configs:
+                if prefix == "" or spec.layer_path.startswith(prefix):
+                    spec.carry_remat = True
+                    break
     per_stage = spec.num_layers // pp
     assignment = {}
     for layer in range(spec.num_layers):
@@ -193,6 +205,10 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
             method=spec.head_method,
         )
 
+    from smdistributed_modelparallel_tpu.parallel.memory import (
+        name_layer_activation,
+    )
+
     def apply_one_layer(lp, carry, layer_xs, key):
         rngs = _mk_rngs(model, key, "layer")
         if spec.carry_is_tuple:
@@ -201,13 +217,17 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
                 {"params": lp}, x, cross_states=cross, attention_mask=amask,
                 xs=layer_xs, rngs=rngs,
             )
-            return (out, cross, amask)
+            return (name_layer_activation(out), cross, amask)
         if spec.layer_xs is not None:
-            return layer_module.apply({"params": lp}, carry, xs=layer_xs, rngs=rngs)
-        return layer_module.apply({"params": lp}, carry, rngs=rngs)
+            out = layer_module.apply({"params": lp}, carry, xs=layer_xs, rngs=rngs)
+        else:
+            out = layer_module.apply({"params": lp}, carry, rngs=rngs)
+        return name_layer_activation(out)
 
     if spec.carry_remat:
-        apply_one_layer = jax.checkpoint(apply_one_layer, static_argnums=())
+        from smdistributed_modelparallel_tpu.parallel.memory import remat_policy
+
+        apply_one_layer = jax.checkpoint(apply_one_layer, policy=remat_policy())
 
     def stage_body(stage_layer_params, stage_layer_xs, carry, key):
         """Apply this stage's per_stage layers sequentially (scan over the

@@ -3,11 +3,20 @@
 Parity target: reference ``shard_optimizer_state`` (contiguous buffer +
 virtual params, ``torch/model.py:1237-1340``,
 ``torch/optimizers/optimizer.py:355-391``) and "ZeRO-2D" sharded DP
-(DeepSpeed stage-3 fork, ``backend/zero_config.py``). On TPU both reduce to
-PartitionSpecs: optimizer-state leaves (and, for sharded DP, parameters)
-are sharded over the rdp axis on their largest divisible dimension; XLA
-emits the reduce-scatter / allgather traffic the reference implements by
-hand. Completed in M4; M1 ships the spec machinery with pp=tp=1 paths.
+(DeepSpeed stage-3 fork configured by ``backend/zero_config.py`` —
+``sharded_data_parallel_degree`` + the ``sdp_*`` knobs).
+
+TPU-native re-design: both are PartitionSpecs.
+- ZeRO-1: optimizer-state leaves mirror their parameter's pp/tp spec and
+  additionally shard a free dimension over rdp. The post-update parameter
+  allgather the reference runs by hand (``optimizer.py:379-389``) is
+  emitted by XLA from the spec mismatch between sharded state and
+  replicated params.
+- ZeRO-3 (zero2d): parameters themselves are sharded over rdp (above the
+  ``sdp_param_persistence_threshold``); XLA inserts the forward/backward
+  allgathers and gradient reduce-scatters that DeepSpeed stage 3 performs
+  with explicit collectives, and schedules them (the ``sdp_max_live_
+  parameters`` / hierarchical-allgather knobs become advisory).
 """
 
 import numpy as np
@@ -17,41 +26,120 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.backend.topology import RDP_AXIS
+from smdistributed_modelparallel_tpu.module_manager import path_key
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 
 logger = get_logger()
 
 
-def shard_spec_for_leaf(leaf, rdp_size, persistence_threshold=0):
-    """Spec sharding a tensor over rdp on its first divisible dim, or None."""
-    shape = getattr(leaf, "shape", ())
+def add_rdp_axis(spec, shape, rdp_size, persistence_threshold=0):
+    """Extend `spec` (list of axes per dim, or None) with rdp on the first
+    free dimension divisible by rdp_size. Returns a list or None."""
     if rdp_size <= 1 or not shape:
         return None
     if int(np.prod(shape)) < persistence_threshold:
         return None
+    base = list(spec) if spec is not None else [None] * len(shape)
+    base += [None] * (len(shape) - len(base))
     for i, dim in enumerate(shape):
-        if dim % rdp_size == 0:
-            spec = [None] * len(shape)
-            spec[i] = RDP_AXIS
-            return P(*spec)
+        if base[i] is None and dim % rdp_size == 0:
+            base[i] = RDP_AXIS
+            return base
     return None
 
 
-def opt_state_shardings(opt_state, model):
-    """Shardings for the optimizer-state pytree under shard_optimizer_state.
+def shard_spec_for_leaf(leaf, rdp_size, persistence_threshold=0):
+    """Spec sharding a tensor over rdp on its first divisible dim, or None."""
+    out = add_rdp_axis(None, getattr(leaf, "shape", ()), rdp_size,
+                       persistence_threshold)
+    return P(*out) if out is not None else None
 
-    Moment vectors mirror their parameter's sharding, additionally sharded
-    over rdp. Returns None when sharding is disabled (state replicated).
+
+def zero2d_param_provider(model):
+    """Spec provider sharding parameters over rdp (ZeRO-3 / FSDP).
+
+    Composes with pp/tp specs via the module manager's dimension-wise merge:
+    this provider only names rdp on dims the earlier providers left free.
     """
     cfg = state.cfg
-    if not (cfg.shard_optimizer_state or cfg.zero2d_enabled):
-        return None
     mesh = state.mesh
     rdp_size = mesh.shape[RDP_AXIS]
-    threshold = cfg.sdp_param_persistence_threshold if cfg.zero2d_enabled else 0
+    threshold = cfg.sdp_param_persistence_threshold
+    mm = model.module_manager
 
-    def leaf_sharding(leaf):
-        spec = shard_spec_for_leaf(leaf, rdp_size, threshold)
-        return NamedSharding(mesh, spec if spec is not None else P())
+    def provider(path, leaf):
+        # Merge-safe: compute the spec the earlier providers produce, then
+        # extend with rdp. Providers are consulted in registration order and
+        # this one is registered last, so recursion is bounded by ordering:
+        # we re-run only the providers registered before us.
+        prior = [None] * getattr(leaf, "ndim", 0)
+        for p in mm._spec_providers:
+            if getattr(p, "_smp_name", None) == "zero2d":
+                break
+            got = p(path, leaf)
+            if got is None:
+                continue
+            for i, axes in enumerate(got):
+                if axes is not None and i < len(prior):
+                    prior[i] = axes
+        out = add_rdp_axis(prior, getattr(leaf, "shape", ()), rdp_size, threshold)
+        return P(*out) if out is not None else None
 
-    return jax.tree_util.tree_map(leaf_sharding, opt_state)
+    return provider
+
+
+def maybe_register_zero2d(model):
+    if state.cfg is not None and state.cfg.zero2d_enabled:
+        model.module_manager.register_spec_provider(
+            zero2d_param_provider(model), name="zero2d"
+        )
+        logger.info(
+            "ZeRO sharded data parallelism: parameters >= %d elems sharded "
+            "over rdp=%d.",
+            state.cfg.sdp_param_persistence_threshold,
+            state.mesh.shape[RDP_AXIS],
+        )
+
+
+def opt_state_shardings(opt_state, model):
+    """Shardings for the optimizer-state pytree.
+
+    Moment-like leaves (same shape as a parameter, with the parameter's
+    path as a suffix of their pytree path) mirror the parameter's spec;
+    under ``shard_optimizer_state``/zero2d they are additionally sharded
+    over rdp. Returns None when state should stay replicated-as-params.
+    """
+    cfg = state.cfg
+    if cfg is None:
+        return None
+    zero1 = cfg.shard_optimizer_state
+    zero2d = cfg.zero2d_enabled
+    mesh = state.mesh
+    rdp_size = mesh.shape[RDP_AXIS]
+    threshold = cfg.sdp_param_persistence_threshold if zero2d else 0
+
+    # Param path -> (shape, spec) for suffix matching.
+    param_info = {}
+    if model is not None and model.params is not None:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(model.params)[0]:
+            key = path_key(path)
+            spec = model.module_manager.spec_for(key, leaf)
+            param_info[key] = (getattr(leaf, "shape", ()), list(spec))
+
+    def leaf_sharding(path, leaf):
+        key = path_key(path)
+        shape = getattr(leaf, "shape", ())
+        base = None
+        for pkey, (pshape, pspec) in param_info.items():
+            if key.endswith(pkey) and pshape == shape:
+                base = list(pspec)
+                break
+        if zero1 or zero2d:
+            extended = add_rdp_axis(base, shape, rdp_size, threshold)
+            if extended is not None:
+                return NamedSharding(mesh, P(*extended))
+        if base is not None and any(a is not None for a in base):
+            return NamedSharding(mesh, P(*base))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, opt_state)

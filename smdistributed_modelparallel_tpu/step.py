@@ -89,11 +89,24 @@ class StepFunction:
 
                 maybe_auto_partition(model)
 
-        grads, outputs = self._run_compiled(
-            model, stacked_args, stacked_kwargs
-        )
+        tl = state.timeline
+        if tl is not None and tl.enabled:
+            tl.start_step(state.step_count)
+            with tl.span(f"step_{state.step_count}"):
+                grads, outputs = self._run_compiled(
+                    model, stacked_args, stacked_kwargs
+                )
+                jax.block_until_ready(outputs)
+            tl.end_step(state.step_count)
+            tl.flush()
+        else:
+            grads, outputs = self._run_compiled(
+                model, stacked_args, stacked_kwargs
+            )
         if model is not None and grads is not None:
             model._grads = grads
+        if state.memory_metrics is not None:
+            state.memory_metrics.record_step(state.step_count)
         state.step_count += 1
         return StepOutput(outputs)
 
@@ -201,7 +214,16 @@ class StepFunction:
             for v in scan_vals
         ]
         rng = state.rng_manager.next_key("step")
-        return compiled(model.params, scan_vals, bcast_vals, rng)
+        loss_scale = jnp.asarray(
+            state.loss_scaler.loss_scale if state.loss_scaler else 1.0,
+            jnp.float32,
+        )
+        grads, outputs, grads_finite = compiled(
+            model.params, scan_vals, bcast_vals, rng, loss_scale
+        )
+        if model is not None:
+            model._grads_finite = grads_finite
+        return grads, outputs
 
     @staticmethod
     def _make_reconstruct(model, treedef, scan_idx, bcast_idx, static):
@@ -258,10 +280,18 @@ class StepFunction:
                 )
             return (loss if has_backward else jnp.zeros(())), out
 
-        def step_impl(params, scan_leaves, bcast_leaves, rng):
+        use_scaler = cfg.fp16
+
+        def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
             keys = jax.random.split(rng, num_mb)
             if has_backward:
-                grad_fn = jax.value_and_grad(mb_forward, has_aux=True)
+                def scaled_fwd(params, mb_leaves, bcast_leaves, key):
+                    loss, out = mb_forward(params, mb_leaves, bcast_leaves, key)
+                    # fp16: differentiate scale*loss so half grads stay
+                    # representable (reference LossScaler.backward).
+                    return loss * loss_scale, out
+
+                grad_fn = jax.value_and_grad(scaled_fwd, has_aux=True)
 
                 def body(acc, xs):
                     mb_leaves, key = xs
@@ -274,11 +304,14 @@ class StepFunction:
                 )
                 grads, outs = jax.lax.scan(body, acc0, (scan_leaves, keys))
                 # Microbatch averaging: parity with reference
-                # torch/allreduce/ddp.py:92-98 (grads divided by num_mb).
+                # torch/allreduce/ddp.py:92-98 (grads divided by num_mb);
+                # loss-scale undone in the same pass.
                 grads = jax.tree_util.tree_map(
-                    lambda g, p: (g / num_mb).astype(p.dtype), grads, params
+                    lambda g, p: (g / (num_mb * loss_scale)).astype(p.dtype),
+                    grads, params,
                 )
-                return grads, outs
+                finite = _grads_finite(grads) if use_scaler else None
+                return grads, outs, finite
 
             def body(carry, xs):
                 mb_leaves, key = xs
@@ -286,14 +319,14 @@ class StepFunction:
                 return carry, out
 
             _, outs = jax.lax.scan(body, 0, (scan_leaves, keys))
-            return None, outs
+            return None, outs, None
 
         jitted = jax.jit(step_impl, donate_argnums=())
         mesh = state.mesh
 
-        def run(params, scan_vals, bcast_vals, rng):
+        def run(params, scan_vals, bcast_vals, rng, loss_scale):
             with jax.set_mesh(mesh):
-                return jitted(params, scan_vals, bcast_vals, rng)
+                return jitted(params, scan_vals, bcast_vals, rng, loss_scale)
 
         return run
 
@@ -315,7 +348,9 @@ class StepFunction:
         out_aval = model._output_aval
         reconstruct = self._make_reconstruct(model, treedef, scan_idx, bcast_idx, static)
 
-        def step_impl(params, scan_leaves, bcast_leaves, rng):
+        use_scaler = cfg.fp16
+
+        def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
             keys = jax.random.split(rng, num_mb)
 
             def cap_body(_, xs):
@@ -370,23 +405,24 @@ class StepFunction:
                 _, (losses, user_outs) = jax.lax.scan(
                     post_body, 0, (scan_leaves, outs, keys)
                 )
-                return jnp.mean(losses), user_outs
+                return jnp.mean(losses) * loss_scale, user_outs
 
             if has_backward:
                 (_, outs), grads = jax.value_and_grad(forward_all, has_aux=True)(params)
                 grads = jax.tree_util.tree_map(
-                    lambda g, p: g.astype(p.dtype), grads, params
+                    lambda g, p: (g / loss_scale).astype(p.dtype), grads, params
                 )
-                return grads, outs
+                finite = _grads_finite(grads) if use_scaler else None
+                return grads, outs, finite
             _, outs = forward_all(params)
-            return None, outs
+            return None, outs, None
 
         jitted = jax.jit(step_impl, donate_argnums=())
         mesh = state.mesh
 
-        def run(params, scan_vals, bcast_vals, rng):
+        def run(params, scan_vals, bcast_vals, rng, loss_scale):
             with jax.set_mesh(mesh):
-                return jitted(params, scan_vals, bcast_vals, rng)
+                return jitted(params, scan_vals, bcast_vals, rng, loss_scale)
 
         return run
 
@@ -405,6 +441,16 @@ def _best_batch_sharding(mesh, cfg, arr):
         if arr.shape[dim] % size != 0:
             spec[dim] = None
     return NamedSharding(mesh, P(*spec))
+
+
+def _grads_finite(grads):
+    """Single bool: every grad element finite (the reference's overflow
+    allgather across pp+tp collapses to this reduction under SPMD)."""
+    leaves = [jnp.all(jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)]
+    out = leaves[0]
+    for l in leaves[1:]:
+        out = jnp.logical_and(out, l)
+    return out
 
 
 def _acc_dtype(dtype, cfg):

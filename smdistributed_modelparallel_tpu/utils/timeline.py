@@ -14,12 +14,9 @@ import os
 import threading
 import time
 
-_DEFAULT_PATH = os.environ.get("SMP_TIMELINE_PATH", "")
-
-
 class Timeline:
     def __init__(self, path=None):
-        self.path = path or _DEFAULT_PATH
+        self.path = path or os.environ.get("SMP_TIMELINE_PATH", "")
         self.enabled = bool(self.path)
         self._events = []
         self._lock = threading.Lock()

@@ -1,0 +1,247 @@
+"""M4 tests: activation checkpointing, ZeRO sharding, fp16 loss scaling,
+activation offloading.
+
+Mirrors the reference tiers: ``test/torch/mpi_hybrid/test_zero.py`` /
+``test_opt_sharding.py`` (sharded-vs-replicated loss parity),
+``test/torch/test_checkpointing*`` (remat correctness), fp16 scaler unit
+tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from smdistributed_modelparallel_tpu.nn.transformer import (
+    DistributedTransformerLMHead,
+)
+
+TINY = dict(
+    num_layers=4, num_attention_heads=4, attention_head_size=8,
+    hidden_size=32, intermediate_size=64, vocab_size=96, num_positions=32,
+    causal_mask_size=32, pre_layernorm=True, post_layernorm=False,
+    final_layernorm=True, attention_dropout_prob=0.0,
+    hidden_dropout_prob=0.0, embedding_dropout_prob=0.0,
+)
+
+
+def _train(cfg, steps=3, model_kwargs=None, lr=0.1):
+    smp.shutdown()
+    smp.init(cfg)
+    kwargs = dict(TINY)
+    kwargs.update(model_kwargs or {})
+    m = DistributedTransformerLMHead(**kwargs)
+    model = smp.DistributedModel(m)
+    opt = smp.DistributedOptimizer(optax.sgd(lr), model)
+
+    @smp.step
+    def train_step(model, ids):
+        logits = model(ids)
+        loss = jnp.mean(vocab_parallel_cross_entropy(logits[:, :-1], ids[:, 1:]))
+        model.backward(loss)
+        return loss
+
+    ids = jax.random.randint(jax.random.key(0), (8, 16), 0, 96)
+    losses = []
+    for _ in range(steps):
+        out = train_step(model, ids)
+        opt.step()
+        losses.append(float(out.reduce_mean()))
+    return losses, model, opt
+
+
+class TestActivationCheckpointing:
+    def test_loss_parity_with_remat(self):
+        base, _, _ = _train({"microbatches": 2})
+        ckpt, _, _ = _train(
+            {"microbatches": 2},
+            model_kwargs={"activation_checkpointing": True},
+        )
+        np.testing.assert_allclose(base, ckpt, atol=1e-5)
+
+    def test_set_activation_checkpointing_api(self):
+        smp.shutdown()
+        smp.init({"microbatches": 2})
+        smp.set_activation_checkpointing("transformer")
+        m = DistributedTransformerLMHead(**TINY)
+        model = smp.DistributedModel(m)
+        assert model.module.activation_checkpointing
+
+    def test_smp_checkpoint_function(self):
+        smp.shutdown()
+        smp.init({})
+
+        def f(x):
+            return jnp.sum(jnp.tanh(x) ** 2)
+
+        x = jax.random.normal(jax.random.key(0), (8,))
+        g1 = jax.grad(f)(x)
+        g2 = jax.grad(lambda x: smp.checkpoint(f)(x))(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-6)
+
+    def test_checkpoint_sequential(self):
+        smp.shutdown()
+        smp.init({})
+        fns = [jnp.tanh, jnp.sin, jnp.cos, jnp.tanh]
+        x = jax.random.normal(jax.random.key(0), (4,))
+        out = smp.checkpoint_sequential(fns, x, strategy="group_2")
+        ref = x
+        for f in fns:
+            ref = f(ref)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_pipeline_remat_parity(self):
+        base, _, _ = _train({"microbatches": 4})
+        pp, _, _ = _train(
+            {"microbatches": 4, "pipeline_parallel_degree": 2, "ddp": True},
+            model_kwargs={"activation_checkpointing": True},
+        )
+        np.testing.assert_allclose(base, pp, atol=1e-4)
+
+
+class TestOptimizerStateSharding:
+    def test_zero1_loss_parity(self):
+        base, _, _ = _train({"microbatches": 2, "ddp": True})
+        z1, model, opt = _train(
+            {"microbatches": 2, "ddp": True, "shard_optimizer_state": True}
+        )
+        np.testing.assert_allclose(base, z1, atol=1e-5)
+        # Adam-like state would shard; SGD has no moments. Re-check with adamw.
+
+    def test_zero1_moments_sharded(self):
+        smp.shutdown()
+        smp.init({"microbatches": 2, "ddp": True, "shard_optimizer_state": True})
+        m = DistributedTransformerLMHead(**TINY)
+        model = smp.DistributedModel(m)
+        opt = smp.DistributedOptimizer(optax.adamw(1e-3), model)
+
+        @smp.step
+        def train_step(model, ids):
+            logits = model(ids)
+            loss = jnp.mean(
+                vocab_parallel_cross_entropy(logits[:, :-1], ids[:, 1:])
+            )
+            model.backward(loss)
+            return loss
+
+        ids = jax.random.randint(jax.random.key(0), (8, 16), 0, 96)
+        train_step(model, ids)
+        opt.step()
+        # Find a moment leaf and check it is sharded over rdp.
+        from smdistributed_modelparallel_tpu.backend.topology import RDP_AXIS
+
+        found_sharded = False
+        for leaf in jax.tree_util.tree_leaves(opt.opt_state):
+            if isinstance(leaf, jax.Array) and leaf.ndim >= 1:
+                spec = getattr(leaf.sharding, "spec", None)
+                if spec and any(
+                    RDP_AXIS in (ax if isinstance(ax, tuple) else (ax,))
+                    for ax in spec if ax is not None
+                ):
+                    found_sharded = True
+                    break
+        assert found_sharded, "no optimizer-state leaf sharded over rdp"
+
+
+class TestShardedDataParallelism:
+    def test_zero2d_loss_parity(self):
+        base, _, _ = _train({"microbatches": 2, "ddp": True})
+        z2, model, _ = _train({
+            "microbatches": 2, "ddp": True,
+            "sharded_data_parallel_degree": 8,
+            "sdp_param_persistence_threshold": 100,
+        })
+        np.testing.assert_allclose(base, z2, atol=1e-5)
+
+    def test_zero2d_params_sharded(self):
+        smp.shutdown()
+        smp.init({
+            "microbatches": 2, "ddp": True,
+            "sharded_data_parallel_degree": 8,
+            "sdp_param_persistence_threshold": 100,
+        })
+        m = DistributedTransformerLMHead(**TINY)
+        model = smp.DistributedModel(m)
+
+        @smp.step
+        def fwd(model, ids):
+            logits = model(ids)
+            loss = jnp.mean(
+                vocab_parallel_cross_entropy(logits[:, :-1], ids[:, 1:])
+            )
+            model.backward(loss)
+            return loss
+
+        ids = jax.random.randint(jax.random.key(0), (8, 16), 0, 96)
+        fwd(model, ids)
+        from smdistributed_modelparallel_tpu.backend.topology import RDP_AXIS
+
+        sharded = 0
+        for leaf in jax.tree_util.tree_leaves(model.params):
+            spec = getattr(leaf.sharding, "spec", None)
+            if spec and any(
+                RDP_AXIS in (ax if isinstance(ax, tuple) else (ax,))
+                for ax in spec if ax is not None
+            ):
+                sharded += 1
+        assert sharded > 0, "no parameter sharded over rdp under zero2d"
+
+
+class TestFp16LossScaling:
+    def test_scaler_backoff_and_growth(self):
+        from smdistributed_modelparallel_tpu.fp16 import DynamicLossScaler
+
+        s = DynamicLossScaler(init_scale=1024.0, scale_window=2)
+        s.update(True)
+        assert s.loss_scale == 512.0
+        s.update(False)
+        s.update(False)
+        assert s.loss_scale == 1024.0
+
+    def test_fp16_training_runs_and_matches(self):
+        base, _, _ = _train({"microbatches": 2}, lr=0.01)
+        fp16, _, _ = _train({"microbatches": 2, "fp16": True}, lr=0.01)
+        # Half precision: loose tolerance, but the curves must track.
+        np.testing.assert_allclose(base, fp16, rtol=0.05)
+        assert state.loss_scaler is not None
+
+    def test_overflow_skips_step(self):
+        smp.shutdown()
+        smp.init({"microbatches": 1, "fp16": True})
+        m = DistributedTransformerLMHead(**TINY)
+        model = smp.DistributedModel(m)
+        opt = smp.DistributedOptimizer(optax.sgd(0.1), model)
+
+        @smp.step
+        def bad_step(model, ids):
+            logits = model(ids)
+            loss = jnp.sum(logits) * jnp.inf  # force overflow
+            model.backward(loss)
+            return loss
+
+        ids = jax.random.randint(jax.random.key(0), (2, 16), 0, 96)
+        bad_step(model, ids)
+        before = jax.device_get(jax.tree_util.tree_leaves(model.params)[0])
+        scale_before = state.loss_scaler.loss_scale
+        opt.step()
+        after = jax.device_get(jax.tree_util.tree_leaves(model.params)[0])
+        np.testing.assert_array_equal(before, after)  # update skipped
+        assert state.loss_scaler.loss_scale < scale_before  # backed off
+
+
+class TestActivationOffload:
+    def test_offload_config_runs(self):
+        # On backends without pinned_host this falls back to plain remat;
+        # either way the step must run and match the baseline.
+        base, _, _ = _train({"microbatches": 2})
+        off, _, _ = _train(
+            {"microbatches": 2, "offload_activations": True},
+            model_kwargs={"activation_checkpointing": True},
+        )
+        np.testing.assert_allclose(base, off, atol=1e-5)

@@ -1,0 +1,76 @@
+"""M5 observability tests: timeline + step memory metrics.
+
+Mirrors the reference's timeline/memory-metrics surfaces
+(``torch/step.py:69-115``, ``backend/core.py:524-562``).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.backend.state import state
+
+
+def _tiny_train(tmp_path, env):
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        smp.shutdown()
+        smp.init({"microbatches": 2})
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(8)(x)
+
+        model = smp.DistributedModel(Net())
+        opt = smp.DistributedOptimizer(optax.sgd(0.1), model)
+
+        @smp.step
+        def train(model, x, y):
+            out = model(x)
+            loss = jnp.mean((out - y) ** 2)
+            model.backward(loss)
+            return loss
+
+        x = jax.random.normal(jax.random.key(0), (4, 8))
+        y = jax.random.normal(jax.random.key(1), (4, 8))
+        train(model, x, y)
+        opt.step()
+        train(model, x, y)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+class TestTimeline:
+    def test_chrome_trace_written(self, tmp_path):
+        path = str(tmp_path / "timeline.json")
+        _tiny_train(tmp_path, {"SMP_TIMELINE_PATH": path})
+        assert os.path.exists(path)
+        payload = json.load(open(path))
+        names = [e["name"] for e in payload["traceEvents"]]
+        assert any(n.startswith("step_0") for n in names)
+        assert any(e.get("ph") == "X" for e in payload["traceEvents"])
+
+
+class TestMemoryMetrics:
+    def test_jsonl_written(self, tmp_path):
+        path = str(tmp_path / "mem.jsonl")
+        _tiny_train(tmp_path, {
+            "SMP_WRITE_STEP_MEMORY_METRICS": "1",
+            "SMP_STEP_MEMORY_METRICS_PATH": path,
+        })
+        assert os.path.exists(path)
+        lines = [json.loads(l) for l in open(path)]
+        assert len(lines) >= 2
+        assert lines[0]["step"] == 0
+        assert "devices" in lines[0]
