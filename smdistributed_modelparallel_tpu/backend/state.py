@@ -27,6 +27,7 @@ class ModelParallelState:
         self.step_count = 0
         self.loaded_model_state = None      # deferred checkpoint payloads
         self.loaded_optimizer_state = None
+        self.last_compile_report = None     # one_time_compile_report output
 
     @property
     def initialized(self):
@@ -87,6 +88,7 @@ class ModelParallelState:
         self.step_count = 0
         self.loaded_model_state = None
         self.loaded_optimizer_state = None
+        self.last_compile_report = None
 
 
 state = ModelParallelState()

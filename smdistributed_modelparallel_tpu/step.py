@@ -46,10 +46,21 @@ logger = get_logger()
 
 
 class _ModelRef:
-    """Static placeholder for a DistributedModel inside traced args."""
+    """Static placeholder for a DistributedModel inside traced args.
+
+    Value-hashable: instances are created fresh on every step call and feed
+    the compiled-function cache key, so identity hashing would defeat the
+    cache and silently retrace every step.
+    """
 
     def __init__(self, index):
         self.index = index
+
+    def __hash__(self):
+        return hash((_ModelRef, self.index))
+
+    def __eq__(self, other):
+        return isinstance(other, _ModelRef) and other.index == self.index
 
 
 class StepFunction:
@@ -199,6 +210,8 @@ class StepFunction:
 
         key = (treedef, tuple(scan_idx), tuple(bcast_idx),
                tuple((i, _static_key(v)) for i, v in sorted(static.items())),
+               tuple((v.shape, str(v.dtype)) for v in scan_vals),
+               tuple((v.shape, str(v.dtype)) for v in bcast_vals),
                getattr(self, "_has_backward", True),
                model.training if model is not None else None)
         compiled = self._cache.get(key)
@@ -321,14 +334,7 @@ class StepFunction:
             _, outs = jax.lax.scan(body, 0, (scan_leaves, keys))
             return None, outs, None
 
-        jitted = jax.jit(step_impl, donate_argnums=())
-        mesh = state.mesh
-
-        def run(params, scan_vals, bcast_vals, rng, loss_scale):
-            with jax.set_mesh(mesh):
-                return jitted(params, scan_vals, bcast_vals, rng, loss_scale)
-
-        return run
+        return _make_runner(step_impl, "step")
 
     def _build_pipeline(self, model, treedef, scan_idx, bcast_idx, static, num_mb):
         """pp > 1: one pipelined forward over all microbatches.
@@ -338,6 +344,11 @@ class StepFunction:
         dead code XLA eliminates), and once with the call *forced* to the
         pipeline's output for that microbatch to compute loss/outputs.
         Requires exactly one model(...) call per step function.
+
+        Schedule dispatch: ``pipeline: interleaved`` (the default) lowers to
+        the 1F1B executor with bounded in-flight microbatches
+        (``parallel/pipeline_1f1b.py``); ``simple`` / forward-only steps use
+        the fill-drain executor (``parallel/pipeline.py``).
         """
         from smdistributed_modelparallel_tpu.parallel.pipeline import pipeline_forward
 
@@ -349,10 +360,9 @@ class StepFunction:
         reconstruct = self._make_reconstruct(model, treedef, scan_idx, bcast_idx, static)
 
         use_scaler = cfg.fp16
+        use_1f1b = has_backward and cfg.pipeline == "interleaved"
 
-        def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
-            keys = jax.random.split(rng, num_mb)
-
+        def capture_inputs(scan_leaves, bcast_leaves, keys):
             def cap_body(_, xs):
                 mb_leaves, key = xs
                 model._begin_capture(out_aval)
@@ -370,6 +380,60 @@ class StepFunction:
                 return 0, captured[0]
 
             _, stacked_inputs = jax.lax.scan(cap_body, 0, (scan_leaves, keys))
+            return stacked_inputs
+
+        if use_1f1b:
+            from smdistributed_modelparallel_tpu.parallel.pipeline_1f1b import (
+                pipeline_1f1b,
+            )
+
+            def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
+                keys = jax.random.split(rng, num_mb)
+                stacked_inputs = capture_inputs(scan_leaves, bcast_leaves, keys)
+                run_p = params
+                if half is not None:
+                    run_p = jax.tree_util.tree_map(
+                        lambda x: x.astype(half)
+                        if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                        params,
+                    )
+
+                def mb_loss_fn(out, mb_index, key):
+                    mb_leaves = [
+                        jax.lax.dynamic_index_in_dim(l, mb_index, 0, keepdims=False)
+                        for l in scan_leaves
+                    ]
+                    rngs = {
+                        s: jax.random.fold_in(key, h)
+                        for h, s in enumerate(model.rng_streams)
+                    }
+                    model._begin_force(run_p, rngs, out)
+                    try:
+                        args, kwargs = reconstruct(mb_leaves, bcast_leaves)
+                        user_out = fn(*args, **kwargs)
+                    finally:
+                        loss = model._end_step_trace()
+                    if loss is None:
+                        raise StepUsageError(
+                            "model.backward(loss) was not called in the step function."
+                        )
+                    return loss, user_out
+
+                grads, losses, outs = pipeline_1f1b(
+                    model, params, stacked_inputs, rng, mb_loss_fn,
+                    loss_scale / num_mb,
+                )
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: (g / loss_scale).astype(p.dtype), grads, params
+                )
+                finite = _grads_finite(grads) if use_scaler else None
+                return grads, outs, finite
+
+            return _make_runner(step_impl, "step_pipeline_1f1b")
+
+        def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
+            keys = jax.random.split(rng, num_mb)
+            stacked_inputs = capture_inputs(scan_leaves, bcast_leaves, keys)
 
             def forward_all(p):
                 run_p = p
@@ -417,14 +481,57 @@ class StepFunction:
             _, outs = forward_all(params)
             return None, outs, None
 
-        jitted = jax.jit(step_impl, donate_argnums=())
-        mesh = state.mesh
+        return _make_runner(step_impl, "step_pipeline")
 
-        def run(params, scan_vals, bcast_vals, rng, loss_scale):
-            with jax.set_mesh(mesh):
-                return jitted(params, scan_vals, bcast_vals, rng, loss_scale)
 
-        return run
+def _make_runner(step_impl, name):
+    """Jit + AOT-compile a step_impl once, logging the one-time compile
+    report (FLOPs / bytes / peak memory — the reference's one-time Studio
+    metrics upload, ``torch/step.py:295-312``). Falls back to plain jit
+    dispatch if the AOT path is unavailable."""
+    from smdistributed_modelparallel_tpu.utils.metrics import (
+        one_time_compile_report,
+    )
+
+    jitted = jax.jit(step_impl, donate_argnums=())
+    mesh = state.mesh
+    holder = {}
+
+    def run(params, scan_vals, bcast_vals, rng, loss_scale):
+        with jax.set_mesh(mesh):
+            if "compiled" not in holder:
+                compiled = None
+                try:
+                    lowered = jitted.lower(
+                        params, scan_vals, bcast_vals, rng, loss_scale
+                    )
+                    compiled = lowered.compile()
+                    state.last_compile_report = one_time_compile_report(
+                        name, compiled
+                    )
+                except Exception as e:  # pragma: no cover - backend-specific
+                    logger.debug("AOT compile report unavailable: %s", e)
+                holder["compiled"] = compiled
+            c = holder["compiled"]
+            if c is not None:
+                try:
+                    return c(params, scan_vals, bcast_vals, rng, loss_scale)
+                except (TypeError, ValueError) as e:
+                    # Input aval/sharding mismatch only (the step cache keys
+                    # on shapes, so this is a layout drift, e.g. resharded
+                    # params after checkpoint load). Real runtime failures
+                    # (XlaRuntimeError etc.) propagate.
+                    logger.warning(
+                        "AOT step executable rejected inputs (%s); "
+                        "falling back to jit dispatch.", e,
+                    )
+                    holder["compiled"] = None
+            return jitted(params, scan_vals, bcast_vals, rng, loss_scale)
+
+    run.jitted = jitted
+    run.mesh = mesh
+    run.holder = holder
+    return run
 
 
 def _best_batch_sharding(mesh, cfg, arr):

@@ -63,17 +63,30 @@ def one_time_compile_report(step_name, lowered_or_compiled):
     Parity: the reference's one-time Studio metrics upload (comm volume,
     hops, per-device params — ``torch/step.py:295-312``).
     """
+    report = {"name": step_name}
     try:
         cost = lowered_or_compiled.cost_analysis()
         if isinstance(cost, list):
             cost = cost[0] if cost else {}
-        flops = cost.get("flops")
-        bytes_accessed = cost.get("bytes accessed")
-        logger.info(
-            "[metrics] %s: flops=%s bytes_accessed=%s",
-            step_name, flops, bytes_accessed,
-        )
-        return {"flops": flops, "bytes_accessed": bytes_accessed}
+        report["flops"] = cost.get("flops")
+        report["bytes_accessed"] = cost.get("bytes accessed")
     except Exception as e:  # pragma: no cover
         logger.debug("cost_analysis unavailable: %s", e)
-        return {}
+    try:
+        ma = lowered_or_compiled.memory_analysis()
+        if ma is not None:
+            for k in (
+                "temp_size_in_bytes",
+                "argument_size_in_bytes",
+                "output_size_in_bytes",
+                "generated_code_size_in_bytes",
+            ):
+                report[k] = getattr(ma, k, None)
+    except Exception as e:  # pragma: no cover
+        logger.debug("memory_analysis unavailable: %s", e)
+    logger.info(
+        "[metrics] %s: flops=%s bytes_accessed=%s temp_bytes=%s",
+        step_name, report.get("flops"), report.get("bytes_accessed"),
+        report.get("temp_size_in_bytes"),
+    )
+    return report
