@@ -1,0 +1,610 @@
+"""1F1B ("interleaved") pipeline schedule with bounded in-flight microbatches.
+
+Parity target: reference ``torch/pipeline.py:136-145``
+(``InterleavedPipeline.get_next_microbatch`` prioritizes ready-backwards over
+new forwards) and ``torch/server_queue.py:629-676`` (the
+``active_microbatches`` in-flight cap). The reference gets 1F1B behavior
+dynamically from its server event loop; here the schedule is computed
+statically in Python and baked into ONE ``lax.scan`` over ticks:
+
+- each tick has a forward sub-step and a backward sub-step; per stage the
+  static schedule says which microbatch (if any) to process in each;
+- stage inputs are stashed into a ring buffer of ``active_microbatches + 1``
+  slots; backward re-runs the stage forward from the stash under ``jax.vjp``
+  (activation recomputation, Megatron-style 1F1B-with-remat) — peak live
+  carries are O(S * active_microbatches) instead of the fill-drain
+  executor's O(num_microbatches * S) saved scan carries;
+- stage-to-stage transfers (forward activations and backward cotangents)
+  move through pp-sharded buffers via ``jnp.roll`` on the stage axis, which
+  GSPMD lowers to a collective-permute over ICI;
+- the last stage's backward composes head + user loss into the stage VJP, so
+  gradients of head/tied/replicated parameters fall out of the same pass;
+  embedding gradients are applied after the tick loop from the collected
+  stage-0 input cotangents.
+
+The executor returns (mean_loss-scaled grads, stacked user outputs, stacked
+losses); the step engine (``step.py``) divides out the loss scale exactly as
+in the fill-drain path so the two schedules are numerically interchangeable.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+def build_1f1b_schedule(num_stages, num_microbatches, window):
+    """Static lockstep 1F1B schedule.
+
+    Returns (fwd, bwd): int arrays [n_ticks, S]; entry = microbatch index the
+    stage processes in that tick's sub-step, or -1 for idle. Invariants: a
+    stage's forward of microbatch m runs only after stage s-1's forward of m
+    (strictly earlier tick); a stage's backward of m runs only after its own
+    forward of m (same tick allowed on the last stage — cotangent comes from
+    the loss, not a neighbor) and after stage s+1's backward of m; at most
+    ``window`` microbatches are in flight (forwarded, not yet backwarded)
+    per stage at any tick.
+    """
+    S, M, W = num_stages, num_microbatches, window
+    if W < 1:
+        raise PartitionError(f"active_microbatches must be >= 1, got {W}")
+    fwd_next = [0] * S
+    bwd_next = [0] * S
+    fwd_tick = {}
+    bwd_tick = {}
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    limit = 4 * (M + S) * max(1, (S + W - 1) // W) + 16
+    while any(b < M for b in bwd_next):
+        frow, brow = [-1] * S, [-1] * S
+        for s in range(S):
+            m = fwd_next[s]
+            if m < M and (fwd_next[s] - bwd_next[s]) < W:
+                if s == 0 or fwd_tick.get((s - 1, m), limit) < t:
+                    frow[s] = m
+        for s in range(S):
+            if frow[s] >= 0:
+                fwd_tick[(s, frow[s])] = t
+                fwd_next[s] += 1
+        for s in range(S):
+            m = bwd_next[s]
+            if m < M and fwd_tick.get((s, m), limit) <= t:
+                if s == S - 1 or bwd_tick.get((s + 1, m), limit) < t:
+                    brow[s] = m
+        for s in range(S):
+            if brow[s] >= 0:
+                bwd_tick[(s, brow[s])] = t
+                bwd_next[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+        if t > limit:
+            raise PartitionError(
+                f"1F1B schedule did not converge (S={S}, M={M}, W={W})"
+            )
+    return np.asarray(fwd_rows, np.int32), np.asarray(bwd_rows, np.int32)
+
+
+def _tree_zeros(avals_or_tree, like=None):
+    src = avals_or_tree if like is None else like
+    return jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), src)
+
+
+def _inexact_leaves(tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    idx = [i for i, l in enumerate(leaves)
+           if jnp.issubdtype(jnp.result_type(l), jnp.inexact)]
+    return leaves, treedef, idx
+
+
+def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
+                  loss_seed_scale):
+    """Run the full 1F1B forward+backward for all microbatches.
+
+    Args:
+      model: DistributedModel with ``_pipeline_spec`` installed.
+      params: master parameter tree (layer subtree leaves lead with [L]).
+      stacked_inputs: pytree with leading [num_microbatches] — captured
+        inputs of the user's single ``model(...)`` call.
+      rng: PRNG key (dropout etc.; folded per stage/microbatch so backward
+        recompute reproduces the forward exactly).
+      mb_loss_fn(out, mb_index, key) -> (loss, user_out): the user step
+        function re-run with the model call forced to ``out``.
+      loss_seed_scale: scalar multiplied into the backward seed (the step
+        engine passes loss_scale / num_microbatches so grads come out as
+        d(mean(losses) * loss_scale)).
+
+    Returns: (grads_tree, stacked_losses [M], stacked_user_outs [M, ...]).
+    """
+    spec = model._pipeline_spec
+    cfg = state.cfg
+    S = cfg.pipeline_parallel_degree
+    M = cfg.microbatches
+    L = spec.num_layers
+    W = min(cfg.active_microbatches or (S + 1), M)
+    W1 = W + 1
+    module = model.module
+    layer_module = spec.layer_module
+    half = cfg.half_dtype
+
+    fwd_np, bwd_np = build_1f1b_schedule(S, M, W)
+    n_ticks = fwd_np.shape[0]
+    fwd_sched = jnp.asarray(fwd_np)
+    bwd_sched = jnp.asarray(bwd_np)
+
+    from smdistributed_modelparallel_tpu.parallel.pipeline import (
+        _get_subtree,
+        _mk_rngs,
+        _scan_map,
+        stage_layout,
+        staged_layer_views,
+    )
+
+    def cast_half(tree):
+        if half is None:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(half)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            tree,
+        )
+
+    layer_params = _get_subtree(params, spec.layer_path)
+    staged_params, staged_xs, active_rows = staged_layer_views(
+        spec, layer_params, S
+    )
+    idx_np, active_np, maxp = stage_layout(spec, S)
+
+    mb_keys = jax.random.split(rng, M)
+
+    # ---- embed all microbatches (the input queue) --------------------
+
+    def embed_mb(mb_input, key):
+        args, kwargs = mb_input
+        if spec.embed_method is None:
+            return args[0]
+        return module.apply(
+            {"params": cast_half(params)}, *args,
+            rngs=_mk_rngs(model, key, "embed"),
+            method=spec.embed_method, **kwargs,
+        )
+
+    embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
+
+    if spec.carry_is_tuple:
+        hidden_q = embedded[0]
+        sides = embedded[1:]
+    else:
+        hidden_q = embedded
+        sides = None
+
+    carry_aval = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), hidden_q
+    )
+
+    # ---- per-stage forward (pure in stage params and carry) ----------
+
+    from smdistributed_modelparallel_tpu.parallel.memory import (
+        name_layer_activation,
+        remat_policy,
+    )
+
+    def apply_one_layer(lp, carry, layer_xs, key, side):
+        rngs = _mk_rngs(model, key, "layer")
+        if spec.carry_is_tuple:
+            cross, amask = side
+            out = layer_module.apply(
+                {"params": lp}, carry, cross_states=cross,
+                attention_mask=amask, xs=layer_xs, rngs=rngs,
+            )
+        elif spec.layer_xs is not None:
+            out = layer_module.apply({"params": lp}, carry, xs=layer_xs, rngs=rngs)
+        else:
+            out = layer_module.apply({"params": lp}, carry, rngs=rngs)
+        return name_layer_activation(out)
+
+    if spec.carry_remat:
+        apply_one_layer = jax.checkpoint(apply_one_layer, policy=remat_policy())
+
+    def stage_fwd(stage_lp, stage_lxs, x, side, s_idx, m_idx, act_row):
+        """Apply this stage's layer slots; keys derived from (stage, mb) so
+        the backward recompute reproduces dropout exactly. Padded slots pass
+        the carry through unchanged."""
+        base = jax.random.fold_in(jax.random.fold_in(rng, s_idx), m_idx)
+        stage_lp = cast_half(stage_lp)
+
+        def body(c, xs):
+            lp, lxs, i, act = xs
+            new_c = apply_one_layer(
+                lp, c, lxs, jax.random.fold_in(base, i), side
+            )
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(act, n, o), new_c, c
+            ), None
+
+        idx = jnp.arange(maxp)
+        out, _ = jax.lax.scan(body, x, (stage_lp, stage_lxs, idx, act_row))
+        return out
+
+    def gather_mb(tree, m):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+            tree,
+        )
+
+    def gather_side(m):
+        if sides is None:
+            return None
+        return tuple(gather_mb(s, m) for s in sides)
+
+    def gather_sides_rows(ms):
+        """Per-stage side tuples for a [S] vector of microbatch indices."""
+        if sides is None:
+            return None
+        return tuple(
+            jax.tree_util.tree_map(
+                lambda a: jax.vmap(
+                    lambda i: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+                )(ms),
+                s,
+            )
+            for s in sides
+        )
+
+    # ---- head + user loss (last stage only) --------------------------
+
+    def head_apply(p, carry, key):
+        if spec.head_method is None:
+            return carry
+        return module.apply(
+            {"params": cast_half(p)}, carry,
+            rngs=_mk_rngs(model, key, "head"), method=spec.head_method,
+        )
+
+    # Abstract shapes of (loss, user_out) for the collection buffers.
+    loss_out_aval = jax.eval_shape(
+        lambda c: mb_loss_fn(head_apply(params, c, mb_keys[0]), 0, mb_keys[0]),
+        jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), carry_aval),
+    )
+
+    # ---- buffers ------------------------------------------------------
+
+    def zeros_ring(n):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros((S, n) + a.shape, a.dtype), carry_aval
+        )
+
+    # Intermediate cotangent buffers (dembed/dsides) stay fp32; parameter
+    # gradient accumulators follow the same policy as the fill-drain path
+    # (step.py::_acc_dtype — fp32 under _fp32_grad_accumulation, else the
+    # parameter's own dtype, which for master weights is fp32 anyway).
+    grad_dtype = jnp.float32
+
+    def _acc_dtype(dtype):
+        if jnp.issubdtype(dtype, jnp.floating) and cfg._fp32_grad_accumulation:
+            return jnp.float32
+        return dtype
+
+    def param_grad_zeros(tree):
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, _acc_dtype(p.dtype)), tree
+        )
+
+    inbuf0 = zeros_ring(W1)      # inbuf[s, m % W1] = input for stage s's fwd of m
+    stash0 = zeros_ring(W1)      # stash[s, m % W1] = input consumed by fwd of m
+    cotbuf0 = zeros_ring(W1)     # cotbuf[s, m % W1] = cotangent for stage s's output of m
+    dlay0 = param_grad_zeros(staged_params)
+    drep0 = param_grad_zeros(params)          # head/tied/replicated contributions
+    dembed0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((M,) + a.shape, grad_dtype), carry_aval
+    )
+    side_leaves = side_treedef = side_idx = None
+    dsides0 = None
+    if sides is not None:
+        side_leaves, side_treedef, side_idx = _inexact_leaves(
+            tuple(jax.tree_util.tree_map(lambda a: a[0], s) for s in sides)
+        )
+        dsides0 = [
+            jnp.zeros((M,) + side_leaves[i].shape, grad_dtype) for i in side_idx
+        ]
+    losses0 = jnp.zeros((M,), jnp.float32)
+    outs0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((M,) + a.shape, a.dtype), loss_out_aval[1]
+    )
+
+    stage_ids = jnp.arange(S)
+
+    def set_ring(buf, row_slots, row_vals, row_active):
+        """buf[s, row_slots[s]] = row_vals[s] where row_active[s]."""
+
+        def upd(b, v):
+            def one(bs, slot, vs, act):
+                new = jax.lax.dynamic_update_index_in_dim(bs, vs.astype(bs.dtype), slot, 0)
+                return jnp.where(act, new, bs)
+
+            return jax.vmap(one)(b, row_slots, v, row_active)
+
+        return jax.tree_util.tree_map(upd, buf, row_vals)
+
+    def get_ring(buf, row_slots):
+        return jax.tree_util.tree_map(
+            lambda b: jax.vmap(
+                lambda bs, slot: jax.lax.dynamic_index_in_dim(bs, slot, 0, keepdims=False)
+            )(b, row_slots),
+            buf,
+        )
+
+    def scatter_add_mb(buf, m, val, active):
+        """buf[m] += val if active (single microbatch row)."""
+
+        def upd(b, v):
+            cur = jax.lax.dynamic_index_in_dim(b, m, 0, keepdims=False)
+            new = cur + jnp.where(active, v.astype(b.dtype), jnp.zeros_like(cur))
+            return jax.lax.dynamic_update_index_in_dim(b, new, m, 0)
+
+        return jax.tree_util.tree_map(upd, buf, val)
+
+    def scatter_set_mb(buf, m, val, active):
+        def upd(b, v):
+            cur = jax.lax.dynamic_index_in_dim(b, m, 0, keepdims=False)
+            new = jnp.where(active, v.astype(b.dtype), cur)
+            return jax.lax.dynamic_update_index_in_dim(b, new, m, 0)
+
+        return jax.tree_util.tree_map(upd, buf, val)
+
+    def tick(carry, t):
+        inbuf, stash, cotbuf, dlay, drep, dembed, dsides, losses, outs = carry
+
+        # ---------------- forward sub-step ----------------
+        fm = fwd_sched[t]                       # [S]; -1 idle
+        f_active = fm >= 0
+        fmc = jnp.maximum(fm, 0)
+        f_slots = fmc % W1
+        # Stage 0 reads from the embedded queue; others from inbuf.
+        from_q = gather_mb(hidden_q, fmc[0])
+        buf_in = get_ring(inbuf, f_slots)
+        x_in = jax.tree_util.tree_map(
+            lambda q, b: b.at[0].set(q), from_q, buf_in
+        )
+        f_sides = gather_sides_rows(fmc)
+        outs_f = jax.vmap(
+            stage_fwd,
+            in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0),
+        )(staged_params, staged_xs, x_in, f_sides, stage_ids, fmc, active_rows)
+        # Stash the consumed inputs for backward recompute.
+        stash = set_ring(stash, f_slots, x_in, f_active)
+        # Ship outputs forward one stage (collective-permute on pp): the
+        # value produced by stage s lands in inbuf[s+1] at slot m % W1.
+        shifted_vals = jax.tree_util.tree_map(
+            lambda o: jnp.roll(o, 1, axis=0), outs_f
+        )
+        shifted_slots = jnp.roll(f_slots, 1)
+        shifted_active = jnp.roll(f_active, 1).at[0].set(False)
+        inbuf = set_ring(inbuf, shifted_slots, shifted_vals, shifted_active)
+
+        # ---------------- backward sub-step ----------------
+        bm = bwd_sched[t]
+        b_active = bm >= 0
+        bmc = jnp.maximum(bm, 0)
+        b_slots = bmc % W1
+
+        # Last stage: compose stage fwd + head + loss into one VJP.
+        m_last = bmc[S - 1]
+        last_in = jax.tree_util.tree_map(
+            lambda st: jax.lax.dynamic_index_in_dim(
+                st[S - 1], b_slots[S - 1], 0, keepdims=False
+            ),
+            stash,
+        )
+        last_side = gather_side(m_last)
+        key_last = jax.lax.dynamic_index_in_dim(mb_keys, m_last, 0, keepdims=False)
+        last_lp = jax.tree_util.tree_map(lambda p: p[S - 1], staged_params)
+        last_lxs = jax.tree_util.tree_map(lambda p: p[S - 1], staged_xs)
+
+        def last_stage_loss(lp, x, side, p_rep):
+            out = stage_fwd(lp, last_lxs, x, side, S - 1, m_last, active_rows[S - 1])
+            final = head_apply(p_rep, out, key_last)
+            loss, user_out = mb_loss_fn(final, m_last, key_last)
+            return loss, user_out
+
+        loss_m, last_vjp, user_out = jax.vjp(
+            last_stage_loss, last_lp, last_in, last_side, params,
+            has_aux=True,
+        )
+        seed = jnp.asarray(loss_seed_scale, jnp.float32) * jnp.where(
+            b_active[S - 1], 1.0, 0.0
+        )
+        d_last_lp, d_last_in, d_last_side, d_rep = last_vjp(seed.astype(loss_m.dtype))
+
+        # Other stages: plain stage VJP with cotangents from cotbuf.
+        cot_in = get_ring(cotbuf, b_slots)
+        b_sides = gather_sides_rows(bmc)
+        stash_in = get_ring(stash, b_slots)
+
+        def stage_bwd(lp, lxs, x, side, cot, s_idx, m_idx, act_row):
+            def f(lp_, x_, side_):
+                return stage_fwd(lp_, lxs, x_, side_, s_idx, m_idx, act_row)
+
+            _, vjp = jax.vjp(f, lp, x, side)
+            return vjp(cot)
+
+        d_lp_rows, d_x_rows, d_side_rows = jax.vmap(
+            stage_bwd,
+            in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0, 0),
+        )(staged_params, staged_xs, stash_in,
+          b_sides, cot_in, stage_ids, bmc, active_rows)
+
+        # Merge the last stage's composed results over the vmapped rows.
+        def merge_last(rows, last_val):
+            return jax.tree_util.tree_map(
+                lambda r, lv: r.at[S - 1].set(lv.astype(r.dtype)), rows, last_val
+            )
+
+        d_lp_rows = merge_last(d_lp_rows, d_last_lp)
+        d_x_rows = merge_last(d_x_rows, d_last_in)
+        if sides is not None:
+            d_side_rows = merge_last(d_side_rows, d_last_side)
+
+        # Accumulate layer grads (mask idle rows).
+        mask_b = b_active
+
+        def acc_rows(acc, rows):
+            def add(a, r):
+                m = mask_b.reshape((S,) + (1,) * (r.ndim - 1))
+                return a + jnp.where(m, r.astype(a.dtype), 0)
+
+            return jax.tree_util.tree_map(add, acc, rows)
+
+        dlay = acc_rows(dlay, d_lp_rows)
+
+        # Replicated/head grads: only when the last stage was active.
+        drep = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(b_active[S - 1], g.astype(a.dtype), 0),
+            drep, d_rep,
+        )
+
+        # Route input cotangents: stage s's d_input goes to stage s-1's
+        # output cotangent (cotbuf[s-1]); stage 0's goes to the embedding.
+        shifted_cots = jax.tree_util.tree_map(
+            lambda o: jnp.roll(o, -1, axis=0), d_x_rows
+        )
+        cot_slots = jnp.roll(b_slots, -1)
+        cot_active = jnp.roll(b_active, -1).at[S - 1].set(False)
+        cotbuf = set_ring(cotbuf, cot_slots, shifted_cots, cot_active)
+        dembed = scatter_add_mb(
+            dembed, bmc[0],
+            jax.tree_util.tree_map(lambda r: r[0], d_x_rows),
+            b_active[0],
+        )
+
+        # Side cotangents: every active stage contributes to its microbatch.
+        if sides is not None and dsides is not None:
+            def one_stage_side_add(ds, s):
+                row_leaves, _, _ = _inexact_leaves(
+                    jax.tree_util.tree_map(lambda r: r[s], d_side_rows)
+                )
+                vals = [row_leaves[i] for i in side_idx]
+                return [
+                    _scatter_add_leaf(d, bmc[s], v, b_active[s])
+                    for d, v in zip(ds, vals)
+                ]
+
+            for s in range(S):
+                dsides = one_stage_side_add(dsides, s)
+
+        # Loss / user outputs at the last stage's backward tick.
+        losses = losses.at[m_last].set(
+            jnp.where(b_active[S - 1], loss_m.astype(jnp.float32), losses[m_last])
+        )
+        outs = scatter_set_mb(outs, m_last, user_out, b_active[S - 1])
+
+        return (inbuf, stash, cotbuf, dlay, drep, dembed, dsides, losses, outs), None
+
+    def _scatter_add_leaf(buf, m, val, active):
+        cur = jax.lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
+        new = cur + jnp.where(active, val.astype(buf.dtype), jnp.zeros_like(cur))
+        return jax.lax.dynamic_update_index_in_dim(buf, new, m, 0)
+
+    carry0 = (inbuf0, stash0, cotbuf0, dlay0, drep0, dembed0, dsides0,
+              losses0, outs0)
+    carry_end, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+    (_, _, _, dlay, drep, dembed, dsides, losses, outs) = carry_end
+
+    # ---- embedding backward ------------------------------------------
+
+    def embed_bwd(acc, xs):
+        mb_input, key, dcarry, dside_row = xs
+
+        def embed_mb_with(p):
+            args, kwargs = mb_input
+            return module.apply(
+                {"params": cast_half(p)}, *args,
+                rngs=_mk_rngs(model, key, "embed"),
+                method=spec.embed_method, **kwargs,
+            )
+
+        def embed_inexact(p):
+            out = embed_mb_with(p)
+            leaves, _, idx = _inexact_leaves(out)
+            return [leaves[i] for i in idx]
+
+        out_aval = jax.eval_shape(embed_inexact, params)
+        # Cotangent list: hidden cotangent (+ side cotangents for tuples).
+        if sides is not None:
+            cots = list(jax.tree_util.tree_leaves(dcarry)) + list(dside_row)
+        else:
+            cots = jax.tree_util.tree_leaves(dcarry)
+        cots = [c.astype(a.dtype) for c, a in zip(cots, out_aval)]
+        _, vjp = jax.vjp(embed_inexact, params)
+        (dp,) = vjp(cots)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), acc, dp
+        )
+        return acc, None
+
+    if spec.embed_method is not None:
+        demb_params0 = param_grad_zeros(params)
+        dside_stack = tuple(dsides) if dsides is not None else ()
+        demb_params, _ = jax.lax.scan(
+            embed_bwd, demb_params0,
+            (stacked_inputs, mb_keys, dembed, dside_stack),
+        )
+    else:
+        demb_params = None
+
+    # ---- assemble the full gradient tree -----------------------------
+
+    # [S, maxp, ...] accumulated stage grads -> [L, ...] (scatter-add for
+    # padded/uneven layouts; a pure reshape when the layout is dense).
+    if active_np.all() and L == S * maxp:
+        layer_grads = jax.tree_util.tree_map(
+            lambda g: g.reshape((L,) + g.shape[2:]), dlay
+        )
+    else:
+        flat_idx = jnp.asarray(idx_np.reshape(-1))
+        flat_mask = active_np.reshape(-1)
+
+        def to_layers(g):
+            gf = g.reshape((S * maxp,) + g.shape[2:])
+            gf = gf * flat_mask.reshape((-1,) + (1,) * (gf.ndim - 1))
+            return jnp.zeros((L,) + g.shape[2:], g.dtype).at[flat_idx].add(gf)
+
+        layer_grads = jax.tree_util.tree_map(to_layers, dlay)
+    grads = _set_subtree(drep, spec.layer_path, layer_grads)
+    if demb_params is not None:
+        # Embedding contributions exclude the layer subtree (zeros there).
+        demb_wo_layers = _set_subtree(
+            demb_params, spec.layer_path,
+            jax.tree_util.tree_map(jnp.zeros_like, layer_grads),
+        )
+        grads = jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(a.dtype), grads, demb_wo_layers
+        )
+    elif spec.embed_method is None:
+        # Module IS the layer stack: the model input's cotangent is dembed;
+        # no embed params. Nothing further to add.
+        pass
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(jnp.result_type(p)), grads, params
+    )
+    return grads, losses, outs
+
+
+def _set_subtree(tree, path, sub):
+    """Return a copy of `tree` with the node at '/'-path replaced by `sub`."""
+    parts = [p for p in path.strip("/").split("/") if p]
+
+    def rec(node, i):
+        if i == len(parts):
+            return sub
+        out = dict(node)
+        out[parts[i]] = rec(node[parts[i]], i + 1)
+        return out
+
+    return rec(tree, 0)
