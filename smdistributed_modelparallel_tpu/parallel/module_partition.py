@@ -138,6 +138,67 @@ def min_max_segments(costs, k):
     return [(a, b) for a, b in segments if b > a]
 
 
+def min_max_segments_pinned(costs, k, pins):
+    """Split `costs` into exactly k contiguous (possibly empty) segments
+    minimizing the max segment sum, subject to pins {item_index: segment}.
+
+    Used for manual ``smp.set_partition`` layer pins: the pinned layer must
+    land in its pinned stage while the rest of the boundary placement stays
+    cost-optimal. Returns k (start, end) half-open ranges covering [0, n).
+    """
+    n = len(costs)
+    for i, s in pins.items():
+        if not (0 <= s < k):
+            raise PartitionError(f"Pin {i}->{s} out of range [0, {k}).")
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + c)
+
+    def feasible(a, b, seg):
+        """Items [a, b) may live in segment `seg`: every pinned item inside
+        is pinned to `seg`, and no item pinned to `seg` lies outside later
+        handling (checked globally by the DP structure)."""
+        for i in range(a, b):
+            if i in pins and pins[i] != seg:
+                return False
+        return True
+
+    INF = float("inf")
+    # best[i][j]: minimized max-cost covering the first i items with the
+    # first j segments, all pins among them satisfied.
+    best = [[INF] * (k + 1) for _ in range(n + 1)]
+    cut = [[-1] * (k + 1) for _ in range(n + 1)]
+    best[0][0] = 0.0
+    for j in range(1, k + 1):
+        for i in range(0, n + 1):
+            for s in range(0, i + 1):
+                if best[s][j - 1] == INF:
+                    continue
+                if not feasible(s, i, j - 1):
+                    continue
+                # Items pinned to segment j-1 must not remain beyond i.
+                if any(pins.get(t) == j - 1 for t in range(i, n)):
+                    continue
+                seg_cost = prefix[i] - prefix[s]
+                cand = max(best[s][j - 1], seg_cost)
+                if cand < best[i][j]:
+                    best[i][j] = cand
+                    cut[i][j] = s
+    if best[n][k] == INF:
+        raise PartitionError(
+            f"No contiguous {k}-stage split satisfies pins {pins}: pins must "
+            "be non-decreasing in layer order."
+        )
+    segments = []
+    i, j = n, k
+    while j > 0:
+        s = cut[i][j]
+        segments.append((s, i))
+        i, j = s, j - 1
+    segments.reverse()
+    return segments
+
+
 class ModulePartitioner:
     """Assign pipeline stages to a module-cost tree.
 

@@ -75,6 +75,9 @@ class PipelineSpec:
     carry_remat: bool = False
     layer_xs: Any = None
     carry_is_tuple: bool = False
+    layer_costs: Optional[list] = None   # per-layer relative time costs
+    boundaries: Optional[list] = None    # [(start, end)] per stage (filled
+                                         # by partition_for_pipeline)
 
 
 def get_pipeline_spec(module):
@@ -87,10 +90,13 @@ def get_pipeline_spec(module):
 def partition_for_pipeline(model):
     """Produce the stage assignment for a pipelineable model.
 
-    Uniform contiguous ranges (layers L/S per stage) — the layout the stacked
-    executor requires. The generic cost-model partitioner
-    (``parallel/module_partition.py``) covers reference-parity assignment of
-    arbitrary module trees and is used for reporting/validation.
+    Stage boundaries come from the cost-model partitioner
+    (``parallel/module_partition.py`` — the reference's d'Hondt/min-max
+    engine, ``torch/module_partition.py:182-905``) over per-layer costs
+    (parameter bytes blended with time costs by ``memory_weight``).
+    Manual ``smp.set_partition("<layer_path>#<i>", stage)`` pins constrain
+    the boundaries. Non-uniform per-stage layer counts are supported — the
+    executors pad stages to the max count with masked slots.
     """
     cfg = state.cfg
     pp = cfg.pipeline_parallel_degree
@@ -102,10 +108,11 @@ def partition_for_pipeline(model):
             "smp model zoo do). Arbitrary module graphs cannot be pipelined "
             "under SPMD."
         )
-    if spec.num_layers % pp != 0:
+    L = spec.num_layers
+    if L < pp:
         raise PartitionError(
-            f"num_layers={spec.num_layers} must be divisible by "
-            f"pipeline_parallel_degree={pp} for the stacked pipeline executor."
+            f"num_layers={L} < pipeline_parallel_degree={pp}: at least one "
+            "layer per stage is required."
         )
     # Honor activation-checkpoint configs inside the pipeline: the stacked
     # executor applies layers directly (not via the module's own scan), so
@@ -119,32 +126,155 @@ def partition_for_pipeline(model):
                 if prefix == "" or spec.layer_path.startswith(prefix):
                     spec.carry_remat = True
                     break
-    per_stage = spec.num_layers // pp
+
+    spec.boundaries = _choose_boundaries(model, spec, pp)
     assignment = {}
-    for layer in range(spec.num_layers):
-        assignment[f"{spec.layer_path}#{layer}"] = layer // per_stage
+    for s, (a, b) in enumerate(spec.boundaries):
+        for layer in range(a, b):
+            assignment[f"{spec.layer_path}#{layer}"] = s
     model._pipeline_spec = spec
     model.module_manager.register_spec_provider(
         layer_param_sharding_provider(spec), name="pipeline_layers"
     )
     logger.info(
-        "Pipeline partition: %d layers -> %d stages (%d layers/stage).",
-        spec.num_layers, pp, per_stage,
+        "Pipeline partition: %d layers -> %d stages %s.",
+        L, pp, [b - a for a, b in spec.boundaries],
     )
     return assignment
 
 
+def _layer_cost_inputs(model, spec):
+    """(param_bytes_per_layer, time_cost_per_layer) for the cost model.
+
+    Parameter bytes come from the materialized stacked layer subtree
+    (``jax.eval_shape``-equivalent — shapes are concrete by partition time);
+    time costs from ``spec.layer_costs`` when the model declares them
+    (heterogeneous stacks, e.g. windowed/alternating attention), else
+    uniform — the reference's timed trace costs
+    (``torch/module_manager.py:435-499``) are declared rather than measured
+    because one compiled SPMD program has no per-module eager timings.
+    """
+    L = spec.num_layers
+    params = model._params
+    pbytes = 0.0
+    if params is not None:
+        try:
+            sub = _get_subtree(params, spec.layer_path)
+            pbytes = sum(
+                leaf.nbytes for leaf in jax.tree_util.tree_leaves(sub)
+            ) / max(L, 1)
+        except (KeyError, TypeError):
+            pbytes = 0.0
+    times = list(spec.layer_costs) if spec.layer_costs else [1.0] * L
+    if len(times) != L:
+        raise PartitionError(
+            f"pipeline_spec.layer_costs has {len(times)} entries for "
+            f"{L} layers."
+        )
+    return [pbytes] * L, times
+
+
+def _choose_boundaries(model, spec, pp):
+    """Contiguous per-stage layer ranges from costs + manual pins."""
+    from smdistributed_modelparallel_tpu.parallel.module_partition import (
+        ModuleNode,
+        ModulePartitioner,
+        min_max_segments_pinned,
+    )
+
+    cfg = state.cfg
+    L = spec.num_layers
+    pbytes, times = _layer_cost_inputs(model, spec)
+
+    pins = {}
+    for prefix, stage in model.module_manager.get_manual_partitions().items():
+        if prefix.startswith(spec.layer_path + "#"):
+            try:
+                pins[int(prefix.rsplit("#", 1)[1])] = stage
+            except ValueError:
+                raise PartitionError(
+                    f"Malformed layer pin '{prefix}': expected "
+                    f"'{spec.layer_path}#<layer_index>'."
+                )
+    for idx, stage in pins.items():
+        if not (0 <= idx < L):
+            raise PartitionError(f"Pinned layer {idx} out of range [0, {L}).")
+
+    mw = cfg.memory_weight
+    total_m = sum(pbytes) or 1.0
+    total_t = sum(times) or 1.0
+    blended = [
+        mw * (m / total_m) + (1.0 - mw) * (t / total_t)
+        for m, t in zip(pbytes, times)
+    ]
+    if pins:
+        return min_max_segments_pinned(blended, pp, pins)
+    # No pins: run the reference-parity tree partitioner (min-max DP
+    # segmentation + d'Hondt stage allocation) over the layer sequence.
+    root = ModuleNode(name=spec.layer_path)
+    root.children = [
+        ModuleNode(name=f"{spec.layer_path}#{i}", param_bytes=pbytes[i],
+                   time=times[i])
+        for i in range(L)
+    ]
+    assignment = ModulePartitioner(
+        root, pp, memory_weight=mw
+    ).partition()
+    stages = [assignment[f"{spec.layer_path}#{i}"] for i in range(L)]
+    if any(b < a for a, b in zip(stages, stages[1:])):
+        raise PartitionError(
+            f"Partitioner produced a non-contiguous stage order {stages}; "
+            "the SPMD executor requires contiguous stages."
+        )
+    bounds = []
+    start = 0
+    for s in range(pp):
+        end = start
+        while end < L and stages[end] == s:
+            end += 1
+        bounds.append((start, end))
+        start = end
+    if start != L:
+        raise PartitionError(
+            f"Partitioner left layers unassigned (stages={stages})."
+        )
+    return bounds
+
+
+def stage_layout(spec, num_stages):
+    """(layer_index_grid [S, maxp], active_mask [S, maxp], maxp) for the
+    executors. Uniform boundaries collapse to the dense reshape layout."""
+    import numpy as np
+
+    bounds = spec.boundaries
+    L = spec.num_layers
+    if bounds is None:
+        per = L // num_stages
+        bounds = [(s * per, (s + 1) * per) for s in range(num_stages)]
+    maxp = max(b - a for a, b in bounds)
+    idx = np.zeros((num_stages, maxp), np.int32)
+    active = np.zeros((num_stages, maxp), bool)
+    for s, (a, b) in enumerate(bounds):
+        n = b - a
+        idx[s, :n] = np.arange(a, b)
+        active[s, :n] = True
+    return idx, active, maxp
+
+
 def layer_param_sharding_provider(spec):
     """Spec provider: stacked layer params get their leading (layer) axis
-    sharded over pp; everything else replicated across pp."""
+    sharded over pp; everything else replicated across pp. When the layer
+    count does not divide pp (uneven/padded boundaries) the stack stays
+    replicated — the executor's per-stage gather distributes the compute."""
     from jax.sharding import PartitionSpec as P
 
     prefix = spec.layer_path.strip("/")
+    pp = state.cfg.pipeline_parallel_degree if state.cfg else 1
 
     def provider(path, leaf):
         if path == prefix or path.startswith(prefix + "/"):
             ndim = getattr(leaf, "ndim", 0)
-            if ndim >= 1:
+            if ndim >= 1 and leaf.shape[0] % pp == 0:
                 return P(PP_AXIS, *([None] * (ndim - 1)))
         return None
 
@@ -174,7 +304,6 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     S = cfg.pipeline_parallel_degree
     num_mb = cfg.microbatches
     L = spec.num_layers
-    per_stage = L // S
     module = model.module
     layer_module = spec.layer_module
 
@@ -229,16 +358,21 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
 
         apply_one_layer = jax.checkpoint(apply_one_layer, policy=remat_policy())
 
-    def stage_body(stage_layer_params, stage_layer_xs, carry, key):
-        """Apply this stage's per_stage layers sequentially (scan over the
-        local layer axis)."""
+    def stage_body(stage_layer_params, stage_layer_xs, carry, key, active_row):
+        """Apply this stage's layer slots sequentially (scan over the local
+        layer axis); padded slots pass the carry through unchanged."""
 
         def body(c, xs):
-            lp, lxs, i = xs
-            return apply_one_layer(lp, c, lxs, jax.random.fold_in(key, i)), None
+            lp, lxs, i, act = xs
+            new_c = apply_one_layer(lp, c, lxs, jax.random.fold_in(key, i))
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(act, n, o), new_c, c
+            ), None
 
-        idx = jnp.arange(per_stage)
-        out, _ = jax.lax.scan(body, carry, (stage_layer_params, stage_layer_xs, idx))
+        idx = jnp.arange(active_row.shape[0])
+        out, _ = jax.lax.scan(
+            body, carry, (stage_layer_params, stage_layer_xs, idx, active_row)
+        )
         return out
 
     mb_keys = jax.random.split(rngs_key, num_mb)
@@ -246,13 +380,10 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     # Embed all microbatches upfront (the pipeline's input queue).
     embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
 
-    # [L, ...] -> [S, per_stage, ...]; dim 0 stays sharded on pp.
-    staged_params = jax.tree_util.tree_map(
-        lambda x: x.reshape((S, per_stage) + x.shape[1:]), layer_params
-    )
-    staged_xs = jax.tree_util.tree_map(
-        lambda x: jnp.asarray(x).reshape((S, per_stage) + jnp.asarray(x).shape[1:]),
-        spec.layer_xs,
+    # [L, ...] -> [S, maxp, ...]; dim 0 stays sharded on pp. Uniform
+    # boundaries collapse to a reshape; uneven ones gather padded slots.
+    staged_params, staged_xs, active_rows = staged_layer_views(
+        spec, layer_params, S
     )
 
     n_ticks = num_mb + S - 1
@@ -272,7 +403,7 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
         lambda x: jnp.zeros((S,) + x.shape, x.dtype), carry_shape
     )
 
-    vmapped_stages = jax.vmap(stage_body, in_axes=(0, 0, 0, 0))
+    vmapped_stages = jax.vmap(stage_body, in_axes=(0, 0, 0, 0, 0))
     stage_keys = jax.random.split(rngs_key, S)
     stage_ids = jnp.arange(S)
 
@@ -305,7 +436,9 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
             carry_in = feed
         # Distinct dropout keys per (stage, tick).
         tick_keys = jax.vmap(lambda k: jax.random.fold_in(k, t))(stage_keys)
-        outs = vmapped_stages(staged_params, staged_xs, carry_in, tick_keys)
+        outs = vmapped_stages(
+            staged_params, staged_xs, carry_in, tick_keys, active_rows
+        )
         x_outs = outs[0] if sides is not None else outs
         # Collect last stage's output (microbatch t - (S-1) when valid).
         tail = jax.tree_util.tree_map(lambda o: o[S - 1], x_outs)
@@ -342,6 +475,39 @@ def _mk_rngs(model, key, tag):
         s: jax.random.fold_in(key, zlib.crc32(f"{tag}/{s}".encode()))
         for s in model.rng_streams
     }
+
+
+def staged_layer_views(spec, layer_params, num_stages):
+    """Stage the [L, ...] layer stack as ([S, maxp, ...] params,
+    [S, maxp, ...] xs, [S, maxp] active mask).
+
+    Uniform boundaries are a plain reshape (dim 0 stays pp-sharded, no data
+    movement); uneven boundaries gather into padded slots — the gather
+    crosses the even [L] storage sharding, so uneven splits trade one
+    layer-param reshard per step for balanced stage compute.
+    """
+    L = spec.num_layers
+    idx, active, maxp = stage_layout(spec, num_stages)
+    uniform = active.all() and L == num_stages * maxp
+    if uniform:
+        staged_params = jax.tree_util.tree_map(
+            lambda x: x.reshape((num_stages, maxp) + x.shape[1:]), layer_params
+        )
+        staged_xs = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x).reshape(
+                (num_stages, maxp) + jnp.asarray(x).shape[1:]
+            ),
+            spec.layer_xs,
+        )
+    else:
+        gidx = jnp.asarray(idx)
+        staged_params = jax.tree_util.tree_map(
+            lambda x: x[gidx], layer_params
+        )
+        staged_xs = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x)[gidx], spec.layer_xs
+        )
+    return staged_params, staged_xs, jnp.asarray(active)
 
 
 def _get_subtree(params, path):

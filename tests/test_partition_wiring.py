@@ -1,0 +1,140 @@
+"""Cost-model partitioner wired into execution.
+
+Parity targets: reference auto-partitioner driving actual module placement
+(``torch/module_partition.py:182-905``, ``torch/server.py:254-268``) and
+manual ``smp.set_partition`` pins (``torch/module_manager.py:1061``).
+Covers: uneven layer costs produce non-uniform executed boundaries, pins
+change the executed assignment, infeasible pins raise, and the pinned/padded
+executions keep loss parity with the unpartitioned baseline.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.models.transformer_lm import TransformerLM
+from smdistributed_modelparallel_tpu.parallel.module_partition import (
+    min_max_segments_pinned,
+)
+from smdistributed_modelparallel_tpu.parallel.pipeline import PipelineSpec
+from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
+from tests.models import softmax_xent
+
+
+import flax.linen as nn
+
+
+class UnevenLM(TransformerLM):
+    """TransformerLM declaring uneven per-layer time costs (e.g. a stack
+    whose first layer is far more expensive, like an embedding-heavy or
+    wide-attention stage in the reference's traced costs)."""
+
+    @nn.nowrap
+    def pipeline_spec(self):
+        spec = super().pipeline_spec()
+        return PipelineSpec(
+            layer_path=spec.layer_path,
+            num_layers=spec.num_layers,
+            layer_module=spec.layer_module,
+            layer_costs=[5.0] + [1.0] * (self.n_layers - 1),
+        )
+
+
+def _fit(module_fn, cfg, pins=None, steps=2):
+    smp.reset()
+    smp.init(cfg)
+    module = module_fn()
+    model = smp.DistributedModel(module)
+    if pins:
+        for prefix, stage in pins.items():
+            smp.set_partition(prefix, stage)
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    ids = jax.random.randint(jax.random.key(0), (8, 12), 0, 32)
+
+    @smp.step
+    def train_step(model, batch):
+        logits = model(batch)
+        loss = jnp.mean(softmax_xent(logits[:, :-1], batch[:, 1:]))
+        model.backward(loss)
+        return loss
+
+    losses = []
+    for _ in range(steps):
+        out = train_step(model, ids)
+        losses.append(float(out.reduce_mean()))
+        optimizer.step()
+    return losses, model
+
+
+def _mk(n_layers=4, cls=TransformerLM):
+    def fn():
+        return cls(
+            vocab_size=32, max_len=12, d_model=16, n_layers=n_layers, n_heads=2,
+        )
+
+    return fn
+
+
+class TestCostDrivenBoundaries:
+    def test_uneven_costs_give_non_uniform_boundary(self):
+        _, model = _fit(_mk(4, UnevenLM), {
+            "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+            "memory_weight": 0.0,  # pure time costs
+        }, steps=1)
+        # costs [5,1,1,1] over 2 stages -> [0,1) and [1,4), not [0,2)/[2,4).
+        assert model._pipeline_spec.boundaries == [(0, 1), (1, 4)]
+
+    def test_uneven_boundary_keeps_parity(self):
+        base, _ = _fit(_mk(4, UnevenLM), {"microbatches": 4})
+        pp, _ = _fit(_mk(4, UnevenLM), {
+            "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+            "memory_weight": 0.0,
+        })
+        np.testing.assert_allclose(pp, base, rtol=1e-4, atol=1e-5)
+
+    def test_uniform_costs_stay_uniform(self):
+        _, model = _fit(_mk(4), {
+            "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+        }, steps=1)
+        assert model._pipeline_spec.boundaries == [(0, 2), (2, 4)]
+
+
+class TestManualPins:
+    def test_pin_moves_boundary(self):
+        _, model = _fit(_mk(4), {
+            "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+        }, steps=1, pins={"layers/block#2": 0})
+        # layer 2 pinned to stage 0 forces [0,3)/[3,4).
+        assert model._pipeline_spec.boundaries == [(0, 3), (3, 4)]
+
+    def test_pinned_execution_keeps_parity(self):
+        base, _ = _fit(_mk(4), {"microbatches": 4})
+        pinned, _ = _fit(_mk(4), {
+            "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+        }, pins={"layers/block#2": 0})
+        np.testing.assert_allclose(pinned, base, rtol=1e-4, atol=1e-5)
+
+    def test_infeasible_pins_raise(self):
+        with pytest.raises(PartitionError):
+            _fit(_mk(4), {
+                "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+            }, steps=1, pins={"layers/block#0": 1, "layers/block#3": 0})
+
+
+class TestPinnedSegmentsDP:
+    def test_exact_segments_with_pins(self):
+        segs = min_max_segments_pinned([1, 1, 1, 1], 2, {2: 0})
+        assert segs == [(0, 3), (3, 4)]
+
+    def test_no_pins_matches_even(self):
+        segs = min_max_segments_pinned([1, 1, 1, 1], 2, {})
+        assert segs == [(0, 2), (2, 4)]
+
+    def test_empty_segment_allowed_when_pinned(self):
+        segs = min_max_segments_pinned([1, 1], 3, {0: 0, 1: 2})
+        assert len(segs) == 3
+        assert segs[0] == (0, 1) and segs[2] == (1, 2)
