@@ -20,10 +20,7 @@ Model-zoo configs for GPT-2 sizes are in ``models/gpt2.py``.
 from dataclasses import field
 from typing import Optional
 
-import numpy as np
-
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from smdistributed_modelparallel_tpu.parallel.pipeline import PipelineSpec
@@ -65,22 +62,20 @@ class CausalSelfAttention(nn.Module):
 
             rd = self.rotary_dim or hd
             q, k = apply_rotary(q, k, rd, neox_style=True)
-        scale = 1.0 / np.sqrt(hd)
-        if self.attention_in_fp32:
-            q, k = q.astype(jnp.float32), k.astype(jnp.float32)
-        scores = jnp.einsum("bthd,bshd->bhts", q, k) * scale
-        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
-        if self.window is not None:
-            mask = mask & (
-                jnp.arange(T)[:, None] - jnp.arange(T)[None, :] < self.window
-            )
-        scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(scores.dtype).min)
-        if attn_bias is not None:
-            scores = scores + attn_bias
-        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        from smdistributed_modelparallel_tpu.ops.attention import attention_core
+
+        drop_rng = None
         if self.dropout > 0.0 and not self.deterministic:
-            probs = nn.Dropout(self.dropout, deterministic=False)(probs)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T, D)
+            drop_rng = self.make_rng("dropout")
+        out = attention_core(
+            q, k, v,
+            causal=True,
+            window=self.window,
+            bias=attn_bias,
+            attention_in_fp32=self.attention_in_fp32,
+            dropout_rate=self.dropout if not self.deterministic else 0.0,
+            dropout_rng=drop_rng,
+        ).reshape(B, T, D)
         return nn.Dense(D, name="proj")(out)
 
 
