@@ -59,9 +59,9 @@ class DistributedOptimizer:
         from smdistributed_modelparallel_tpu.parallel.zero import opt_state_shardings
 
         self._opt_state = jax.jit(self.tx.init)(self.model.params)
-        shardings = opt_state_shardings(self._opt_state, self.model)
-        if shardings is not None:
-            self._opt_state = jax.device_put(self._opt_state, shardings)
+        opt_shardings = opt_state_shardings(self._opt_state, self.model)
+        if opt_shardings is not None:
+            self._opt_state = jax.device_put(self._opt_state, opt_shardings)
         if state.loaded_optimizer_state is not None:
             # Deferred resume payload (parity: reference
             # torch/optimizers/optimizer.py:545-547).
@@ -80,7 +80,24 @@ class DistributedOptimizer:
             new_params = optax.apply_updates(params, updates)
             return new_params, new_opt_state
 
-        self._update = jax.jit(update, donate_argnums=(0, 1))
+        # Pin output shardings: without them GSPMD may return params
+        # resharded to whatever layout the update program preferred (e.g. a
+        # tp-sharded embedding coming back from tp-sharded grads), after
+        # which the step's AOT executable rejects its inputs and every
+        # subsequent step pays jit-dispatch. Parity: the reference's
+        # post-step param allgather restores the canonical placement
+        # (torch/optimizers/optimizer.py:355-391); here the canonical
+        # placement is the partitioner's _param_shardings.
+        param_pin = self.model._param_shardings
+        opt_pin = opt_shardings if opt_shardings is not None else (
+            jax.tree_util.tree_map(lambda l: l.sharding, self._opt_state)
+        )
+        out_shardings = None
+        if param_pin is not None:
+            out_shardings = (param_pin, opt_pin)
+        self._update = jax.jit(
+            update, donate_argnums=(0, 1), out_shardings=out_shardings
+        )
 
     # ------------------------------------------------------------------
 
