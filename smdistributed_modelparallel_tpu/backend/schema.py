@@ -350,6 +350,19 @@ SCHEMA = {
         "description": "TPU extension: dispatch attention/softmax to Pallas "
         "kernels on TPU (jnp fallback elsewhere or when shapes don't tile).",
     },
+    "fused_optimizer_step": {
+        "type": bool,
+        "default": True,
+        "description": "TPU extension: compile the optimizer update into the "
+        "step program (one device launch per training iteration). The update "
+        "is installed only when optimizer.step() is called; disabled "
+        "automatically under fp16 loss scaling. Memory note: because the "
+        "step may legally run without a following optimizer.step(), the "
+        "fused program cannot donate params/opt_state, so peak memory holds "
+        "one extra params+opt_state copy vs the donated standalone update; "
+        "set False to restore the donated memory profile on tight-HBM "
+        "configs.",
+    },
     "_device_count_override": {
         "type": (int, type(None)),
         "default": None,

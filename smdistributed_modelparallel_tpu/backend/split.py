@@ -67,7 +67,8 @@ class TensorSplitter:
                 leaf.smp_slice(self.num_microbatches, mb, axis)
                 for mb in range(self.num_microbatches)
             ]
-            return jnp.stack([jnp.asarray(p) for p in pieces], axis=0)
+            stacked = jnp.stack([jnp.asarray(p) for p in pieces], axis=0)
+            return DeferredSplit(stacked, 0, self.num_microbatches, stacked=True)
         if not _is_array(leaf):
             if self.num_microbatches > 1 and leaf is not None and not isinstance(
                 leaf, (bool, int, float, str, bytes)
@@ -75,7 +76,6 @@ class TensorSplitter:
                 logger.debug("Argument %s of type %s is not splittable; broadcasting.",
                              name, type(leaf).__name__)
             return NonSplit(leaf)
-        leaf = jnp.asarray(leaf)
         if leaf.ndim <= axis:
             return NonSplit(leaf)
         dim = leaf.shape[axis]
@@ -84,11 +84,10 @@ class TensorSplitter:
                 f"Axis {axis} of argument '{name}' has size {dim}, not divisible by "
                 f"microbatches={self.num_microbatches}."
             )
-        mb_dim = dim // self.num_microbatches
-        # [.., num_mb * mb_dim, ..] -> [num_mb, .., mb_dim, ..]
-        new_shape = leaf.shape[:axis] + (self.num_microbatches, mb_dim) + leaf.shape[axis + 1:]
-        reshaped = leaf.reshape(new_shape)
-        return jnp.moveaxis(reshaped, axis, 0)
+        # Defer the actual [B, ...] -> [num_mb, B/num_mb, ...] restack to the
+        # compiled step program: an eager per-leaf reshape dispatch per step
+        # is pure launch overhead on a remote accelerator.
+        return DeferredSplit(leaf, axis, self.num_microbatches, stacked=False)
 
 
 class NonSplit:
@@ -98,12 +97,61 @@ class NonSplit:
         self.value = value
 
 
+def stack_leaf(leaf, axis, num_mb, stacked=False):
+    """[B, ...] -> [num_mb, B/num_mb, ...] restack along ``axis``; the single
+    implementation shared by eager helpers and the traced step prologue."""
+    if stacked:
+        return leaf
+    leaf = jnp.asarray(leaf)
+    mb_dim = leaf.shape[axis] // num_mb
+    new_shape = leaf.shape[:axis] + (num_mb, mb_dim) + leaf.shape[axis + 1:]
+    return jnp.moveaxis(leaf.reshape(new_shape), axis, 0)
+
+
+class DeferredSplit:
+    """A splittable leaf whose microbatch restack is deferred to trace time.
+
+    ``stack()`` produces the [num_mb, ...] view (called inside the compiled
+    program); ``slice(mb)`` eagerly extracts one microbatch (init/trace-time
+    helper).
+    """
+
+    __slots__ = ("value", "axis", "num_mb", "stacked")
+
+    def __init__(self, value, axis, num_mb, stacked=False):
+        self.value = value
+        self.axis = axis
+        self.num_mb = num_mb
+        self.stacked = stacked
+
+    def stack(self, value=None):
+        leaf = self.value if value is None else value
+        return stack_leaf(leaf, self.axis, self.num_mb, self.stacked)
+
+    def slice(self, mb):
+        leaf = jnp.asarray(self.value)
+        if self.stacked:
+            return leaf[mb]
+        mb_dim = leaf.shape[self.axis] // self.num_mb
+        start = mb * mb_dim
+        return jax.lax.slice_in_dim(leaf, start, start + mb_dim, axis=self.axis)
+
+
 def microbatch_slice(stacked_tree, mb):
-    """Select microbatch `mb` from a stacked tree (outside-scan helper)."""
+    """Select microbatch `mb` from a stacked/deferred tree (outside-scan
+    helper)."""
+
+    def pick(x):
+        if isinstance(x, NonSplit):
+            return x.value
+        if isinstance(x, DeferredSplit):
+            return x.slice(mb)
+        return x[mb]
+
     return jax.tree_util.tree_map(
-        lambda x: x.value if isinstance(x, NonSplit) else x[mb],
+        pick,
         stacked_tree,
-        is_leaf=lambda x: isinstance(x, NonSplit),
+        is_leaf=lambda x: isinstance(x, (NonSplit, DeferredSplit)),
     )
 
 

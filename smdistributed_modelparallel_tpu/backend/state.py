@@ -25,6 +25,7 @@ class ModelParallelState:
         self.timeline = None        # Timeline (SMP_TIMELINE_PATH)
         self.memory_metrics = None  # StepMemoryMetricsCollector
         self.step_count = 0
+        self.step_rng = None        # device-carried RNG key advanced by the step program
         self.loaded_model_state = None      # deferred checkpoint payloads
         self.loaded_optimizer_state = None
         self.last_compile_report = None     # one_time_compile_report output
@@ -86,6 +87,7 @@ class ModelParallelState:
         self.optimizer = None
         self.module_manager = None
         self.step_count = 0
+        self.step_rng = None
         self.loaded_model_state = None
         self.loaded_optimizer_state = None
         self.last_compile_report = None

@@ -57,8 +57,9 @@ class DistributedModel:
         self.rng_streams = tuple(rngs)
         self._params = None               # materialized param pytree (jax.Arrays)
         self._param_shardings = None      # pytree of NamedSharding
-        self._grads = None                # latest accumulated grads (set by step)
+        self._grads_store = None          # ("avg", tree) | ("raw", tree, divisor, avg_cache)
         self._grads_finite = None         # device bool under fp16 loss scaling
+        self._pending_update = None       # fused-step (grads_token, params, opt_state)
         self._tls = threading.local()     # per-trace bound params / backward loss
         self._partition_result = None     # set by the pipeline partitioner (M2)
         self._pipeline_spec = None        # PipelineSpec when pp > 1 (M2)
@@ -294,6 +295,39 @@ class DistributedModel:
     @property
     def grads(self):
         return self._grads
+
+    # _grads backs onto a store that can hold the RAW microbatch-sum tree
+    # from a fused step (averaging folds into the optimizer update, so the
+    # mean is only computed if someone actually reads the grads).
+    @property
+    def _grads(self):
+        store = self._grads_store
+        if store is None:
+            return None
+        if store[0] == "avg":
+            return store[1]
+        _, raw, divisor, avg = store
+        if avg is None:
+            avg = jax.tree_util.tree_map(
+                lambda g, p: (g / divisor).astype(p.dtype), raw, self._params
+            )
+            self._grads_store = ("raw", raw, divisor, avg)
+        return avg
+
+    @_grads.setter
+    def _grads(self, value):
+        self._grads_store = None if value is None else ("avg", value)
+
+    def _set_raw_grads(self, raw, divisor):
+        self._grads_store = ("raw", raw, divisor, None)
+
+    def _grads_token_is(self, token):
+        """Identity check against the step's grads output without forcing
+        the lazy average."""
+        store = self._grads_store
+        if store is None:
+            return False
+        return (store[1] is token)
 
     def parameters(self):
         """Flat list of parameter arrays (reference-compat-ish)."""

@@ -30,8 +30,17 @@ from smdistributed_modelparallel_tpu.utils.logger import get_logger
 logger = get_logger()
 
 
+_OPTIMIZER_SERIAL = [0]
+
+
 class DistributedOptimizer:
     def __init__(self, tx, model=None, grad_clip_norm=None):
+        # Monotonic serial for step-cache keys: id() can be reused by the
+        # allocator after a replaced optimizer is collected, which would let
+        # a new optimizer silently hit the old optimizer's cached fused
+        # update.
+        _OPTIMIZER_SERIAL[0] += 1
+        self._serial = _OPTIMIZER_SERIAL[0]
         if not isinstance(tx, optax.GradientTransformation):
             raise SMPValidationError(
                 "DistributedOptimizer expects an optax.GradientTransformation "
@@ -69,16 +78,7 @@ class DistributedOptimizer:
             self.load_state_dict(state.loaded_optimizer_state)
             state.loaded_optimizer_state = None
 
-        clip = self.grad_clip_norm
-
-        def update(params, opt_state, grads):
-            if clip is not None:
-                gnorm = optax.global_norm(grads)
-                scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            updates, new_opt_state = self.tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
-            return new_params, new_opt_state
+        update = self.build_update_fn()
 
         # Pin output shardings: without them GSPMD may return params
         # resharded to whatever layout the update program preferred (e.g. a
@@ -99,6 +99,24 @@ class DistributedOptimizer:
             update, donate_argnums=(0, 1), out_shardings=out_shardings
         )
 
+    def build_update_fn(self):
+        """Pure (params, opt_state, grads) -> (new_params, new_opt_state)
+        update, shared between the standalone jitted update and the fused
+        in-step update (``fused_optimizer_step``)."""
+        clip = self.grad_clip_norm
+        tx = self.tx
+
+        def update(params, opt_state, grads):
+            if clip is not None:
+                gnorm = optax.global_norm(grads)
+                scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            return new_params, new_opt_state
+
+        return update
+
     # ------------------------------------------------------------------
 
     def step(self):
@@ -108,12 +126,32 @@ class DistributedOptimizer:
         (``torch/optimizers/optimizer.py:355-391``) — sharded update then
         param allgather; under XLA both emerge from the sharding specs.
         """
-        grads = self.model._grads
-        if grads is None:
+        if self.model._grads_store is None:
             raise StepUsageError(
                 "No gradients available: run an @smp.step function with "
                 "model.backward(loss) before optimizer.step()."
             )
+        # Fused path (``fused_optimizer_step``): the step program already
+        # computed (new_params, new_opt_state) in the same launch; installing
+        # them is a host-side pointer swap. Guarded by grads identity so a
+        # user who replaced model._grads (custom grad processing) falls back
+        # to the real update below. The identity check deliberately avoids
+        # reading model._grads (that would force the lazy average).
+        pending = getattr(self.model, "_pending_update", None)
+        if pending is not None:
+            self.model._pending_update = None
+            if (
+                pending[0] is not None
+                and self.model._grads_token_is(pending[0])
+                and self.model._params is pending[3]
+                and self._opt_state is pending[4]
+            ):
+                self.model.params = pending[1]
+                self._opt_state = pending[2]
+                self.model._grads = None
+                self.model._grads_finite = None
+                return
+        grads = self.model._grads
         self._ensure_state()
         scaler = state.loss_scaler
         finite = self.model._grads_finite

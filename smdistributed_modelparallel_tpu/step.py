@@ -31,10 +31,12 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from smdistributed_modelparallel_tpu.backend.split import (
+    DeferredSplit,
     NonSplit,
     StepOutput,
     TensorSplitter,
     microbatch_slice,
+    stack_leaf,
 )
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.model import DistributedModel
@@ -114,8 +116,6 @@ class StepFunction:
             grads, outputs = self._run_compiled(
                 model, stacked_args, stacked_kwargs
             )
-        if model is not None and grads is not None:
-            model._grads = grads
         if state.memory_metrics is not None:
             state.memory_metrics.record_step(state.step_count)
         state.step_count += 1
@@ -187,55 +187,100 @@ class StepFunction:
         mesh = state.mesh
         num_mb = cfg.microbatches
 
-        # Partition the stacked-arg tree into scan leaves (stacked arrays),
-        # broadcast array leaves, and static leaves.
+        # Partition the arg tree into scan leaves (DeferredSplit: restacked
+        # to [num_mb, ...] inside the compiled program), broadcast array
+        # leaves, and static leaves.
         tree = (stacked_args, stacked_kwargs)
         leaves, treedef = jax.tree_util.tree_flatten(
-            tree, is_leaf=lambda x: isinstance(x, (NonSplit, _ModelRef))
+            tree, is_leaf=lambda x: isinstance(x, (NonSplit, _ModelRef, DeferredSplit))
         )
         scan_idx, bcast_idx, static = [], [], {}
-        scan_vals, bcast_vals = [], []
+        scan_vals, bcast_vals, scan_meta = [], [], []
         for i, leaf in enumerate(leaves):
             if isinstance(leaf, _ModelRef):
                 static[i] = leaf
+            elif isinstance(leaf, DeferredSplit):
+                scan_idx.append(i)
+                scan_vals.append(leaf.value)
+                scan_meta.append((leaf.axis, leaf.num_mb, leaf.stacked))
             elif isinstance(leaf, NonSplit):
                 if _is_jax_type(leaf.value):
                     bcast_idx.append(i)
                     bcast_vals.append(leaf.value)
                 else:
                     static[i] = leaf.value
-            else:
-                scan_idx.append(i)
-                scan_vals.append(leaf)
+            else:  # untracked array leaf: broadcast
+                bcast_idx.append(i)
+                bcast_vals.append(leaf)
+
+        # Fused optimizer update (TPU extension, cfg.fused_optimizer_step):
+        # compile the optax update into the step program so a full training
+        # iteration is ONE device launch. Disabled under fp16 loss scaling
+        # (the overflow-skip decision lives in the scaler on the host).
+        opt = state.optimizer
+        fused = (
+            getattr(cfg, "fused_optimizer_step", False)
+            and opt is not None
+            and opt.model is model
+            and state.loss_scaler is None
+            and getattr(self, "_has_backward", True)
+        )
+        if fused:
+            opt._ensure_state()
 
         key = (treedef, tuple(scan_idx), tuple(bcast_idx),
                tuple((i, _static_key(v)) for i, v in sorted(static.items())),
                tuple((v.shape, str(v.dtype)) for v in scan_vals),
+               tuple(scan_meta),
                tuple((v.shape, str(v.dtype)) for v in bcast_vals),
                getattr(self, "_has_backward", True),
+               fused, opt._serial if fused else None,
                model.training if model is not None else None)
         compiled = self._cache.get(key)
         if compiled is None:
-            compiled = self._build(model, treedef, scan_idx, bcast_idx, static, num_mb)
+            compiled = self._build(
+                model, treedef, scan_idx, bcast_idx, static, num_mb,
+                scan_meta, opt.build_update_fn() if fused else None,
+            )
             self._cache[key] = compiled
 
         # Device placement: params already sharded; shard batch over data axes
         # (replicate arrays whose dims don't divide the mesh axes, e.g. tiny
-        # test batches).
+        # test batches). Skip the dispatch when the leaf already sits on the
+        # target sharding (the steady-state case).
         scan_vals = [
-            jax.device_put(v, _best_batch_sharding(mesh, cfg, v))
-            for v in scan_vals
+            _place(v, _input_sharding(mesh, cfg, v, meta))
+            for v, meta in zip(scan_vals, scan_meta)
         ]
-        rng = state.rng_manager.next_key("step")
-        loss_scale = jnp.asarray(
-            state.loss_scaler.loss_scale if state.loss_scaler else 1.0,
-            jnp.float32,
+        rng = state.step_rng
+        if rng is None:
+            rng = state.rng_manager.next_key("step")
+        loss_scale = _cached_scalar(
+            state.loss_scaler.loss_scale if state.loss_scaler else 1.0
         )
-        grads, outputs, grads_finite = compiled(
-            model.params, scan_vals, bcast_vals, rng, loss_scale
+        opt_state = opt._opt_state if fused else ()
+        if model is not None:
+            model._pending_update = None
+        in_params = model.params
+        grads, outputs, grads_finite, next_rng, fused_out = compiled(
+            in_params, opt_state, scan_vals, bcast_vals, rng, loss_scale
         )
+        state.step_rng = next_rng
         if model is not None:
             model._grads_finite = grads_finite
+            if grads is not None:
+                raw_div = getattr(compiled, "raw_divisor", None)
+                if raw_div:
+                    model._set_raw_grads(grads, raw_div)
+                else:
+                    model._grads = grads
+            if fused:
+                # Tokens of the exact inputs the fused update consumed:
+                # optimizer.step() installs the precomputed result only if
+                # neither grads, params, nor opt_state were replaced since.
+                model._pending_update = (
+                    grads, fused_out[0], fused_out[1], in_params, opt_state
+                )
         return grads, outputs
 
     @staticmethod
@@ -253,7 +298,8 @@ class StepFunction:
 
         return reconstruct
 
-    def _build(self, model, treedef, scan_idx, bcast_idx, static, num_mb):
+    def _build(self, model, treedef, scan_idx, bcast_idx, static, num_mb,
+               scan_meta, fused_update):
         cfg = state.cfg
         if (
             cfg.pipeline_parallel_degree > 1
@@ -262,7 +308,8 @@ class StepFunction:
             and model._output_aval is not None
         ):
             return self._build_pipeline(
-                model, treedef, scan_idx, bcast_idx, static, num_mb
+                model, treedef, scan_idx, bcast_idx, static, num_mb,
+                scan_meta, fused_update,
             )
         has_backward = getattr(self, "_has_backward", True)
         half = cfg.half_dtype
@@ -270,13 +317,7 @@ class StepFunction:
 
         reconstruct = self._make_reconstruct(model, treedef, scan_idx, bcast_idx, static)
 
-        def mb_forward(params, mb_scan_leaves, bcast_leaves, key):
-            run_params = params
-            if half is not None:
-                run_params = jax.tree_util.tree_map(
-                    lambda p: p.astype(half) if jnp.issubdtype(p.dtype, jnp.floating) else p,
-                    params,
-                )
+        def mb_forward(run_params, mb_scan_leaves, bcast_leaves, key):
             rngs = {
                 s: jax.random.fold_in(key, h)
                 for h, s in enumerate(model.rng_streams)
@@ -297,9 +338,20 @@ class StepFunction:
 
         def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
             keys = jax.random.split(rng, num_mb)
+            # Half-cast hoisted out of the microbatch scan: the cast is
+            # loop-invariant, and differentiating w.r.t. the half params is
+            # numerically identical (the astype VJP is an exact bf16->fp32
+            # upcast of the cotangent, applied below at accumulation).
+            run_params = params
+            if half is not None:
+                run_params = jax.tree_util.tree_map(
+                    lambda p: p.astype(half)
+                    if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                    params,
+                )
             if has_backward:
-                def scaled_fwd(params, mb_leaves, bcast_leaves, key):
-                    loss, out = mb_forward(params, mb_leaves, bcast_leaves, key)
+                def scaled_fwd(run_params, mb_leaves, bcast_leaves, key):
+                    loss, out = mb_forward(run_params, mb_leaves, bcast_leaves, key)
                     # fp16: differentiate scale*loss so half grads stay
                     # representable (reference LossScaler.backward).
                     return loss * loss_scale, out
@@ -308,14 +360,23 @@ class StepFunction:
 
                 def body(acc, xs):
                     mb_leaves, key = xs
-                    (_, out), grads = grad_fn(params, mb_leaves, bcast_leaves, key)
-                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                    (_, out), grads = grad_fn(run_params, mb_leaves, bcast_leaves, key)
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(a.dtype), acc, grads
+                    )
                     return acc, out
 
                 acc0 = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, _acc_dtype(p.dtype, cfg)), params
                 )
                 grads, outs = jax.lax.scan(body, acc0, (scan_leaves, keys))
+                if fused_update is not None:
+                    # Fused mode: return the RAW accumulator (aliases the
+                    # scan carry, no extra materialization); the averaging
+                    # folds into the optimizer-update kernels in the runner,
+                    # and into a lazy divide if the user reads model.grads.
+                    # (Loss scaling is off in fused mode.)
+                    return grads, outs, None
                 # Microbatch averaging: parity with reference
                 # torch/allreduce/ddp.py:92-98 (grads divided by num_mb);
                 # loss-scale undone in the same pass.
@@ -328,15 +389,17 @@ class StepFunction:
 
             def body(carry, xs):
                 mb_leaves, key = xs
-                _, out = mb_forward(params, mb_leaves, bcast_leaves, key)
+                _, out = mb_forward(run_params, mb_leaves, bcast_leaves, key)
                 return carry, out
 
             _, outs = jax.lax.scan(body, 0, (scan_leaves, keys))
             return None, outs, None
 
-        return _make_runner(step_impl, "step")
+        return _make_runner(step_impl, "step", scan_meta, fused_update, model,
+                            raw_divisor=num_mb if fused_update is not None else None)
 
-    def _build_pipeline(self, model, treedef, scan_idx, bcast_idx, static, num_mb):
+    def _build_pipeline(self, model, treedef, scan_idx, bcast_idx, static,
+                        num_mb, scan_meta, fused_update):
         """pp > 1: one pipelined forward over all microbatches.
 
         The user fn is traced twice per microbatch: once with the model call
@@ -429,7 +492,9 @@ class StepFunction:
                 finite = _grads_finite(grads) if use_scaler else None
                 return grads, outs, finite
 
-            return _make_runner(step_impl, "step_pipeline_1f1b")
+            return _make_runner(
+                step_impl, "step_pipeline_1f1b", scan_meta, fused_update, model
+            )
 
         def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
             keys = jax.random.split(rng, num_mb)
@@ -481,11 +546,18 @@ class StepFunction:
             _, outs = forward_all(params)
             return None, outs, None
 
-        return _make_runner(step_impl, "step_pipeline")
+        return _make_runner(step_impl, "step_pipeline", scan_meta, fused_update, model)
 
 
-def _make_runner(step_impl, name):
-    """Jit + AOT-compile a step_impl once, logging the one-time compile
+def _make_runner(step_impl, name, scan_meta, fused_update, model,
+                 raw_divisor=None):
+    """Jit + AOT-compile the full per-step program once.
+
+    The wrapper around ``step_impl`` performs, inside the SAME compiled
+    program: the microbatch restack of raw batch leaves, the RNG-key advance
+    (the next step's key is a program output, so no eager dispatch per
+    step), and — under ``fused_optimizer_step`` — the optimizer update
+    pinned to the partitioner's param shardings. Logs the one-time compile
     report (FLOPs / bytes / peak memory — the reference's one-time Studio
     metrics upload, ``torch/step.py:295-312``). Falls back to plain jit
     dispatch if the AOT path is unavailable."""
@@ -493,17 +565,59 @@ def _make_runner(step_impl, name):
         one_time_compile_report,
     )
 
-    jitted = jax.jit(step_impl, donate_argnums=())
+    param_pin = model._param_shardings if model is not None else None
+    opt_pin = None
+    if fused_update is not None and state.optimizer is not None:
+        # Captured eagerly (shardings are not queryable on tracers).
+        opt_pin = jax.tree_util.tree_map(
+            lambda l: l.sharding if isinstance(l, jax.Array) else None,
+            state.optimizer._opt_state,
+        )
+
+    def full_impl(params, opt_state, raw_scan, bcast_vals, rng, loss_scale):
+        use_rng, next_rng = jax.random.split(rng)
+        scan_leaves = [
+            stack_leaf(v, *m) for v, m in zip(raw_scan, scan_meta)
+        ]
+        grads, outs, finite = step_impl(
+            params, scan_leaves, bcast_vals, use_rng, loss_scale
+        )
+        if fused_update is not None:
+            upd_grads = grads
+            if raw_divisor is not None:
+                # Average the raw accumulator on the way into the update —
+                # this divide fuses into the optimizer's elementwise kernels
+                # instead of materializing an averaged-grads output.
+                upd_grads = jax.tree_util.tree_map(
+                    lambda g, p: (g / raw_divisor).astype(p.dtype),
+                    grads, params,
+                )
+            new_params, new_opt = fused_update(params, opt_state, upd_grads)
+            if param_pin is not None:
+                new_params = jax.lax.with_sharding_constraint(new_params, param_pin)
+            if opt_pin is not None:
+                new_opt = jax.tree_util.tree_map(
+                    lambda l, s: jax.lax.with_sharding_constraint(l, s)
+                    if s is not None else l,
+                    new_opt, opt_pin,
+                    is_leaf=lambda x: x is None,
+                )
+            fused_out = (new_params, new_opt)
+        else:
+            fused_out = ()
+        return grads, outs, finite, next_rng, fused_out
+
+    jitted = jax.jit(full_impl, donate_argnums=())
     mesh = state.mesh
     holder = {}
 
-    def run(params, scan_vals, bcast_vals, rng, loss_scale):
+    def run(params, opt_state, scan_vals, bcast_vals, rng, loss_scale):
         with jax.set_mesh(mesh):
             if "compiled" not in holder:
                 compiled = None
                 try:
                     lowered = jitted.lower(
-                        params, scan_vals, bcast_vals, rng, loss_scale
+                        params, opt_state, scan_vals, bcast_vals, rng, loss_scale
                     )
                     compiled = lowered.compile()
                     state.last_compile_report = one_time_compile_report(
@@ -515,7 +629,7 @@ def _make_runner(step_impl, name):
             c = holder["compiled"]
             if c is not None:
                 try:
-                    return c(params, scan_vals, bcast_vals, rng, loss_scale)
+                    return c(params, opt_state, scan_vals, bcast_vals, rng, loss_scale)
                 except (TypeError, ValueError) as e:
                     # Input aval/sharding mismatch only (the step cache keys
                     # on shapes, so this is a layout drift, e.g. resharded
@@ -526,18 +640,48 @@ def _make_runner(step_impl, name):
                         "falling back to jit dispatch.", e,
                     )
                     holder["compiled"] = None
-            return jitted(params, scan_vals, bcast_vals, rng, loss_scale)
+            return jitted(params, opt_state, scan_vals, bcast_vals, rng, loss_scale)
 
     run.jitted = jitted
     run.mesh = mesh
     run.holder = holder
+    run.raw_divisor = raw_divisor if fused_update is not None else None
     return run
 
 
-def _best_batch_sharding(mesh, cfg, arr):
-    """Batch sharding for a stacked array, dropping mesh axes that don't
-    divide the corresponding dim (falls back to replication)."""
-    spec = list(batch_spec(cfg, arr.ndim, stacked=True))
+def _place(v, sharding):
+    if isinstance(v, jax.Array) and v.sharding == sharding:
+        return v
+    return jax.device_put(v, sharding)
+
+
+_SCALAR_CACHE = {}
+
+
+def _cached_scalar(value):
+    """Device scalar for a host float, cached: avoids a host->device
+    transfer per step for values that change rarely (the loss scale)."""
+    key = float(value)
+    out = _SCALAR_CACHE.get(key)
+    if out is None:
+        if len(_SCALAR_CACHE) > 64:
+            _SCALAR_CACHE.clear()
+        out = jnp.asarray(key, jnp.float32)
+        _SCALAR_CACHE[key] = out
+    return out
+
+
+def _input_sharding(mesh, cfg, arr, meta):
+    """Batch sharding for a raw (or pre-stacked) scan input, dropping mesh
+    axes that don't divide the corresponding dim (falls back to
+    replication). For raw leaves the divisibility check applies to the
+    post-stack per-microbatch dim."""
+    axis, num_mb, stacked = meta
+    ndim = len(arr.shape)
+    spec = list(batch_spec(
+        cfg, ndim, batch_axis=0 if stacked else axis, stacked=stacked
+    ))
+    batch_dim = 1 if stacked else axis
     for dim, axes in enumerate(spec):
         if axes is None:
             continue
@@ -545,7 +689,10 @@ def _best_batch_sharding(mesh, cfg, arr):
         size = 1
         for a in axes_tuple:
             size *= mesh.shape[a]
-        if arr.shape[dim] % size != 0:
+        dim_size = arr.shape[dim]
+        if dim == batch_dim and not stacked:
+            dim_size = dim_size // num_mb
+        if dim_size % size != 0:
             spec[dim] = None
     return NamedSharding(mesh, P(*spec))
 

@@ -8,6 +8,7 @@ import pytest
 import jax.numpy as jnp
 
 from smdistributed_modelparallel_tpu.backend.split import (
+    DeferredSplit,
     NonSplit,
     StepOutput,
     TensorSplitter,
@@ -20,7 +21,8 @@ def test_basic_split():
     sp = TensorSplitter(4)
     x = jnp.arange(8 * 3).reshape(8, 3)
     (stacked,), _ = sp.stack_microbatches((x,), {}, arg_names=["x"])
-    assert stacked.shape == (4, 2, 3)
+    assert isinstance(stacked, DeferredSplit)
+    assert stacked.stack().shape == (4, 2, 3)
     np.testing.assert_array_equal(microbatch_slice(stacked, 1), np.asarray(x[2:4]))
 
 
@@ -28,9 +30,9 @@ def test_nested_structures():
     sp = TensorSplitter(2)
     batch = {"ids": jnp.ones((4, 5)), "inner": [jnp.zeros((4,)), jnp.ones((4, 2))]}
     (stacked,), _ = sp.stack_microbatches((batch,), {}, arg_names=["batch"])
-    assert stacked["ids"].shape == (2, 2, 5)
-    assert stacked["inner"][0].shape == (2, 2)
-    assert stacked["inner"][1].shape == (2, 2, 2)
+    assert stacked["ids"].stack().shape == (2, 2, 5)
+    assert stacked["inner"][0].stack().shape == (2, 2)
+    assert stacked["inner"][1].stack().shape == (2, 2, 2)
 
 
 def test_non_split_inputs():
@@ -46,7 +48,7 @@ def test_non_split_inputs():
 def test_input_split_axes():
     sp = TensorSplitter(2, input_split_axes={"x": 1})
     (stacked,), _ = sp.stack_microbatches((jnp.arange(12).reshape(3, 4),), {}, ["x"])
-    assert stacked.shape == (2, 3, 2)
+    assert stacked.stack().shape == (2, 3, 2)
     np.testing.assert_array_equal(
         np.asarray(microbatch_slice(stacked, 0)), np.arange(12).reshape(3, 4)[:, :2]
     )
@@ -69,8 +71,8 @@ def test_smp_slice_protocol():
 
     sp = TensorSplitter(4)
     (stacked,), _ = sp.stack_microbatches((Custom(),), {}, ["c"])
-    assert stacked.shape == (4, 2)
-    np.testing.assert_array_equal(np.asarray(stacked[2]), [4, 5])
+    assert stacked.stack().shape == (4, 2)
+    np.testing.assert_array_equal(np.asarray(stacked.slice(2)), [4, 5])
 
 
 def test_scalars_broadcast():
