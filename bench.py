@@ -81,9 +81,13 @@ def main():
     iters = 10 if on_tpu else 3
 
     def ce_loss(logits, ids):
-        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-        tgt = jnp.take_along_axis(logp, ids[:, 1:, None], axis=-1)
-        return -jnp.mean(tgt)
+        # logsumexp form: the [N, V] fp32 log-softmax is never materialized
+        # (the cast+reduce fuse); only the [N] lse and gathered target
+        # logits are. Used by BOTH the plain-JAX baseline and the framework.
+        lg = logits[:, :-1]
+        tgt = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
+        lse = jax.scipy.special.logsumexp(lg.astype(jnp.float32), axis=-1)
+        return jnp.mean(lse - tgt.astype(jnp.float32))
 
     ids = jax.random.randint(jax.random.key(0), (batch, seq_len), 0, vocab)
 
@@ -116,11 +120,14 @@ def main():
     opt_state0 = jax.jit(tx.init)(params0)
     p, o, l = base_train(params0, opt_state0, ids)
     _readback(l)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        p, o, l = base_train(p, o, ids)
-    _readback(l)
-    base_dt = (time.perf_counter() - t0) / iters
+    base_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, o, l = base_train(p, o, ids)
+        _readback(l)
+        base_times.append((time.perf_counter() - t0) / iters)
+    base_dt = sorted(base_times)[1]  # median of 3 repeats
     del p, o
 
     # ---- framework run ----
@@ -140,12 +147,15 @@ def main():
         optimizer.step()
     _readback(out.reduce_mean())
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = train_step(model, ids)
-        optimizer.step()
-    final_loss = _readback(out.reduce_mean())
-    dt = (time.perf_counter() - t0) / iters
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = train_step(model, ids)
+            optimizer.step()
+        final_loss = _readback(out.reduce_mean())
+        times.append((time.perf_counter() - t0) / iters)
+    dt = sorted(times)[1]  # median of 3 repeats
 
     tokens = batch * seq_len
     tok_per_sec_chip = tokens / dt / max(n_chips, 1)

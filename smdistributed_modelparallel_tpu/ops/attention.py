@@ -111,24 +111,37 @@ def attention_core(
         if impl in ("ring", "ulysses"):
             return cp_attention(q, k, v, scale=scale, causal=causal, impl=impl)
 
+    kpad = _as_key_padding_bias(mask, mask_value)
     if (
         use_pallas
         and _pallas_ok(q, k, v)
         and bias is None
-        and mask is None
+        and (mask is None or kpad is not None)
         and local_select is None
-        and (dropout_rate == 0.0 or dropout_rng is None)
-        and causal
-        and window is None
-        and not attention_in_fp32  # kernel already computes scores in fp32
-        and extra_scale is None
-        and qk_compensation is None  # kernel matmul is fp32; no overflow
+        # attention_in_fp32 / qk_compensation need no special handling: the
+        # kernel's score math is always fp32 (N8 parity, and then some).
     ):
         from smdistributed_modelparallel_tpu.ops.pallas_attention import (
             flash_attention,
         )
 
-        return flash_attention(q, k, v, scale=scale)
+        if isinstance(scale, (int, float, np.floating)):
+            qq, kernel_scale = q, float(scale)
+        else:
+            # Traced scale (e.g. scale_attn_by_layer_idx under lax.scan):
+            # fold into q — the kernel's scale argument is static. Keep q's
+            # dtype (a traced f32 scalar would promote bf16 q to f32).
+            qq, kernel_scale = (q * scale).astype(q.dtype), 1.0
+        seed = None
+        rate = 0.0
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            rate = float(dropout_rate)
+            seed = jax.lax.bitcast_convert_type(
+                jax.random.bits(dropout_rng, (), jnp.uint32), jnp.int32
+            )
+        return flash_attention(
+            qq, k, v, kpad, seed, kernel_scale, causal, window, rate
+        )
 
     T, S = q.shape[1], k.shape[1]
     compute_dtype = jnp.float32 if attention_in_fp32 else q.dtype
@@ -174,12 +187,32 @@ def attention_core(
     return jnp.einsum("bhts,bshd->bthd", probs, v)
 
 
+def _as_key_padding_bias(mask, mask_value):
+    """Reduce a broadcastable attention mask to additive [B, S] form, or
+    None if it genuinely varies along T (falls back to the jnp path).
+
+    Accepts [B|1, 1, 1, S] boolean or additive-float masks — the shape of
+    HF-style padding masks (reference ``attention_mask`` handling)."""
+    if mask is None:
+        return None
+    if mask.ndim == 2:  # already [B, S]
+        reduced = mask
+    elif mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+        reduced = mask[:, 0, 0, :]
+    else:
+        return None
+    if reduced.dtype == jnp.bool_:
+        return jnp.where(reduced, 0.0, mask_value).astype(jnp.float32)
+    return reduced.astype(jnp.float32)
+
+
 def _pallas_ok(q, k, v):
-    """Pallas flash kernel preconditions: TPU backend, self-attention, and a
-    sequence short enough that K/V fit VMEM per (batch, head) — the kernel
-    pads hd/T to tile boundaries itself (``pallas_attention._flash_fwd``)."""
+    """Pallas flash kernel preconditions: TPU backend and q/kv sequences
+    short enough that K/V (dq pass) or Q/dO (dkv pass) fit VMEM per
+    (batch, head) — the kernels pad hd/T/S to tile boundaries themselves
+    (``pallas_attention._prep``)."""
     on_tpu = jax.default_backend() == "tpu"
     if not on_tpu:
         return False
     T, S, hd = q.shape[1], k.shape[1], q.shape[-1]
-    return T == S and T >= 128 and T <= 8192 and hd <= 256
+    return T >= 128 and S >= 128 and T <= 8192 and S <= 8192 and hd <= 256

@@ -1,21 +1,30 @@
-"""Pallas TPU flash-attention kernel.
+"""Pallas TPU flash-attention kernels (forward + backward).
 
-Parity target: the reference's fused attention-softmax CUDA kernels
-(``smp_torch_cuda_lib``: ``scaled_upper_triang_softmax_{forward,backward}``,
-SURVEY §2.1 N8, dispatched from ``torch/nn/softmax.py:15-93``). The TPU
-design goes further than the reference's fused softmax: a blockwise
-online-softmax (flash) forward that never materializes the [T, T] score
-matrix in HBM — scores live in VMEM one [block_q, block_k] tile at a time,
-and causally-masked-out tiles are skipped entirely.
+Parity target: the reference's fused attention-softmax CUDA kernel PAIRS
+(``smp_torch_cuda_lib``: ``scaled_masked_softmax_{forward,backward}``,
+``scaled_upper_triang_softmax_{forward,backward}`` — SURVEY §2.1 N8,
+dispatched from ``torch/nn/softmax.py:7-93``). The TPU design goes further
+than the reference's fused softmax: a blockwise online-softmax (flash)
+forward and a blockwise recompute backward, neither of which materializes
+the [T, S] score matrix in HBM — scores live in VMEM one
+[block_q, block_k] tile at a time.
 
-Backward is recompute-based (``jax.custom_vjp``): the standard softmax
-transpose in plain jnp, which XLA fuses; the forward's memory saving is the
-flash win, matching how the reference pairs its fused forward with an
-explicit backward kernel.
+Supported feature surface (all combinations):
+  - causal and non-causal attention, T != S (cross-attention offsets);
+  - windowed (local/banded) attention, causal band or symmetric band
+    (reference ``torch/nn/transformer.py:1331-1352``);
+  - additive key-padding bias [B, S] (the broadcastable form of HF-style
+    attention masks; arbitrary [.., T, S] biases fall back to jnp);
+  - dropout on the attention probabilities, replayed exactly in the
+    backward via a counter-based hash RNG (no [T, S] mask materialized);
+  - fp32 score math always (subsumes ``attention_in_fp32``).
 
-Layout: inputs [B, T, H, hd]; the kernel runs on [B*H, T, hd] with grid
-(B*H, T/block_q), k/v resident in VMEM per (batch, head) — the dispatch gate
-(``ops/attention.py::_pallas_ok``) bounds T so k/v fit VMEM.
+Backward: two passes — dq (grid over q blocks, kv streamed) and dk/dv
+(grid over kv blocks, q streamed) — using the forward's saved per-row
+logsumexp and the precomputed ``delta = rowsum(dO * O)``, the standard
+flash-attention backward decomposition.
+
+Layout: inputs [B, T, H, hd]; kernels run on [B*H, T, hd].
 """
 
 import functools
@@ -27,10 +36,93 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_LSE_MASKED = 1e30  # lse sentinel for fully-masked rows -> p == 0 in bwd
+
+# Testing hook: run kernels in interpret mode even when dispatched through
+# attention_core (which does not thread an interpret flag). Lets CPU tests
+# exercise the real dispatch path.
+FORCE_INTERPRET = False
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k, seq_len):
-    """One q block vs all (causally relevant) kv blocks, online softmax."""
+def _dropout_keep(seed, bh, rows, cols, s_total, rate):
+    """Counter-based keep mask for a [bq, bk] tile.
+
+    lowbias32-style integer hash of the global (bh, row, col) position —
+    identical bits in forward and backward, works compiled and in
+    interpret mode (no pltpu PRNG state).
+    """
+    idx = (bh.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+           + rows.astype(jnp.uint32) * jnp.uint32(s_total)
+           + cols.astype(jnp.uint32))
+    x = idx + seed.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    thr = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
+    return x >= thr
+
+
+def _tile_mask(rows, cols, *, q_len, kv_len, causal, window):
+    """Static structural mask for a tile given absolute row/col indices."""
+    offset = kv_len - q_len
+    keep = cols < kv_len
+    keep &= rows < q_len
+    if causal:
+        keep &= cols <= rows + offset
+        if window is not None:
+            keep &= rows + offset - cols < window
+    elif window is not None:
+        keep &= jnp.abs(rows + offset - cols) < window
+    return keep
+
+
+def _kv_bounds(q_lo, q_hi, *, q_len, kv_len, causal, window, block_k, num_kv):
+    """Traced [lo, hi) kv-block range relevant to q rows [q_lo, q_hi)."""
+    offset = kv_len - q_len
+    if causal:
+        hi = jnp.minimum(num_kv, (q_hi - 1 + offset) // block_k + 1)
+    elif window is not None:
+        # Symmetric band: cols < rows + offset + window.
+        hi = jnp.minimum(num_kv, (q_hi - 1 + offset + window - 1) // block_k + 1)
+    else:
+        hi = num_kv
+    if window is not None:
+        lo = jnp.maximum(0, (q_lo + offset - window + 1) // block_k)
+    else:
+        lo = 0
+    return lo, hi
+
+
+def _q_bounds(k_lo, k_hi, *, q_len, kv_len, causal, window, block_q, num_q):
+    """Traced [lo, hi) q-block range relevant to kv cols [k_lo, k_hi)."""
+    offset = kv_len - q_len
+    lo = 0
+    hi = num_q
+    if causal:
+        lo = jnp.maximum(0, (k_lo - offset) // block_q)
+        if window is not None:
+            hi = jnp.minimum(num_q, (k_hi - 1 - offset + window - 1) // block_q + 1)
+    elif window is not None:
+        lo = jnp.maximum(0, (k_lo - offset - window + 1) // block_q)
+        hi = jnp.minimum(num_q, (k_hi - 1 - offset + window - 1) // block_q + 1)
+    return lo, hi
+
+
+# ----------------------------------------------------------------------
+# Forward
+# ----------------------------------------------------------------------
+
+def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
+                window, rate, has_kpm, has_seed, s_total):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    kpm_ref = next(it) if has_kpm else None
+    seed_ref = next(it) if has_seed else None
+    o_ref, lse_ref = next(it), next(it)
+
+    b = pl.program_id(0)
     i = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # [bq, hd]
     hd = q.shape[-1]
@@ -46,99 +138,375 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k, seq_len)
         )                                              # [bq, bk]
         rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = (cols <= rows) & (cols < seq_len)
-        s = jnp.where(mask, s, NEG_INF)
+        if kpm_ref is not None:
+            s = s + kpm_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
+                          causal=causal, window=window)
+        s = jnp.where(keep, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            dkeep = _dropout_keep(seed_ref[0, 0], b, rows, cols, s_total, rate)
+            p = jnp.where(dkeep, p, 0.0)
         acc_new = acc * alpha + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return acc_new, m_new, l_new
 
-    # Causal: kv blocks beyond this q block's diagonal are all-masked; skip.
-    num_kv = (q_offset + block_q + block_k - 1) // block_k
+    num_kv = k_ref.shape[1] // block_k
+    lo, hi = _kv_bounds(
+        q_offset, q_offset + block_q, q_len=q_len, kv_len=kv_len,
+        causal=causal, window=window, block_k=block_k, num_kv=num_kv,
+    )
     acc0 = jnp.zeros((block_q, hd), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kv, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    acc, m, l = jax.lax.fori_loop(lo, hi, body, (acc0, m0, l0))
+    inv_keep = 1.0 / (1.0 - rate) if rate > 0.0 else 1.0
+    o_ref[0] = (acc * inv_keep / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse = jnp.where(
+        l[:, 0] > 0.0, m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)),
+        _LSE_MASKED,
+    )
+    lse_ref[0] = lse[None, :]
 
 
-def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
+# ----------------------------------------------------------------------
+# Backward
+# ----------------------------------------------------------------------
+
+def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
+                   window, rate, has_kpm, has_seed, s_total):
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (next(it) for _ in range(6))
+    kpm_ref = next(it) if has_kpm else None
+    seed_ref = next(it) if has_seed else None
+    dq_ref = next(it)
+
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, hd]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :][:, None]                   # [bq, 1]
+    delta = delta_ref[0, 0, :][:, None]
+    q_offset = i * block_q
+    inv_keep = 1.0 / (1.0 - rate) if rate > 0.0 else 1.0
+
+    def body(j, dq_acc):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if kpm_ref is not None:
+            s = s + kpm_ref[0, pl.ds(j * block_k, block_k)][None, :]
+        keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
+                          causal=causal, window=window)
+        p = jnp.where(keep, jnp.exp(s - lse), 0.0)    # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if rate > 0.0:
+            dkeep = _dropout_keep(seed_ref[0, 0], b, rows, cols, s_total, rate)
+            dp = jnp.where(dkeep, dp * inv_keep, 0.0)
+        ds = p * (dp - delta) * scale                 # d(q.k^T)
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    num_kv = k_ref.shape[1] // block_k
+    lo, hi = _kv_bounds(
+        q_offset, q_offset + block_q, q_len=q_len, kv_len=kv_len,
+        causal=causal, window=window, block_k=block_k, num_kv=num_kv,
+    )
+    dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    dq = jax.lax.fori_loop(lo, hi, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
+                    window, rate, has_kpm, has_seed, s_total):
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (next(it) for _ in range(6))
+    kpm_ref = next(it) if has_kpm else None
+    seed_ref = next(it) if has_seed else None
+    dk_ref, dv_ref = next(it), next(it)
+
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)              # [bk, hd]
+    v_blk = v_ref[0].astype(jnp.float32)
+    k_offset = j * block_k
+    inv_keep = 1.0 / (1.0 - rate) if rate > 0.0 else 1.0
+    # kpm is indexed per kv block here (the block is this program's slice).
+    kpm_blk = None
+    if kpm_ref is not None:
+        kpm_blk = kpm_ref[0, pl.ds(k_offset, block_k)][None, :]
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [bq, bk]
+        rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        if kpm_blk is not None:
+            s = s + kpm_blk
+        keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
+                          causal=causal, window=window)
+        p = jnp.where(keep, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if rate > 0.0:
+            dkeep = _dropout_keep(seed_ref[0, 0], b, rows, cols, s_total, rate)
+            p_drop = jnp.where(dkeep, p * inv_keep, 0.0)
+            dp = jnp.where(dkeep, dp * inv_keep, 0.0)
+        else:
+            p_drop = p
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p_drop, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [bk, hd]
+        ds = p * (dp - delta) * scale
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_acc, dv_acc
+
+    num_q = q_ref.shape[1] // block_q
+    lo, hi = _q_bounds(
+        k_offset, k_offset + block_k, q_len=q_len, kv_len=kv_len,
+        causal=causal, window=window, block_q=block_q, num_q=num_q,
+    )
+    hd = k_blk.shape[-1]
+    z = jnp.zeros((block_k, hd), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, hi, body, (z, z))
+    # ds carries one *scale (the dq factor); dk = ds^T.q needs the raw q,
+    # but q_blk is pre-scaled — undo the extra factor once per tile.
+    dk_ref[0] = (dk / scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ----------------------------------------------------------------------
+# Host-side wrappers
+# ----------------------------------------------------------------------
+
+def _prep(q, k, v, block_q, block_k):
     B, T, H, hd = q.shape
-    # [B, T, H, hd] -> [B*H, T, hd]
+    S = k.shape[1]
+
     def to_bht(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, hd)
+        return x.transpose(0, 2, 1, 3).reshape(B * x.shape[2], x.shape[1], hd)
 
     qt, kt, vt = to_bht(q), to_bht(k), to_bht(v)
     hd_pad = max(128, int(2 ** np.ceil(np.log2(hd)))) if hd % 128 else hd
     t_pad = ((T + block_q - 1) // block_q) * block_q
+    s_pad = ((S + block_k - 1) // block_k) * block_k
     if hd_pad != hd or t_pad != T:
-        pad = ((0, 0), (0, t_pad - T), (0, hd_pad - hd))
-        qt = jnp.pad(qt, pad)
+        qt = jnp.pad(qt, ((0, 0), (0, t_pad - T), (0, hd_pad - hd)))
+    if hd_pad != hd or s_pad != S:
+        pad = ((0, 0), (0, s_pad - S), (0, hd_pad - hd))
         kt = jnp.pad(kt, pad)
         vt = jnp.pad(vt, pad)
+    return qt, kt, vt, (B, T, S, H, hd, hd_pad, t_pad, s_pad)
 
+
+def _common_inputs(kpad_bias, seed, s_pad, H, interpret):
+    """(extra_inputs, extra_specs, has_kpm, has_seed) shared by all kernels."""
+    inputs, specs = [], []
+    has_kpm = kpad_bias is not None
+    if has_kpm:
+        S = kpad_bias.shape[1]
+        kpm = kpad_bias.astype(jnp.float32)
+        if s_pad != S:
+            kpm = jnp.pad(kpm, ((0, 0), (0, s_pad - S)), constant_values=NEG_INF)
+        inputs.append(kpm)
+        specs.append(pl.BlockSpec((1, s_pad), lambda b, i: (b // H, 0)))
+    has_seed = seed is not None
+    if has_seed:
+        inputs.append(seed.reshape(1, 1).astype(jnp.int32))
+        specs.append(pl.BlockSpec(
+            (1, 1), lambda b, i: (0, 0),
+            memory_space=pltpu.SMEM if not interpret else None,
+        ))
+    return inputs, specs, has_kpm, has_seed
+
+
+def _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
+                    dropout_rate, block_q, block_k, interpret):
+    qt, kt, vt, (B, T, S, H, hd, hd_pad, t_pad, s_pad) = _prep(
+        q, k, v, block_q, block_k
+    )
+    extra, extra_specs, has_kpm, has_seed = _common_inputs(
+        kpad_bias, seed, s_pad, H, interpret
+    )
     grid = (B * H, t_pad // block_q)
-    out = pl.pallas_call(
-        functools.partial(
-            _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
-            seq_len=T,
-        ),
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        q_len=T, kv_len=S, causal=causal, window=window,
+        rate=dropout_rate if has_seed else 0.0,
+        has_kpm=has_kpm, has_seed=has_seed, s_total=s_pad,
+    )
+    out, lse = pl.pallas_call(
+        kern,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, hd_pad), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t_pad, hd_pad), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t_pad, hd_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, hd_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, hd_pad), lambda b, i: (b, 0, 0)),
+            *extra_specs,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, hd_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, t_pad, hd_pad), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, t_pad), jnp.float32),
+        ],
+        interpret=interpret or FORCE_INTERPRET,
+    )(qt, kt, vt, *extra)
+    o = out[:, :T, :hd].reshape(B, H, T, hd).transpose(0, 2, 1, 3)
+    return o, lse
+
+
+def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
+                    window, dropout_rate, block_q, block_k, interpret):
+    qt, kt, vt, (B, T, S, H, hd, hd_pad, t_pad, s_pad) = _prep(
+        q, k, v, block_q, block_k
+    )
+    gt = g.transpose(0, 2, 1, 3).reshape(B * H, T, hd)
+    if hd_pad != hd or t_pad != T:
+        gt = jnp.pad(gt, ((0, 0), (0, t_pad - T), (0, hd_pad - hd)))
+    # delta = rowsum(dO * O): one fused elementwise+reduce pass in XLA.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1).reshape(B * H, 1, T)
+    if t_pad != T:
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, t_pad - T)))
+
+    extra, extra_specs, has_kpm, has_seed = _common_inputs(
+        kpad_bias, seed, s_pad, H, interpret
+    )
+    common = dict(
+        scale=scale, block_q=block_q, block_k=block_k, q_len=T, kv_len=S,
+        causal=causal, window=window,
+        rate=dropout_rate if has_seed else 0.0,
+        has_kpm=has_kpm, has_seed=has_seed, s_total=s_pad,
+    )
+    res_spec_q = pl.BlockSpec((1, t_pad, hd_pad), lambda b, i: (b, 0, 0))
+    row_spec = pl.BlockSpec((1, 1, t_pad), lambda b, i: (b, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(B * H, t_pad // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s_pad, hd_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s_pad, hd_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, hd_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            *extra_specs,
         ],
         out_specs=pl.BlockSpec((1, block_q, hd_pad), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, t_pad, hd_pad), q.dtype),
-        interpret=interpret,
-    )(qt, kt, vt)
-    out = out[:, :T, :hd].reshape(B, H, T, hd).transpose(0, 2, 1, 3)
-    return out
+        interpret=interpret or FORCE_INTERPRET,
+    )(qt, kt, vt, gt, lse, delta, *extra)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(B * H, s_pad // block_k),
+        in_specs=[
+            res_spec_q,
+            pl.BlockSpec((1, block_k, hd_pad), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, hd_pad), lambda b, j: (b, j, 0)),
+            res_spec_q,
+            row_spec,
+            row_spec,
+            *extra_specs,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, hd_pad), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, hd_pad), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, s_pad, hd_pad), k.dtype),
+            jax.ShapeDtypeStruct((B * H, s_pad, hd_pad), v.dtype),
+        ],
+        interpret=interpret or FORCE_INTERPRET,
+    )(qt, kt, vt, gt, lse, delta, *extra)
+
+    def from_bht(x, L):
+        return x[:, :L, :hd].reshape(B, H, L, hd).transpose(0, 2, 1, 3)
+
+    return from_bht(dq, T), from_bht(dk, S), from_bht(dv, S)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, scale=None, block_q=256, block_k=256,
-                    interpret=False):
-    """Causal flash attention over [B, T, H, hd] (self-attention, T == S)."""
+# ----------------------------------------------------------------------
+# custom_vjp surface
+# ----------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def flash_attention(q, k, v, kpad_bias=None, seed=None, scale=None,
+                    causal=True, window=None, dropout_rate=0.0,
+                    block_q=256, block_k=256, interpret=False):
+    """Flash attention over [B, T, H, hd] q and [B, S, H, hd] k/v.
+
+    ``kpad_bias``: additive float [B, S] bias (0 keep / -1e30 drop for
+    boolean masks). ``seed``: int32 scalar array enabling dropout at
+    ``dropout_rate``. Fully-masked rows produce an undefined (zero-ish)
+    output, matching softmax-of-all-masked degeneracy in the jnp path.
+    """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     block_q = min(block_q, q.shape[1])
     block_k = min(block_k, k.shape[1])
-    return _flash_fwd(q, k, v, scale, block_q, block_k, interpret)
+    o, _ = _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
+                           dropout_rate, block_q, block_k, interpret)
+    return o
 
 
-def _fa_fwd(q, k, v, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
-
-
-def _fa_bwd(scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
+def _fa_fwd(q, k, v, kpad_bias, seed, scale, causal, window, dropout_rate,
+            block_q, block_k, interpret):
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
-    # Recompute-based backward: standard softmax transpose, fused by XLA.
-    from smdistributed_modelparallel_tpu.ops.attention import causal_window_mask
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    o, lse = _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
+                             dropout_rate, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse, kpad_bias, seed)
 
-    qf, kf, vf, gf = (x.astype(jnp.float32) for x in (q, k, v, g))
-    s = jnp.einsum("bthd,bshd->bhts", qf, kf) * scale
-    T = q.shape[1]
-    mask = causal_window_mask(T, T)
-    s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    dv = jnp.einsum("bhts,bthd->bshd", p, gf)
-    dp = jnp.einsum("bthd,bshd->bhts", gf, vf)
-    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
-    ds = jnp.where(mask[None, None], ds, 0.0) * scale
-    dq = jnp.einsum("bhts,bshd->bthd", ds, kf)
-    dk = jnp.einsum("bhts,bthd->bshd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+def _fa_bwd(scale, causal, window, dropout_rate, block_q, block_k, interpret,
+            res, g):
+    q, k, v, o, lse, kpad_bias, seed = res
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, o, g, lse, kpad_bias, seed, scale, causal, window,
+        dropout_rate, block_q, block_k, interpret,
+    )
+    return dq, dk, dv, None, None
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -20,6 +20,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# XLA:CPU runs f32 matmuls at bf16 precision on AVX512-BF16 hosts; parity
+# tests compare two differently-fused programs, so pin exact f32 matmuls.
+jax.config.update("jax_default_matmul_precision", "highest")
 
 # Persistent compilation cache: pipeline tests pay many multi-second XLA
 # compiles; cache them across runs (reference keeps a fast unit tier by
