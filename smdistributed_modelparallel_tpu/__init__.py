@@ -54,6 +54,7 @@ from smdistributed_modelparallel_tpu.nn.tp_registry import (
     tp_register,
     tp_register_with_module,
 )
+from smdistributed_modelparallel_tpu.nn.huggingface import from_hf
 from smdistributed_modelparallel_tpu import nn
 
 __version__ = "0.1.0"

@@ -51,6 +51,11 @@ class ModelParallelState:
 
         register_builtins(self.tp_registry)
         install_construction_hooks()
+        from smdistributed_modelparallel_tpu.nn.huggingface import (
+            register_predefined_hooks,
+        )
+
+        register_predefined_hooks(self.tp_registry)
         if cfg.fp16:
             from smdistributed_modelparallel_tpu.fp16.loss_scaler import (
                 DynamicLossScaler,

@@ -255,9 +255,11 @@ def _maybe_translate_to_hf(model, sd):
     """Translate a gathered state dict to the original (HF) layout when the
     root module has registered translate functions (parity: reference
     ``translate_if_full``, ``torch/nn/predefined_hooks.py:82-151``)."""
-    if model is None or state.tp_registry is None:
+    if model is None:
         return sd
-    fns = state.tp_registry.translate_functions(type(model.module))
+    fns = getattr(model, "_translate_functions", None)
+    if fns is None and state.tp_registry is not None:
+        fns = state.tp_registry.translate_functions(type(model.module))
     if fns is None:
         return sd
     to_hf = fns[0] if isinstance(fns, (tuple, list)) else fns

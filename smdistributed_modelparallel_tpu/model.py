@@ -49,12 +49,18 @@ class DistributedModel:
       trace_device: device used for the one-time eager init run.
     """
 
-    def __init__(self, module, rngs=("dropout",), name="main"):
+    def __init__(self, module, rngs=("dropout",), name="main",
+                 translate_functions=None):
         if state.cfg is None:
             raise SMPValidationError("Call smp.init(config) before DistributedModel().")
         self.module = module
         self.name = name
         self.rng_streams = tuple(rngs)
+        # (to_hf, from_hf) state-dict translators for this instance (set by
+        # smp.from_hf); checkpoint translate_if_full prefers these over the
+        # class-keyed registry entry (several HF families share one
+        # distributed class).
+        self._translate_functions = translate_functions
         self._params = None               # materialized param pytree (jax.Arrays)
         self._param_shardings = None      # pytree of NamedSharding
         self._grads_store = None          # ("avg", tree) | ("raw", tree, divisor, avg_cache)

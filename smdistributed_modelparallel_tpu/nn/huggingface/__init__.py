@@ -1,0 +1,193 @@
+"""HuggingFace model-family translation registry.
+
+Parity target: reference ``torch/nn/predefined_hooks.py:56-168``
+(``PredefinedHookManager``): maps HF classes to distributed classes with
+init-hook argument translation and bidirectional state-dict translators,
+registered into the tp_registry at init.
+
+TPU-native flow: HF models are torch modules, so "re-instantiation" means
+building the equivalent ``smp.nn.DistributedTransformerLMHead`` from the HF
+config (``config_to_smp``) and translating the torch state dict into the
+stacked-flax layout (``translate_hf_state_dict``). ``smp.from_hf`` is the
+one-call entry point; full (non-partial) checkpoints translate back to HF
+naming through the registered ``translate_state_dict_to_hf``.
+"""
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from smdistributed_modelparallel_tpu.utils.exceptions import SMPValidationError
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+
+@dataclass(frozen=True)
+class HFFamily:
+    name: str
+    architectures: tuple
+    config_to_smp: Callable
+    translate_from_hf: Optional[Callable]  # hf sd -> flat smp dict
+    translate_to_hf: Optional[Callable]    # flat smp dict -> hf sd
+
+
+def _families():
+    from smdistributed_modelparallel_tpu.nn.huggingface import (
+        bert, gpt2, gptj, gptneox,
+    )
+
+    fams = {}
+    for name, mod in (
+        ("gpt2", gpt2), ("gptj", gptj), ("gptneox", gptneox), ("bert", bert),
+    ):
+        fams[name] = HFFamily(
+            name=name,
+            architectures=mod.HF_ARCHITECTURES,
+            config_to_smp=mod.config_to_smp,
+            translate_from_hf=mod.translate_hf_state_dict,
+            translate_to_hf=mod.translate_state_dict_to_hf,
+        )
+    return fams
+
+
+_FAMILIES_CACHE = None
+
+
+def families():
+    global _FAMILIES_CACHE
+    if _FAMILIES_CACHE is None:
+        _FAMILIES_CACHE = _families()
+    return _FAMILIES_CACHE
+
+
+def family_for(config_or_model):
+    """Resolve the HFFamily for a transformers model, config, or an
+    architecture-name string."""
+    if isinstance(config_or_model, str):
+        candidates = [config_or_model]
+    else:
+        config = getattr(config_or_model, "config", config_or_model)
+        candidates = [type(config_or_model).__name__]
+        candidates += list(getattr(config, "architectures", None) or [])
+        # Config-class fallback: GPT2Config -> model_type "gpt2".
+        mt = getattr(config, "model_type", None)
+        if mt:
+            candidates.append(mt)
+    for fam in families().values():
+        for cand in candidates:
+            norm = cand.lower().replace("-", "").replace("_", "")
+            if cand in fam.architectures or norm == fam.name:
+                return fam
+    raise SMPValidationError(
+        f"No HF translation registered for {candidates}; supported "
+        f"architectures: "
+        f"{[a for f in families().values() for a in f.architectures]}"
+    )
+
+
+def translate_model(model_or_config, **overrides):
+    """Build the DistributedTransformerLMHead for an HF model/config.
+
+    Returns ``(module, flat_params_or_None, family)`` — flat_params is the
+    translated state dict when a model (with weights) was given, or None
+    for a bare config.
+    """
+    from smdistributed_modelparallel_tpu.nn.transformer import (
+        DistributedTransformerLMHead,
+    )
+
+    fam = family_for(model_or_config)
+    config = getattr(model_or_config, "config", model_or_config)
+    kwargs = fam.config_to_smp(config)
+    kwargs.update(overrides)
+    module = DistributedTransformerLMHead(**kwargs)
+    flat = None
+    if hasattr(model_or_config, "state_dict"):
+        flat = fam.translate_from_hf(model_or_config.state_dict(), config=config)
+    return module, flat, fam
+
+
+def register_predefined_hooks(registry):
+    """Register HF classes in the tp_registry (parity: reference
+    ``PredefinedHookManager``). Lazy: transformers is imported only if
+    present; absence is not an error."""
+    try:
+        import transformers
+    except Exception:  # pragma: no cover - transformers always in image
+        logger.debug("transformers unavailable; HF hooks not registered.")
+        return
+
+    from smdistributed_modelparallel_tpu.nn.transformer import (
+        DistributedTransformerLMHead,
+    )
+
+    for fam in families().values():
+        for arch in fam.architectures:
+            hf_cls = getattr(transformers, arch, None)
+            if hf_cls is None:
+                continue
+
+            def _init_hook(config, _fam=fam, **kw):
+                out = _fam.config_to_smp(config)
+                out.update(kw)
+                return (), out
+
+            # translate_functions deliberately NOT registered here: the
+            # registry keys them by distributed class, and all four
+            # families share DistributedTransformerLMHead — the accurate
+            # channel is the per-instance functions smp.from_hf installs.
+            registry.register(
+                hf_cls,
+                DistributedTransformerLMHead,
+                init_hook=_init_hook,
+            )
+
+    # T5: layer-level only (T5Block -> DistributedTransformerLayer), the
+    # reference's scope; the relative-attention-bias block is declined by
+    # the hook returning None.
+    t5_block = getattr(
+        getattr(getattr(transformers, "models", None), "t5", None),
+        "modeling_t5", None,
+    )
+    t5_block = getattr(t5_block, "T5Block", None)
+    if t5_block is not None:
+        from smdistributed_modelparallel_tpu.nn.huggingface import t5
+        from smdistributed_modelparallel_tpu.nn.transformer import (
+            DistributedTransformerLayer,
+        )
+
+        def _t5_init_hook(config, has_relative_attention_bias=False, **kw):
+            out = t5.config_to_smp_layer(config, has_relative_attention_bias)
+            if out is None:
+                return None
+            out.update(kw)
+            return (), out
+
+        registry.register(
+            t5_block, DistributedTransformerLayer, init_hook=_t5_init_hook
+        )
+
+
+def from_hf(model_or_config, rngs=("dropout",), **overrides):
+    """One-call HF entry point: build + wrap + stage weights.
+
+    ``smp.from_hf(hf_model_or_config)`` returns an ``smp.DistributedModel``
+    whose parameters load from the translated HF weights on first use, and
+    whose full checkpoints translate back to HF naming
+    (``translate_if_full`` parity, reference
+    ``torch/nn/predefined_hooks.py:82-151``).
+    """
+    from smdistributed_modelparallel_tpu.backend.state import state
+    from smdistributed_modelparallel_tpu.model import DistributedModel
+
+    module, flat, fam = translate_model(model_or_config, **overrides)
+    model = DistributedModel(
+        module, rngs=rngs,
+        translate_functions=(fam.translate_to_hf, fam.translate_from_hf),
+    )
+    if flat is not None:
+        if state.loaded_model_state is not None:
+            logger.warning("Overwriting previously staged checkpoint state "
+                           "with HF weights.")
+        state.loaded_model_state = flat
+    return model

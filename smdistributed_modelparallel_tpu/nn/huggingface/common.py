@@ -35,6 +35,18 @@ PROJ_W = f"{L}/output/proj/kernel"
 PROJ_B = f"{L}/output/proj/bias"
 
 
+def act_from_hf(name):
+    """HF activation name -> ours. HF's "gelu" is the EXACT erf form
+    (ACT2FN); "gelu_new"/"gelu_pytorch_tanh" are the tanh approximation
+    (our "gelu")."""
+    return {
+        "gelu": "gelu_erf",
+        "gelu_new": "gelu",
+        "gelu_pytorch_tanh": "gelu",
+        "relu": "relu",
+    }[name]
+
+
 def to_np(t):
     """torch tensor / array -> numpy."""
     if hasattr(t, "detach"):

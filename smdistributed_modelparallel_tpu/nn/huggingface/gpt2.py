@@ -35,7 +35,7 @@ def config_to_smp(config):
         "attention_head_size": config.n_embd // config.n_head,
         "hidden_size": config.n_embd,
         "vocab_size": config.vocab_size,
-        "activation": "gelu" if config.activation_function != "relu" else "relu",
+        "activation": c.act_from_hf(config.activation_function),
         "add_lm_head": True,
         "tie_input_output_embedding": True,
         "intermediate_size": (

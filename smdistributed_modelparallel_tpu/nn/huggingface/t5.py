@@ -1,0 +1,47 @@
+"""HF T5 translation hooks.
+
+Parity target: reference ``torch/nn/huggingface/t5.py`` — which supports T5
+at the LAYER level only (``T5Block`` -> ``DistributedTransformerLayer``),
+declines the relative-attention-bias layer (the first block of each stack
+stays undistributed), and ships NO state-dict translators. The same scope
+applies here: ``config_to_smp_layer`` produces
+``DistributedTransformerLayer`` kwargs for non-bias blocks; blocks with
+``has_relative_attention_bias`` return None (kept undistributed), mirroring
+``hf_t5_transformer_layer_init_hook`` (reference ``t5.py:11-31``).
+
+Note: HF T5 uses RMSNorm (no bias/mean); the reference maps it onto its
+standard-LayerNorm DistributedTransformerLayer with the same approximation
+made here. Full-model T5 (enc-dec with relative bias) is intentionally out
+of scope, as in the reference.
+"""
+
+from smdistributed_modelparallel_tpu.utils.exceptions import SMPValidationError
+
+HF_ARCHITECTURES = ("T5Block",)
+
+
+def config_to_smp_layer(config, has_relative_attention_bias=False):
+    """HF T5Config (+ block flag) -> DistributedTransformerLayer kwargs, or
+    None for the relative-bias block (left undistributed)."""
+    if has_relative_attention_bias:
+        return None
+    if config.d_kv * config.num_heads != config.d_model:
+        raise SMPValidationError(
+            f"d_kv ({config.d_kv}) * num_heads ({config.num_heads}) must "
+            f"equal d_model ({config.d_model}) for T5."
+        )
+    return {
+        "num_attention_heads": config.num_heads,
+        "attention_head_size": config.d_kv,
+        "hidden_size": config.d_model,
+        "intermediate_size": config.d_ff,
+        "attention_dropout_prob": config.dropout_rate,
+        "hidden_dropout_prob": config.dropout_rate,
+        "add_cross_attention": bool(config.is_decoder),
+        "causal_mask_size": config.n_positions if config.is_decoder and hasattr(config, "n_positions") else None,
+        "pre_layernorm": True,
+        "post_layernorm": False,
+        "use_qkv_bias": False,
+        "use_attn_dense_bias": False,
+        "scale_attention_scores": False,  # T5 does not scale by 1/sqrt(hd)
+    }

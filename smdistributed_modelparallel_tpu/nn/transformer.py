@@ -67,6 +67,9 @@ def _activation(name):
     return {
         "gelu": lambda x: nn.gelu(x, approximate=True),
         "gelu_new": lambda x: nn.gelu(x, approximate=True),
+        # Exact erf gelu (HF BERT's "gelu"; the tanh approximation above is
+        # HF's "gelu_new" and the reference's fused bias_gelu).
+        "gelu_erf": lambda x: nn.gelu(x, approximate=False),
         "relu": nn.relu,
         "silu": nn.silu,
         "swish": nn.silu,
@@ -415,10 +418,16 @@ class DistributedTransformerLayer(nn.Module):
         x = hidden
 
         if self.parallel_attn_output:
-            # GPT-J style: one LN, attention and MLP in parallel off it.
+            # Parallel residual: GPT-J style shares one LN
+            # (single_pre_layernorm); GPT-NeoX style (pre_layernorm, two
+            # LNs) feeds the MLP from its own post-attention layernorm.
             h = ln("attention/layernorm")(x)
+            if self.pre_layernorm and not self.single_pre_layernorm:
+                h_mlp = ln("output/layernorm")(x)
+            else:
+                h_mlp = h
             a = attn(h, attention_mask=attention_mask, xs=xs)
-            m = mlp(h)
+            m = mlp(h_mlp)
             x = (x.astype(res_dtype) + a.astype(res_dtype) + m.astype(res_dtype)).astype(hidden.dtype)
             return x
 

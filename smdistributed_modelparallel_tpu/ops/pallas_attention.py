@@ -328,7 +328,7 @@ def _prep(q, k, v, block_q, block_k):
     return qt, kt, vt, (B, T, S, H, hd, hd_pad, t_pad, s_pad)
 
 
-def _common_inputs(kpad_bias, seed, s_pad, H, interpret):
+def _common_inputs(kpad_bias, seed, s_pad, B, H, interpret):
     """(extra_inputs, extra_specs, has_kpm, has_seed) shared by all kernels."""
     inputs, specs = [], []
     has_kpm = kpad_bias is not None
@@ -337,6 +337,10 @@ def _common_inputs(kpad_bias, seed, s_pad, H, interpret):
         kpm = kpad_bias.astype(jnp.float32)
         if s_pad != S:
             kpm = jnp.pad(kpm, ((0, 0), (0, s_pad - S)), constant_values=NEG_INF)
+        if kpm.shape[0] != B:
+            # Broadcast batch dim: the index_map below computes b // H and
+            # must never address past the array's blocks.
+            kpm = jnp.broadcast_to(kpm, (B, s_pad))
         inputs.append(kpm)
         specs.append(pl.BlockSpec((1, s_pad), lambda b, i: (b // H, 0)))
     has_seed = seed is not None
@@ -355,7 +359,7 @@ def _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
         q, k, v, block_q, block_k
     )
     extra, extra_specs, has_kpm, has_seed = _common_inputs(
-        kpad_bias, seed, s_pad, H, interpret
+        kpad_bias, seed, s_pad, B, H, interpret
     )
     grid = (B * H, t_pad // block_q)
     kern = functools.partial(
@@ -403,7 +407,7 @@ def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
         delta = jnp.pad(delta, ((0, 0), (0, 0), (0, t_pad - T)))
 
     extra, extra_specs, has_kpm, has_seed = _common_inputs(
-        kpad_bias, seed, s_pad, H, interpret
+        kpad_bias, seed, s_pad, B, H, interpret
     )
     common = dict(
         scale=scale, block_q=block_q, block_k=block_k, q_len=T, kv_len=S,

@@ -1,0 +1,196 @@
+"""HF model-family translation tests.
+
+Parity targets: reference ``torch/nn/predefined_hooks.py`` registration and
+the per-family translators (``torch/nn/huggingface/*``). The strongest
+check is logits parity: a randomly-initialized HF torch model's forward
+must match our translated flax model's forward.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import smdistributed_modelparallel_tpu as smp
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _tiny_configs():
+    return {
+        "gpt2": transformers.GPT2Config(
+            n_embd=32, n_layer=2, n_head=2, vocab_size=64, n_positions=32,
+            attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0,
+        ),
+        "gptj": transformers.GPTJConfig(
+            n_embd=32, n_layer=2, n_head=2, vocab_size=64, n_positions=32,
+            rotary_dim=8, attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0,
+            tie_word_embeddings=False,
+        ),
+        "gptneox": transformers.GPTNeoXConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=64, vocab_size=64, max_position_embeddings=32,
+            rotary_pct=0.5, tie_word_embeddings=False,
+            attention_dropout=0.0, hidden_dropout=0.0,
+        ),
+        "bert": transformers.BertConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=64, vocab_size=64, max_position_embeddings=32,
+            type_vocab_size=2, attention_probs_dropout_prob=0.0,
+            hidden_dropout_prob=0.0,
+        ),
+    }
+
+
+def _hf_model(name, config):
+    cls = {
+        "gpt2": transformers.GPT2LMHeadModel,
+        "gptj": transformers.GPTJForCausalLM,
+        "gptneox": transformers.GPTNeoXForCausalLM,
+        "bert": transformers.BertModel,
+    }[name]
+    torch.manual_seed(0)
+    m = cls(config)
+    m.eval()
+    return m
+
+
+def _hf_logits(name, hf, ids):
+    with torch.no_grad():
+        t_ids = torch.tensor(np.asarray(ids))
+        if name == "bert":
+            out = hf(t_ids, token_type_ids=torch.zeros_like(t_ids))
+            return out.last_hidden_state.numpy()
+        return hf(t_ids).logits.numpy()
+
+
+class TestLogitsParity:
+    @pytest.mark.parametrize("name", ["gpt2", "gptj", "gptneox", "bert"])
+    def test_forward_matches_hf(self, name):
+        config = _tiny_configs()[name]
+        hf = _hf_model(name, config)
+        smp.reset()
+        smp.init({})
+        model = smp.from_hf(hf, deterministic=True)
+        ids = jax.random.randint(jax.random.key(0), (2, 16), 0, 64)
+        if name == "bert":
+            ours = np.asarray(
+                model(ids, token_type_ids=jnp.zeros_like(ids))
+            )
+        else:
+            ours = np.asarray(model(ids))
+        ref = _hf_logits(name, hf, ids)
+        np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("name", ["gpt2", "gptj", "gptneox", "bert"])
+    def test_state_dict_round_trip(self, name):
+        """hf -> smp -> hf is the identity on every tensor."""
+        from smdistributed_modelparallel_tpu.nn import huggingface as hfmod
+
+        config = _tiny_configs()[name]
+        hf = _hf_model(name, config)
+        fam = hfmod.family_for(hf)
+        sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+        flat = fam.translate_from_hf(sd, config=config)
+        back = fam.translate_to_hf(flat, config=config)
+        for k, v in back.items():
+            if k not in sd:
+                continue  # e.g. synthesized tied lm_head
+            np.testing.assert_allclose(
+                np.asarray(v), sd[k], atol=1e-6, err_msg=f"{name}:{k}"
+            )
+
+    def test_registry_has_predefined_hooks(self):
+        smp.reset()
+        smp.init({})
+        from smdistributed_modelparallel_tpu.backend.state import state
+
+        assert state.tp_registry.is_supported(transformers.GPT2LMHeadModel)
+        assert state.tp_registry.is_supported(transformers.GPTJForCausalLM)
+        assert state.tp_registry.is_supported(transformers.GPTNeoXForCausalLM)
+        assert state.tp_registry.is_supported(transformers.BertModel)
+
+
+@pytest.mark.slow
+class TestEndToEnd:
+    def test_gpt2_tp4_train_save_full_reload(self, tmp_path):
+        """VERDICT r2 done-criterion: load an HF GPT-2 checkpoint, train one
+        step under tp4, save a full checkpoint back to HF naming, reload it
+        into a fresh HF model."""
+        config = transformers.GPT2Config(
+            n_embd=32, n_layer=2, n_head=4, vocab_size=64, n_positions=32,
+            attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0,
+        )
+        hf = _hf_model("gpt2", config)
+        smp.reset()
+        smp.init({"tensor_parallel_degree": 4, "ddp": True, "microbatches": 2})
+        model = smp.from_hf(hf, deterministic=True)
+        opt = smp.DistributedOptimizer(optax.sgd(0.01), model)
+
+        @smp.step
+        def train_step(model, ids):
+            logits = model(ids)
+            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+            loss = -jnp.mean(
+                jnp.take_along_axis(logp, ids[:, 1:, None], axis=-1)
+            )
+            model.backward(loss)
+            return loss
+
+        ids = jax.random.randint(jax.random.key(1), (4, 16), 0, 64)
+        out = train_step(model, ids)
+        opt.step()
+        assert np.isfinite(float(out.reduce_mean()))
+
+        # Weights actually came from HF (not random re-init).
+        wte = np.asarray(jax.device_get(model.params["word_embedding"]["embedding"]))
+        np.testing.assert_raises(
+            AssertionError, np.testing.assert_allclose, wte,
+            hf.state_dict()["transformer.wte.weight"].numpy(), 1e-3,
+        )  # trained for a step, so it moved...
+        smp.save_checkpoint(str(tmp_path), tag="final", model=model,
+                            partial=False, translate_if_full=True)
+
+        import pickle
+
+        with open(tmp_path / "final", "rb") as fh:
+            payload = pickle.load(fh)
+        sd = payload["model"]
+        assert "transformer.wte.weight" in sd  # HF naming
+        fresh = _hf_model("gpt2", config)
+        fresh.load_state_dict(
+            {k: torch.tensor(np.asarray(v)) for k, v in sd.items()}
+        )
+        np.testing.assert_allclose(
+            fresh.state_dict()["transformer.wte.weight"].numpy(), wte, atol=1e-6
+        )
+
+
+class TestT5Hooks:
+    def test_layer_hook_scope_matches_reference(self):
+        """T5 support is layer-level, and the relative-attention-bias block
+        is declined (left undistributed) — reference t5.py:11-31."""
+        from smdistributed_modelparallel_tpu.nn.huggingface import t5
+
+        config = transformers.T5Config(
+            d_model=32, d_kv=8, num_heads=4, d_ff=64, num_layers=2,
+            vocab_size=64, dropout_rate=0.0, is_decoder=False,
+        )
+        assert t5.config_to_smp_layer(config, has_relative_attention_bias=True) is None
+        kw = t5.config_to_smp_layer(config)
+        assert kw["num_attention_heads"] == 4
+        assert kw["scale_attention_scores"] is False
+        from smdistributed_modelparallel_tpu.nn.transformer import (
+            DistributedTransformerLayer,
+        )
+
+        layer = DistributedTransformerLayer(**kw, deterministic=True)
+        x = jnp.ones((1, 8, 32))
+        v = layer.init(jax.random.key(0), x)
+        out = layer.apply(v, x)
+        assert out.shape == x.shape
