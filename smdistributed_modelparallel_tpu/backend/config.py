@@ -121,6 +121,12 @@ class ModelParallelConfig:
                     logger.warning(
                         "Config '%s' is deprecated; use '%s'.", key, spec.get("replacement")
                     )
+                if spec.get("advisory") and value != spec["default"]:
+                    logger.warning(
+                        "Config '%s' is advisory on TPU (%s); accepted for "
+                        "reference compatibility but has no effect.",
+                        key, spec["advisory"],
+                    )
             else:
                 value = spec["default"]
                 if isinstance(value, str) and _FORMULA_REF.search(value) and spec["type"] is int:
@@ -132,6 +138,12 @@ class ModelParallelConfig:
                 self._check_bounds(key, spec, value, values)
                 self._check_options(key, spec, value)
             values[key] = value
+
+        # The ZeRO-2D JSON overrides land BEFORE constraint checking so the
+        # keys it sets go through the same requires/cross validation as
+        # directly-specified values.
+        if values.get("_sharded_data_parallelism_config") is not None:
+            self._apply_sdp_json(values)
 
         for key, spec in SCHEMA.items():
             self._check_requires(key, spec, values)
@@ -203,6 +215,71 @@ class ModelParallelConfig:
             raise ConfigError("sharded_data_parallel_degree > 1 requires ddp: True")
         if v["offload_activations"] and v["activation_loading_horizon"] < 1:
             logger.warning("activation_loading_horizon=0 disables offload prefetch pipelining.")
+
+    def _apply_sdp_json(self, v):
+        """Parse ``_sharded_data_parallelism_config`` (a DeepSpeed-style
+        JSON file path or dict) onto the ``sdp_*`` knobs.
+
+        Parity: reference ``backend/zero_config.py:13-131`` — the custom
+        JSON recursively overrides the defaults built from the sdp_*
+        params; stage must be 3. Keys with no TPU counterpart (DeepSpeed
+        scheduler/engine options) are accepted as advisory with a warning.
+        """
+        import json
+        import os
+
+        raw = v["_sharded_data_parallelism_config"]
+        if isinstance(raw, str):
+            if not os.path.exists(raw):
+                raise ConfigError(
+                    f"_sharded_data_parallelism_config file not found: {raw}"
+                )
+            with open(raw, encoding="utf-8") as fh:
+                raw = json.load(fh)
+        if not isinstance(raw, dict):
+            raise ConfigError(
+                "_sharded_data_parallelism_config must be a dict or a JSON "
+                f"file path (got {type(raw).__name__})."
+            )
+        zo = raw.get("zero_optimization", {})
+        if not isinstance(zo, dict):
+            raise ConfigError("zero_optimization must be a dict.")
+        if zo.get("stage", 3) != 3:
+            raise ConfigError(
+                "Only ZeRO stage 3 is supported in "
+                "_sharded_data_parallelism_config (reference parity)."
+            )
+        if zo.get("offload_optimizer") or zo.get("offload_param"):
+            raise ConfigError(
+                "cpu offload in _sharded_data_parallelism_config is not "
+                "supported (reference parity)."
+            )
+        mapping = {
+            "reduce_bucket_size": "sdp_reduce_bucket_size",
+            "stage3_param_persistence_threshold": "sdp_param_persistence_threshold",
+            "stage3_max_live_parameters": "sdp_max_live_parameters",
+            "zero2d_hierarchy_allgather": "sdp_hierarchical_allgather",
+            "zero2d_shard_size": "sharded_data_parallel_degree",
+        }
+        consumed = {"stage", "offload_optimizer", "offload_param"}
+        for src, dst in mapping.items():
+            if src in zo:
+                v[dst] = _coerce(dst, zo[src], SCHEMA[dst]["type"])
+                self._check_bounds(dst, SCHEMA[dst], v[dst], v)
+                consumed.add(src)
+        if "gradient_clipping" in raw:
+            v["sdp_gradient_clipping"] = _coerce(
+                "sdp_gradient_clipping", raw["gradient_clipping"],
+                SCHEMA["sdp_gradient_clipping"]["type"],
+            )
+        advisory = sorted(set(zo) - consumed) + sorted(
+            set(raw) - {"zero_optimization", "gradient_clipping"}
+        )
+        if advisory:
+            logger.warning(
+                "_sharded_data_parallelism_config keys with no TPU "
+                "counterpart (advisory, ignored): %s", advisory,
+            )
 
     # -- accessors ------------------------------------------------------
 

@@ -50,6 +50,7 @@ SCHEMA = {
         "then-all-backward.",
     },
     "horovod": {
+        "advisory": "SPMD collectives replace horovod",
         "type": bool,
         "default": False,
         "description": "Reference-compat flag (TF/Horovod DP). Accepted, unused on TPU.",
@@ -110,12 +111,14 @@ SCHEMA = {
         "description": "Global grad-norm clip value applied under sharded data parallelism.",
     },
     "_sharded_data_parallelism_config": {
-        "type": (str, type(None)),
+        "type": (str, dict, type(None)),
         "default": None,
         "internal": True,
-        "description": "Path to a JSON file overriding sharded-DP settings.",
+        "description": "DeepSpeed-style sharded-DP overrides: a JSON file "
+        "path or an inline dict (zero_optimization.* keys map onto sdp_*).",
     },
     "ddp_port": {
+        "advisory": "no TCP rendezvous under the JAX runtime",
         "type": (int, type(None)),
         "default": None,
         "lower_bound": 0,
@@ -131,6 +134,7 @@ SCHEMA = {
         "'nccl' is accepted for config compatibility and treated as 'xla'.",
     },
     "contiguous": {
+        "advisory": "TF-runtime key; the single JAX runtime has no graph split",
         "type": bool,
         "default": True,
         "description": "Force pipeline stages to be contiguous layer ranges "
@@ -195,6 +199,7 @@ SCHEMA = {
         "activation memory of the pipeline schedule.",
     },
     "fast_mode": {
+        "advisory": "no MPMD message passing to shortcut",
         "type": bool,
         "default": False,
         "internal": True,
@@ -202,6 +207,7 @@ SCHEMA = {
         "direct stage-to-stage transfers; accepted and ignored.",
     },
     "static_mode": {
+        "advisory": "the compiled step IS static",
         "type": bool,
         "default": False,
         "internal": True,
@@ -241,6 +247,7 @@ SCHEMA = {
         "checkpointing.",
     },
     "_shard_offloaded_activations": {
+        "advisory": "XLA manages offload buffers",
         "type": bool,
         "default": True,
         "internal": True,
@@ -261,6 +268,7 @@ SCHEMA = {
         "them directly sharded on device (TPU: jax.eval_shape + sharded init).",
     },
     "skip_tracing": {
+        "advisory": "init/trace pass is shape-only and cheap",
         "type": bool,
         "default": False,
         "description": "Skip the cost-tracing pass; the auto-partitioner falls "
@@ -274,6 +282,7 @@ SCHEMA = {
         "be resident on device awaiting consumption.",
     },
     "task_level_activation_loading_horizon": {
+        "advisory": "XLA schedules host offload",
         "type": int,
         "default": 4,
         "lower_bound": 1,
@@ -281,6 +290,7 @@ SCHEMA = {
         "description": "Reference-compat scheduling knob; advisory on TPU.",
     },
     "herring": {
+        "advisory": "SPMD collectives replace herring",
         "type": bool,
         "default": False,
         "requires": {"ddp": False, "horovod": False},
@@ -289,6 +299,7 @@ SCHEMA = {
         "description": "Reference-compat; not functional.",
     },
     "_match_weights": {
+        "advisory": "use the HF translators/parity tests instead",
         "type": bool,
         "default": False,
         "internal": True,
@@ -303,6 +314,7 @@ SCHEMA = {
         "description": "Accumulate microbatch gradients in float32.",
     },
     "checkpoint_attentions": {
+        "advisory": "use activation-checkpointing configs (smp.set_activation_checkpointing) — remat granularity is the layer",
         "type": bool,
         "default": False,
         "internal": True,

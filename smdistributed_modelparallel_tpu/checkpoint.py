@@ -259,7 +259,14 @@ def _maybe_translate_to_hf(model, sd):
         return sd
     fns = getattr(model, "_translate_functions", None)
     if fns is None and state.tp_registry is not None:
-        fns = state.tp_registry.translate_functions(type(model.module))
+        from smdistributed_modelparallel_tpu.nn.auto_distribute import (
+            HookedModule,
+        )
+
+        mod = model.module
+        if isinstance(mod, HookedModule):
+            mod = mod.inner
+        fns = state.tp_registry.translate_functions(type(mod))
     if fns is None:
         return sd
     to_hf = fns[0] if isinstance(fns, (tuple, list)) else fns

@@ -207,11 +207,57 @@ class DistributedModel:
 
         Parity note: this is the reference's first-step tracing moment
         (``torch/worker.py:248-278``); here it both creates params and
-        gives the partitioner concrete shapes.
+        gives the partitioner concrete shapes. Under
+        ``delayed_parameter_initialization`` parameters are born sharded
+        (never materialized whole on one device).
         """
+        if state.cfg is not None and state.cfg.delayed_parameter_initialization:
+            self._sharded_init(args, kwargs)
+            return
         logger.info("Initializing model parameters from first batch shapes.")
         variables = jax.jit(self.module.init)(self._init_rngs(), *args, **kwargs)
         params = variables["params"]
+        self._set_params(params)
+
+    def _sharded_init(self, args, kwargs):
+        """Delayed (sharded) parameter initialization.
+
+        Parity: reference ``delay_param_initialization``
+        (``torch/parameter.py:24-123`` + ``torch/model.py:511-584``,
+        torchdistx deferred init: parameters materialize only on their
+        owning rank after partitioning). TPU-native: ``jax.eval_shape`` the
+        init to learn shapes + sharding metadata, build the NamedShardings
+        from the registered specs, then compile the init with
+        ``out_shardings`` so every parameter materializes directly in its
+        sharded placement — per-device init memory is the shard, not the
+        tree.
+        """
+        from flax.core import meta as flax_meta
+
+        logger.info("Delayed init: materializing parameters directly sharded.")
+        rngs = self._init_rngs()
+        aval_vars = jax.eval_shape(
+            lambda r, a, kw: self.module.init(r, *a, **kw), rngs, args, kwargs
+        )
+        aval_params = self._adopt_param_metadata(aval_vars["params"])
+        self.module_manager.record_param_tree(aval_params)
+        mesh = state.mesh
+        shardings = self.module_manager.param_shardings(mesh, aval_params)
+
+        def init_unboxed(r, a, kw):
+            return flax_meta.unbox(self.module.init(r, *a, **kw)["params"])
+
+        with jax.set_mesh(mesh):
+            compiled = (
+                jax.jit(init_unboxed, out_shardings=shardings)
+                .lower(rngs, args, kwargs)
+                .compile()
+            )
+            try:
+                self._init_memory_analysis = compiled.memory_analysis()
+            except Exception:  # pragma: no cover - backend-specific
+                self._init_memory_analysis = None
+            params = compiled(rngs, args, kwargs)
         self._set_params(params)
 
     def _set_params(self, params):

@@ -19,6 +19,7 @@ reference's guidance to use smp.nn for custom internals.
 """
 
 import dataclasses
+from typing import Callable, Optional
 
 import flax.linen as nn
 
@@ -26,6 +27,41 @@ from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 
 logger = get_logger()
+
+
+class HookedModule(nn.Module):
+    """Transparent wrapper applying registered forward/return hooks.
+
+    Parity: reference ``DistributedModule.__call__``
+    (``torch/nn/dist_module.py:5-32``) — the forward hook translates the
+    original module's call signature into the distributed module's, the
+    return hook translates the output back. ``nn.share_scope`` keeps the
+    inner module's parameter paths unchanged (the wrapper adds no scope
+    level).
+    """
+
+    inner: nn.Module
+    fwd_hook: Optional[Callable] = None
+    ret_hook: Optional[Callable] = None
+
+    def setup(self):
+        nn.share_scope(self, self.inner)
+
+    def __call__(self, *args, **kwargs):
+        if self.fwd_hook is not None:
+            args, kwargs = self.fwd_hook(*args, **kwargs)
+        out = self.inner(*args, **kwargs)
+        if self.ret_hook is not None:
+            out = self.ret_hook(out)
+        return out
+
+    @nn.nowrap
+    def pipeline_spec(self):
+        """Delegate pipeline discovery to the wrapped module."""
+        fn = getattr(self.inner, "pipeline_spec", None)
+        if fn is None:
+            return None
+        return fn() if callable(fn) else fn
 
 _hooks_installed = False
 _TP_MARK = "_smp_tp_mark"
@@ -140,8 +176,9 @@ def distribute_tree(module, mm=None, registry=None, prefix=""):
                 dist = registry.distribute(
                     type(value), (), _module_fields(value), tp_config=tp_cfg
                 )
-                replaced.append(path)
-                return dist
+                if dist is not None:
+                    replaced.append(path)
+                    return dist
             return visit(value, path)
         if isinstance(value, (list, tuple)):
             new = [
@@ -163,8 +200,9 @@ def distribute_tree(module, mm=None, registry=None, prefix=""):
         dist = registry.distribute(
             type(module), (), _module_fields(module), tp_config=root_cfg
         )
-        replaced.append(prefix or "<root>")
-        return dist, replaced
+        if dist is not None:
+            replaced.append(prefix or "<root>")
+            return dist, replaced
 
     new_module = visit(module, prefix)
     if replaced:
@@ -179,12 +217,28 @@ def distribute_tree(module, mm=None, registry=None, prefix=""):
 
 
 def _dense_init_hook(*args, **fields):
-    from smdistributed_modelparallel_tpu.nn.linear import DistributedLinear
-
     keep = {
         "features": fields.get("features"),
         "use_bias": fields.get("use_bias", True),
     }
+    # flax's `dtype` is the COMPUTE dtype (params stay param_dtype=f32);
+    # DistributedLinear's `dtype` is the parameter-storage dtype, so
+    # mapping them across would silently degrade master weights. Compute
+    # dtype follows the input dtype in DistributedLinear, which preserves
+    # the common bf16-compute intent.
+    if fields.get("dtype") is not None:
+        logger.debug(
+            "nn.Dense dtype (compute) not mapped on distribution; "
+            "DistributedLinear computes in the input dtype."
+        )
+    import flax.linen as fnn
+
+    default_kinit = fnn.Dense.__dataclass_fields__["kernel_init"].default
+    if fields.get("kernel_init") not in (None, default_kinit):
+        logger.warning(
+            "nn.Dense kernel_init is replaced by DistributedLinear's "
+            "seed-consistent sharded initializer on distribution."
+        )
     return (), keep
 
 

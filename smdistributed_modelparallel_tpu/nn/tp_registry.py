@@ -46,14 +46,33 @@ class TensorParallelismRegistry:
         return init_hook, forward_hook, return_hook
 
     def distribute(self, origin_cls, args, kwargs, tp_config=None):
-        """Build the distributed counterpart of origin_cls(*args, **kwargs)."""
-        dist_cls, init_hook, _, _ = self._map[origin_cls]
+        """Build the distributed counterpart of origin_cls(*args, **kwargs).
+
+        Returns None when the init hook declines (reference T5 relative-
+        bias block). When forward/return hooks are registered, the module
+        is wrapped in a scope-sharing shim that applies them at call time
+        (parity: reference ``DistributedModule.__call__``,
+        ``torch/nn/dist_module.py:5-32``).
+        """
+        dist_cls, init_hook, forward_hook, return_hook = self._map[origin_cls]
         if init_hook is not None:
-            args, kwargs = init_hook(*args, **kwargs)
+            hooked = init_hook(*args, **kwargs)
+            if hooked is None:
+                return None
+            args, kwargs = hooked
         kwargs = dict(kwargs)
         if tp_config:
             kwargs.update(tp_config)
-        return dist_cls(*args, **kwargs)
+        module = dist_cls(*args, **kwargs)
+        if forward_hook is not None or return_hook is not None:
+            from smdistributed_modelparallel_tpu.nn.auto_distribute import (
+                HookedModule,
+            )
+
+            module = HookedModule(
+                inner=module, fwd_hook=forward_hook, ret_hook=return_hook
+            )
+        return module
 
     def translate_functions(self, dist_cls):
         return self._translate_functions.get(dist_cls)

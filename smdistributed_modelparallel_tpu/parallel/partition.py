@@ -28,7 +28,81 @@ def maybe_auto_partition(model):
         return
     from smdistributed_modelparallel_tpu.parallel.pipeline import partition_for_pipeline
 
-    assignment = partition_for_pipeline(model)
+    loaded = _maybe_load_partition(model)
+    if loaded is not None:
+        assignment = loaded
+    else:
+        assignment = partition_for_pipeline(model)
+        _maybe_save_partition(assignment)
     maybe_register_zero2d(model)
     model.module_manager.set_partition_assignment(assignment)
     model.post_partition(assignment)
+
+
+def _maybe_load_partition(model):
+    """``load_partition`` + ``partition_file``: reuse a saved stage
+    assignment instead of re-running the partitioner.
+
+    Parity: reference ``load_partition``/``partition_file``
+    (``backend/config.yaml``; the reference serializes
+    PartitioningAndTraceResults). The saved assignment is re-validated
+    against the model's current layer count, then installed through the
+    same pin path the manual partitioner uses.
+    """
+    import json
+    import os
+
+    cfg = state.cfg
+    if not cfg.load_partition:
+        return None
+    path = cfg.partition_file
+    if not path or not os.path.exists(path):
+        from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
+
+        raise PartitionError(
+            f"load_partition: True but partition_file not found: {path!r}"
+        )
+    with open(path, encoding="utf-8") as fh:
+        payload = json.load(fh)
+    if payload.get("pipeline_parallel_degree") != cfg.pipeline_parallel_degree:
+        from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
+
+        raise PartitionError(
+            "partition_file was saved for pipeline_parallel_degree="
+            f"{payload.get('pipeline_parallel_degree')}, current is "
+            f"{cfg.pipeline_parallel_degree}."
+        )
+    assignment = {k: int(v) for k, v in payload["assignment"].items()}
+    # Install as pins and re-derive boundaries so the pipeline spec and
+    # sharding providers are built exactly as in the computed path.
+    from smdistributed_modelparallel_tpu.parallel.pipeline import (
+        partition_for_pipeline,
+    )
+
+    for prefix, stage in assignment.items():
+        model.module_manager.set_partition(prefix, stage)
+    out = partition_for_pipeline(model)
+    logger.info("Loaded pipeline partition from %s.", path)
+    return out
+
+
+def _maybe_save_partition(assignment):
+    import json
+    import os
+
+    import jax
+
+    cfg = state.cfg
+    path = cfg.partition_file
+    if not path or cfg.load_partition:
+        return
+    if jax.process_index() != 0:
+        # One writer on shared filesystems (multi-host runs).
+        return
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({
+            "pipeline_parallel_degree": cfg.pipeline_parallel_degree,
+            "assignment": assignment,
+        }, fh, indent=1)
+    logger.info("Saved pipeline partition to %s.", path)

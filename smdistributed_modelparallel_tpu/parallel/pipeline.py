@@ -200,6 +200,41 @@ def _choose_boundaries(model, spec, pp):
         if not (0 <= idx < L):
             raise PartitionError(f"Pinned layer {idx} out of range [0, {L}).")
 
+    if not cfg.auto_partition:
+        # Manual partitioning (reference ``auto_partition: False`` +
+        # ``default_partition`` semantics, ``backend/config.yaml:150-170``,
+        # ``torch/module_manager.py:1061``): every layer goes to
+        # ``default_partition`` unless explicitly pinned with
+        # smp.set_partition.
+        default = cfg.default_partition
+        if default is None or not (0 <= default < pp):
+            raise PartitionError(
+                f"auto_partition: False requires default_partition in "
+                f"[0, {pp}) (got {default})."
+            )
+        stages = [pins.get(i, default) for i in range(L)]
+        if any(b < a for a, b in zip(stages, stages[1:])):
+            raise PartitionError(
+                f"Manual partition produced a non-contiguous stage order "
+                f"{stages}; the SPMD executor requires non-decreasing "
+                "stage assignments along the layer sequence."
+            )
+        bounds = []
+        start = 0
+        for s in range(pp):
+            end = start
+            while end < L and stages[end] == s:
+                end += 1
+            if end == start:
+                raise PartitionError(
+                    f"Manual partition leaves stage {s} empty "
+                    f"(stages={stages}); every pipeline stage needs at "
+                    "least one layer."
+                )
+            bounds.append((start, end))
+            start = end
+        return bounds
+
     mw = cfg.memory_weight
     total_m = sum(pbytes) or 1.0
     total_t = sum(times) or 1.0

@@ -1,0 +1,241 @@
+"""Every accepted config key is either consumed by the implementation or
+explicitly declared advisory (VERDICT r2 item 7).
+
+Also covers the newly-honored keys: manual partitioning
+(``auto_partition: False`` + ``default_partition``), the ZeRO-2D JSON
+override, partition save/load, and registry forward/return hooks.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.backend.schema import SCHEMA
+from smdistributed_modelparallel_tpu.backend.config import ModelParallelConfig
+from smdistributed_modelparallel_tpu.models.transformer_lm import TransformerLM
+from smdistributed_modelparallel_tpu.utils.exceptions import (
+    ConfigError,
+    PartitionError,
+)
+from tests.models import softmax_xent
+
+_PKG = os.path.join(os.path.dirname(__file__), "..", "smdistributed_modelparallel_tpu")
+
+
+def test_every_schema_key_consumed_or_advisory():
+    """Meta-test: walk SCHEMA; each key must appear in the implementation
+    (outside schema.py) or carry an explicit advisory declaration."""
+    src = ""
+    for root, _, files in os.walk(_PKG):
+        for f in files:
+            if f.endswith(".py") and f != "schema.py":
+                with open(os.path.join(root, f)) as fh:
+                    src += fh.read()
+    missing = []
+    for key, spec in SCHEMA.items():
+        if spec.get("advisory"):
+            continue
+        pats = (f"cfg.{key}", f'"{key}"', f"'{key}'")
+        if not any(p in src for p in pats):
+            missing.append(key)
+    assert not missing, (
+        f"Config keys accepted but neither consumed nor declared advisory: "
+        f"{missing}"
+    )
+
+
+def test_advisory_keys_warn_when_set():
+    import logging
+
+    from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    logger = get_logger()
+    logger.addHandler(handler)
+    try:
+        ModelParallelConfig({"fast_mode": True})
+    finally:
+        logger.removeHandler(handler)
+    assert any("advisory" in m for m in records)
+
+
+class TestManualPartition:
+    def _train(self, cfg, pins=()):
+        smp.reset()
+        smp.init(cfg)
+        module = TransformerLM(vocab_size=32, max_len=12, d_model=16,
+                               n_layers=4, n_heads=2)
+        for prefix, stage in pins:
+            smp.set_partition(prefix, stage)
+        model = smp.DistributedModel(module)
+        ids = jax.random.randint(jax.random.key(0), (4, 12), 0, 32)
+
+        @smp.step
+        def train_step(model, batch):
+            logits = model(batch)
+            loss = jnp.mean(softmax_xent(logits[:, :-1], batch[:, 1:]))
+            model.backward(loss)
+            return loss
+
+        out = train_step(model, ids)
+        return model, float(out.reduce_mean())
+
+    def test_default_partition_with_pins(self):
+        model, loss = self._train(
+            {"pipeline_parallel_degree": 2, "microbatches": 2, "ddp": True,
+             "auto_partition": False, "default_partition": 0},
+            pins=[("layers/block#2", 1), ("layers/block#3", 1)],
+        )
+        assert np.isfinite(loss)
+        assert model._pipeline_spec.boundaries == [(0, 2), (2, 4)]
+
+    def test_empty_stage_rejected(self):
+        with pytest.raises(PartitionError, match="empty"):
+            self._train(
+                {"pipeline_parallel_degree": 2, "microbatches": 2,
+                 "ddp": True, "auto_partition": False, "default_partition": 0},
+            )
+
+    def test_partition_file_save_and_load(self, tmp_path):
+        pfile = str(tmp_path / "partition.json")
+        model, _ = self._train(
+            {"pipeline_parallel_degree": 2, "microbatches": 2, "ddp": True,
+             "partition_file": pfile},
+        )
+        saved = json.load(open(pfile))
+        assert saved["pipeline_parallel_degree"] == 2
+        computed = model._pipeline_spec.boundaries
+        # Reload: the saved assignment drives the boundaries.
+        model2, loss2 = self._train(
+            {"pipeline_parallel_degree": 2, "microbatches": 2, "ddp": True,
+             "partition_file": pfile, "load_partition": True},
+        )
+        assert model2._pipeline_spec.boundaries == computed
+        assert np.isfinite(loss2)
+
+    def test_load_partition_missing_file_raises(self):
+        with pytest.raises(PartitionError, match="not found"):
+            self._train(
+                {"pipeline_parallel_degree": 2, "microbatches": 2,
+                 "ddp": True, "partition_file": "/nonexistent/p.json",
+                 "load_partition": True},
+            )
+
+
+class TestSdpJsonOverride:
+    def test_json_file_overrides_sdp_knobs(self, tmp_path):
+        p = tmp_path / "sdp.json"
+        p.write_text(json.dumps({
+            "zero_optimization": {
+                "stage": 3,
+                "reduce_bucket_size": 12345,
+                "stage3_param_persistence_threshold": 777,
+                "stage3_max_live_parameters": 999,
+            },
+            "gradient_clipping": 0.5,
+            "some_deepspeed_engine_knob": True,
+        }))
+        cfg = ModelParallelConfig({
+            "sharded_data_parallel_degree": 2, "ddp": True,
+            "_sharded_data_parallelism_config": str(p),
+        })
+        assert cfg.sdp_reduce_bucket_size == 12345
+        assert cfg.sdp_param_persistence_threshold == 777
+        assert cfg.sdp_max_live_parameters == 999
+        assert cfg.sdp_gradient_clipping == 0.5
+
+    def test_inline_dict_accepted(self):
+        cfg = ModelParallelConfig({
+            "sharded_data_parallel_degree": 2, "ddp": True,
+            "_sharded_data_parallelism_config": {
+                "zero_optimization": {"reduce_bucket_size": 4242},
+            },
+        })
+        assert cfg.sdp_reduce_bucket_size == 4242
+
+    def test_json_cannot_bypass_requires(self, tmp_path):
+        """zero2d_shard_size from the JSON goes through the same requires
+        checks as a directly-set sharded_data_parallel_degree."""
+        with pytest.raises(ConfigError):
+            ModelParallelConfig({
+                "_sharded_data_parallelism_config": {
+                    "zero_optimization": {"zero2d_shard_size": 8},
+                },
+            })  # no ddp -> must be rejected
+
+    def test_wrong_stage_rejected(self, tmp_path):
+        p = tmp_path / "sdp.json"
+        p.write_text(json.dumps({"zero_optimization": {"stage": 2}}))
+        with pytest.raises(ConfigError, match="stage 3"):
+            ModelParallelConfig({
+                "sharded_data_parallel_degree": 2, "ddp": True,
+                "_sharded_data_parallelism_config": str(p),
+            })
+
+    def test_missing_file_rejected(self):
+        with pytest.raises(ConfigError, match="not found"):
+            ModelParallelConfig({
+                "sharded_data_parallel_degree": 2, "ddp": True,
+                "_sharded_data_parallelism_config": "/no/such/file.json",
+            })
+
+
+class TestForwardReturnHooks:
+    def test_hooks_applied_without_moving_params(self):
+        import flax.linen as nn
+        from smdistributed_modelparallel_tpu.nn.linear import DistributedLinear
+
+        smp.reset()
+        smp.init({"tensor_parallel_degree": 2, "ddp": True})
+
+        calls = []
+
+        def fwd_hook(x, **kw):
+            calls.append("fwd")
+            return (x * 2.0,), kw
+
+        def ret_hook(out):
+            calls.append("ret")
+            return out + 1.0
+
+        from smdistributed_modelparallel_tpu.backend.state import state
+        from smdistributed_modelparallel_tpu.nn.auto_distribute import (
+            _dense_init_hook,
+        )
+
+        state.tp_registry.register(
+            nn.Dense, DistributedLinear,
+            init_hook=lambda *a, **f: ((), {"features": f["features"]}),
+            forward_hook=fwd_hook, return_hook=ret_hook,
+        )
+        try:
+            # Build via distribute path: mark a top-level Dense.
+            with smp.tensor_parallelism():
+                dense = nn.Dense(8)
+            model = smp.DistributedModel(dense)
+            x = jnp.ones((2, 4))
+            out = model(x)
+        finally:
+            # The registry outlives smp.reset(); restore the builtin so
+            # other tests see the stock registration.
+            state.tp_registry.register(
+                nn.Dense, DistributedLinear, init_hook=_dense_init_hook
+            )
+        assert "fwd" in calls and "ret" in calls
+        # Scope sharing: param paths unchanged (kernel at the root).
+        assert "kernel" in model.params
+        # Hook math: f(2x) + 1
+        ref = x * 2.0 @ model.params["kernel"] + 1.0
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
