@@ -130,17 +130,17 @@ def save_checkpoint(path, tag=None, model=None, optimizer=None,
     os.makedirs(path, exist_ok=True)
 
     if partial:
+        from smdistributed_modelparallel_tpu.shard_io import save_sharded
+
         ckpt_dir = os.path.join(path, f"{tag}_partial")
         os.makedirs(ckpt_dir, exist_ok=True)
         if model is not None and model.params is not None:
-            # Per-process file (reference: per-rank partial). Under
-            # single-controller SPMD each process saves the full gathered
-            # tree; multi-host sharded save keys off process coords in the
-            # filename so ranks don't collide.
-            save(model.state_dict(), os.path.join(ckpt_dir, "model.pt"))
+            # True per-rank shards (reference: per-rank partial files,
+            # torch/checkpoint.py:124-165): each process writes only its
+            # replica-0 addressable shards; no process gathers the tree.
+            save_sharded(model.params, ckpt_dir, "model")
         if optimizer is not None and optimizer.opt_state is not None:
-            save(optimizer.local_state_dict(),
-                 os.path.join(ckpt_dir, "optimizer.pt"))
+            save_sharded(optimizer.opt_state, ckpt_dir, "optimizer")
         if state.loss_scaler is not None:
             save(state.loss_scaler.state_dict(),
                  os.path.join(ckpt_dir, "fp16_states.pt"))
@@ -201,19 +201,29 @@ def resume_from_checkpoint(path, tag=None, partial=True, strict=True,
             tag = fh.read().strip()
 
     if partial:
+        import glob as _glob
+
+        from smdistributed_modelparallel_tpu.shard_io import ShardCatalog
+
         ckpt_dir = os.path.join(path, f"{tag}_partial")
         if not os.path.isdir(ckpt_dir):
             raise SMPRuntimeError(f"Partial checkpoint dir not found: {ckpt_dir}")
         with open(os.path.join(ckpt_dir, "smp_config.pt"), "rb") as fh:
             saved_cfg = pickle.load(fh)
         verify_smp_config(saved_cfg)
-        model_sd = load(os.path.join(ckpt_dir, "model.pt"))
+        if _glob.glob(os.path.join(ckpt_dir, "model_shards_p*.npz")):
+            model_sd = ShardCatalog(ckpt_dir, "model")
+        else:  # legacy gathered-pickle layout
+            model_sd = load(os.path.join(ckpt_dir, "model.pt"))
         opt_sd = None
         if load_optimizer:
-            try:
-                opt_sd = load(os.path.join(ckpt_dir, "optimizer.pt"))
-            except SMPRuntimeError:
-                opt_sd = None
+            if _glob.glob(os.path.join(ckpt_dir, "optimizer_shards_p*.npz")):
+                opt_sd = ShardCatalog(ckpt_dir, "optimizer")
+            else:
+                try:
+                    opt_sd = load(os.path.join(ckpt_dir, "optimizer.pt"))
+                except SMPRuntimeError:
+                    opt_sd = None
         fp16_path = os.path.join(ckpt_dir, "fp16_states.pt")
         if state.loss_scaler is not None and os.path.exists(
             _partial_name(fp16_path)
@@ -235,9 +245,14 @@ def resume_from_checkpoint(path, tag=None, partial=True, strict=True,
 
 
 def _stash_or_apply(model_sd, opt_sd):
+    from smdistributed_modelparallel_tpu.shard_io import ShardCatalog
+
     model = state.model
     if model is not None and model.params is not None:
-        model.load_state_dict(model_sd)
+        if isinstance(model_sd, ShardCatalog):
+            model.load_sharded(model_sd)
+        else:
+            model.load_state_dict(model_sd)
     else:
         # Applied by DistributedModel once params materialize (parity:
         # reference state.loaded_model_state, torch/model.py:245-251).
@@ -246,7 +261,10 @@ def _stash_or_apply(model_sd, opt_sd):
     if opt_sd is None:
         return
     if opt is not None and opt.opt_state is not None:
-        opt.load_state_dict(opt_sd)
+        if isinstance(opt_sd, ShardCatalog):
+            opt.load_sharded(opt_sd)
+        else:
+            opt.load_state_dict(opt_sd)
     else:
         state.loaded_optimizer_state = opt_sd
 

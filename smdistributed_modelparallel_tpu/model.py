@@ -268,9 +268,15 @@ class DistributedModel:
         if state.loaded_model_state is not None:
             # Deferred resume_from_checkpoint payload (parity: reference
             # torch/model.py:245-251).
+            from smdistributed_modelparallel_tpu.shard_io import ShardCatalog
+
             logger.info("Applying deferred checkpoint state to model.")
-            self.load_state_dict(state.loaded_model_state)
+            payload = state.loaded_model_state
             state.loaded_model_state = None
+            if isinstance(payload, ShardCatalog):
+                self.load_sharded(payload)
+            else:
+                self.load_state_dict(payload)
         for hook in self._post_partition_hooks:
             hook(self)
 
@@ -404,21 +410,25 @@ class DistributedModel:
         return flat
 
     def local_state_dict(self):
-        """Per-process shard view. Parity: reference ``local_state_dict``
-        (``torch/model.py:1482+``); here the shards addressable from this
-        process."""
-        flat = {}
-        for path, leaf in jax.tree_util.tree_flatten_with_path(self._params)[0]:
-            key = path_key(path)
-            shards = [s.data for s in leaf.addressable_shards]
-            flat[key] = np.asarray(shards[0]) if len(shards) == 1 else [
-                np.asarray(s) for s in shards
-            ]
-        return flat
+        """Per-process shard payload. Parity: reference ``local_state_dict``
+        (``torch/model.py:1482+``); the replica-0 shards addressable from
+        this process, round-trippable through ``load_state_dict``."""
+        from smdistributed_modelparallel_tpu.shard_io import shard_payload
+
+        return shard_payload(self._params)
 
     def load_state_dict(self, flat_dict):
         """Load a '/'-keyed flat dict into the param tree (resharding as
-        needed)."""
+        needed). Shard payloads (``local_state_dict`` output) load
+        shard-wise."""
+        from smdistributed_modelparallel_tpu.shard_io import (
+            InMemoryCatalog,
+            is_shard_payload,
+        )
+
+        if is_shard_payload(flat_dict):
+            self.load_sharded(InMemoryCatalog(flat_dict))
+            return
         if self._params is None:
             raise SMPValidationError(
                 "Model parameters are not initialized; run a step or call "
@@ -440,6 +450,22 @@ class DistributedModel:
             jax.tree_util.tree_structure(self._params), new_leaves
         )
         self._params = jax.device_put(params, self._param_shardings)
+
+    def load_sharded(self, catalog):
+        """Load a sharded checkpoint (``shard_io`` catalog): each process
+        reads only the pieces its addressable shards need — no full-tree
+        materialization anywhere. Parity: reference per-rank partial load
+        (``torch/checkpoint.py:42-122``)."""
+        if self._params is None:
+            raise SMPValidationError(
+                "Model parameters are not initialized; run a step first."
+            )
+        try:
+            self._params = catalog.load_tree(
+                self._params, self._param_shardings
+            )
+        finally:
+            catalog.close()
 
     # ------------------------------------------------------------------
     # train / eval mode (dropout etc. is explicit in flax; kept for parity)

@@ -63,6 +63,15 @@ class HookedModule(nn.Module):
             return None
         return fn() if callable(fn) else fn
 
+
+def unwrap_hooks(module):
+    """The module the pipeline should drive: HookedModule shares its scope
+    with the wrapped module, so applying inner methods uses the same
+    parameter paths (hooks only shape the direct-call signature)."""
+    while isinstance(module, HookedModule):
+        module = module.inner
+    return module
+
 _hooks_installed = False
 _TP_MARK = "_smp_tp_mark"
 _PARTITION_MARK = "_smp_partition"

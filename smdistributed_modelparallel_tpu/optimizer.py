@@ -74,9 +74,15 @@ class DistributedOptimizer:
         if state.loaded_optimizer_state is not None:
             # Deferred resume payload (parity: reference
             # torch/optimizers/optimizer.py:545-547).
+            from smdistributed_modelparallel_tpu.shard_io import ShardCatalog
+
             logger.info("Applying deferred checkpoint state to optimizer.")
-            self.load_state_dict(state.loaded_optimizer_state)
+            payload = state.loaded_optimizer_state
             state.loaded_optimizer_state = None
+            if isinstance(payload, ShardCatalog):
+                self.load_sharded(payload)
+            else:
+                self.load_state_dict(payload)
 
         update = self.build_update_fn()
 
@@ -195,9 +201,35 @@ class DistributedOptimizer:
         return flat
 
     def local_state_dict(self):
-        return self.state_dict()
+        """Per-process shard payload (parity: reference ``local_state_dict``;
+        r2 weak item: this used to gather the full state). Round-trips
+        through ``load_state_dict``."""
+        from smdistributed_modelparallel_tpu.shard_io import shard_payload
+
+        self._ensure_state()
+        return shard_payload(self._opt_state)
+
+    def load_sharded(self, catalog):
+        """Load a sharded optimizer checkpoint (``shard_io`` catalog)."""
+        self._ensure_state()
+        shardings = jax.tree_util.tree_map(
+            lambda l: l.sharding if isinstance(l, jax.Array) else None,
+            self._opt_state,
+        )
+        try:
+            self._opt_state = catalog.load_tree(self._opt_state, shardings)
+        finally:
+            catalog.close()
 
     def load_state_dict(self, flat_dict):
+        from smdistributed_modelparallel_tpu.shard_io import (
+            InMemoryCatalog,
+            is_shard_payload,
+        )
+
+        if is_shard_payload(flat_dict):
+            self.load_sharded(InMemoryCatalog(flat_dict))
+            return
         self._ensure_state()
         leaves, _ = jax.tree_util.tree_flatten_with_path(self._opt_state)
         new = []

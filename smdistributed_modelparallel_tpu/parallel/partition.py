@@ -73,12 +73,35 @@ def _maybe_load_partition(model):
             f"{cfg.pipeline_parallel_degree}."
         )
     assignment = {k: int(v) for k, v in payload["assignment"].items()}
-    # Install as pins and re-derive boundaries so the pipeline spec and
-    # sharding providers are built exactly as in the computed path.
+    # Validate against the current model before installing: the pins are
+    # silently ignored by the partitioner if prefixes don't match, so a
+    # stale file must fail loudly, not fall back to cost-based boundaries.
+    from smdistributed_modelparallel_tpu.nn.auto_distribute import unwrap_hooks
     from smdistributed_modelparallel_tpu.parallel.pipeline import (
+        get_pipeline_spec,
         partition_for_pipeline,
     )
+    from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
 
+    spec = get_pipeline_spec(unwrap_hooks(model.module))
+    if spec is not None:
+        saved_layers = payload.get("num_layers")
+        if saved_layers is not None and saved_layers != spec.num_layers:
+            raise PartitionError(
+                f"partition_file was saved for {saved_layers} layers, the "
+                f"current model has {spec.num_layers}."
+            )
+        bad = [
+            k for k in assignment
+            if not k.startswith(spec.layer_path + "#")
+        ]
+        if bad:
+            raise PartitionError(
+                f"partition_file entries {bad[:3]}... do not match the "
+                f"current model's layer path '{spec.layer_path}'."
+            )
+    # Install as pins and re-derive boundaries so the pipeline spec and
+    # sharding providers are built exactly as in the computed path.
     for prefix, stage in assignment.items():
         model.module_manager.set_partition(prefix, stage)
     out = partition_for_pipeline(model)
@@ -100,9 +123,18 @@ def _maybe_save_partition(assignment):
         # One writer on shared filesystems (multi-host runs).
         return
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    num_layers = None
+    if assignment:
+        try:
+            num_layers = max(
+                int(k.rsplit("#", 1)[1]) for k in assignment
+            ) + 1
+        except (ValueError, IndexError):
+            num_layers = None
     with open(path, "w", encoding="utf-8") as fh:
         json.dump({
             "pipeline_parallel_degree": cfg.pipeline_parallel_degree,
+            "num_layers": num_layers,
             "assignment": assignment,
         }, fh, indent=1)
     logger.info("Saved pipeline partition to %s.", path)

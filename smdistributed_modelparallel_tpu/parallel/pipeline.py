@@ -100,7 +100,10 @@ def partition_for_pipeline(model):
     """
     cfg = state.cfg
     pp = cfg.pipeline_parallel_degree
-    spec = get_pipeline_spec(model.module)
+    from smdistributed_modelparallel_tpu.nn.auto_distribute import unwrap_hooks
+
+    root = unwrap_hooks(model.module)
+    spec = get_pipeline_spec(root)
     if spec is None:
         raise PartitionError(
             "pipeline_parallel_degree > 1 requires a pipelineable model: one "
@@ -119,7 +122,7 @@ def partition_for_pipeline(model):
     # the remat lives on the executor's layer application.
     if not spec.carry_remat:
         mm = model.module_manager
-        if getattr(model.module, "activation_checkpointing", False):
+        if getattr(root, "activation_checkpointing", False):
             spec.carry_remat = True
         else:
             for prefix in mm.checkpoint_configs:
@@ -339,7 +342,9 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     S = cfg.pipeline_parallel_degree
     num_mb = cfg.microbatches
     L = spec.num_layers
-    module = model.module
+    from smdistributed_modelparallel_tpu.nn.auto_distribute import unwrap_hooks
+
+    module = unwrap_hooks(model.module)
     layer_module = spec.layer_module
 
     layer_params = _get_subtree(params, spec.layer_path)

@@ -129,7 +129,9 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     L = spec.num_layers
     W = min(cfg.active_microbatches or (S + 1), M)
     W1 = W + 1
-    module = model.module
+    from smdistributed_modelparallel_tpu.nn.auto_distribute import unwrap_hooks
+
+    module = unwrap_hooks(model.module)
     layer_module = spec.layer_module
     half = cfg.half_dtype
 

@@ -87,8 +87,8 @@ class TestSaveCheckpointDir:
         smp.save_checkpoint(str(tmp_path), tag="t1", user_content={"epoch": 3})
 
         assert (tmp_path / "newest").read_text() == "t1"
-        assert (tmp_path / "t1_partial" / "model_0_0_0.pt").exists()
-        assert (tmp_path / "t1_partial" / "optimizer_0_0_0.pt").exists()
+        assert (tmp_path / "t1_partial" / "model_shards_p0.npz").exists()
+        assert (tmp_path / "t1_partial" / "optimizer_shards_p0.npz").exists()
 
         # Perturb, resume, verify restoration.
         model.params = jax.tree_util.tree_map(lambda p: p * 0.0, model.params)
@@ -159,3 +159,115 @@ class TestSaveCheckpointDir:
             for l in jax.tree_util.tree_leaves(model.params)
         )
         assert total > 0.0
+
+
+@pytest.mark.slow
+class TestShardedCheckpoint:
+    """True per-rank sharded checkpoints (VERDICT r2 item 6): each global
+    element is stored exactly once across the shard files, and loading
+    materializes only shard-sized pieces — never the full tree."""
+
+    def _setup(self, cfg):
+        smp.reset()
+        smp.init(cfg)
+        from smdistributed_modelparallel_tpu.nn.transformer import (
+            DistributedTransformerLMHead,
+        )
+        from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+            vocab_parallel_cross_entropy,
+        )
+
+        module = DistributedTransformerLMHead(
+            num_layers=4, num_attention_heads=4, attention_head_size=8,
+            hidden_size=32, intermediate_size=64, vocab_size=96,
+            num_positions=32, causal_mask_size=32,
+            pre_layernorm=True, post_layernorm=False, final_layernorm=True,
+            attention_dropout_prob=0.0, hidden_dropout_prob=0.0,
+            embedding_dropout_prob=0.0,
+        )
+        model = smp.DistributedModel(module)
+        opt = smp.DistributedOptimizer(optax.adam(1e-3), model)
+
+        @smp.step
+        def train_step(model, ids):
+            logits = model(ids)
+            loss = jnp.mean(
+                vocab_parallel_cross_entropy(logits[:, :-1], ids[:, 1:])
+            )
+            model.backward(loss)
+            return loss
+
+        ids = jax.random.randint(jax.random.key(0), (8, 16), 0, 96)
+        return model, opt, train_step, ids
+
+    def test_pp_tp_rdp_roundtrip_no_full_tree(self, tmp_path):
+        cfg = {"pipeline_parallel_degree": 2, "tensor_parallel_degree": 2,
+               "microbatches": 2, "ddp": True}
+        model, opt, step_fn, ids = self._setup(cfg)
+        step_fn(model, ids)
+        opt.step()
+        step_fn(model, ids)
+        opt.step()
+        want = jax.device_get(model.state_dict())
+        want_opt = {
+            k: np.asarray(v)
+            for k, v in jax.device_get(opt.state_dict()).items()
+        }
+        smp.save_checkpoint(str(tmp_path), tag="s1", model=model,
+                            optimizer=opt)
+
+        # Storage efficiency: every global element exactly once (a full
+        # gather per process would store mesh-size copies).
+        f = np.load(tmp_path / "s1_partial" / "model_shards_p0.npz")
+        stored = sum(int(np.prod(f[k].shape)) * f[k].dtype.itemsize
+                     for k in f.files)
+        unique = sum(l.nbytes for l in jax.tree_util.tree_leaves(model.params))
+        assert stored == unique, (stored, unique)
+
+        # Fresh world: resume BEFORE params exist (deferred apply), then
+        # spy that reassembly happens shard-wise for tp-sharded leaves.
+        model2, opt2, step_fn2, _ = self._setup(cfg)
+        from smdistributed_modelparallel_tpu import shard_io
+
+        regions = []
+        orig = shard_io.ShardCatalog.assemble
+
+        def spy(self, key, index, shape, dtype):
+            regions.append((key, tuple(
+                (0 if s.start is None else s.start,
+                 d if s.stop is None else s.stop)
+                for s, d in zip(index, shape)), tuple(shape)))
+            return orig(self, key, index, shape, dtype)
+
+        shard_io.ShardCatalog.assemble = spy
+        try:
+            smp.resume_from_checkpoint(str(tmp_path), tag="s1")
+            step_fn2(model2, ids)  # init triggers deferred sharded load
+        finally:
+            shard_io.ShardCatalog.assemble = orig
+
+        got = jax.device_get(model2.state_dict())
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], atol=1e-6, err_msg=k)
+        # tp-sharded leaves were assembled in shard-sized pieces, not whole.
+        partial_reads = [
+            r for r in regions
+            if any((b - a) < d for (a, b), d in zip(r[1], r[2]))
+        ]
+        assert partial_reads, "no shard-wise reads observed"
+
+        # Optimizer state restored too (deferred path).
+        opt2._ensure_state()
+        got_opt = {
+            k: np.asarray(v)
+            for k, v in jax.device_get(opt2.state_dict()).items()
+        }
+        for k in want_opt:
+            np.testing.assert_allclose(
+                got_opt[k], want_opt[k], atol=1e-6, err_msg=k
+            )
+
+        # Training continues.
+        out = step_fn2(model2, ids)
+        opt2.step()
+        assert np.isfinite(float(out.reduce_mean()))
