@@ -268,7 +268,6 @@ SCHEMA = {
         "them directly sharded on device (TPU: jax.eval_shape + sharded init).",
     },
     "skip_tracing": {
-        "advisory": "init/trace pass is shape-only and cheap",
         "type": bool,
         "default": False,
         "description": "Skip the cost-tracing pass; the auto-partitioner falls "

@@ -215,7 +215,12 @@ class DistributedModel:
             self._sharded_init(args, kwargs)
             return
         logger.info("Initializing model parameters from first batch shapes.")
-        variables = jax.jit(self.module.init)(self._init_rngs(), *args, **kwargs)
+        # set_mesh: partial-manual shard_map regions (context parallelism)
+        # inside the init need the mesh bound at the jit call site.
+        with jax.set_mesh(state.mesh):
+            variables = jax.jit(self.module.init)(
+                self._init_rngs(), *args, **kwargs
+            )
         params = variables["params"]
         self._set_params(params)
 
@@ -415,7 +420,7 @@ class DistributedModel:
         this process, round-trippable through ``load_state_dict``."""
         from smdistributed_modelparallel_tpu.shard_io import shard_payload
 
-        return shard_payload(self._params)
+        return shard_payload(self._params, dedupe_global=False)
 
     def load_state_dict(self, flat_dict):
         """Load a '/'-keyed flat dict into the param tree (resharding as

@@ -574,18 +574,21 @@ class DistributedTransformer(nn.Module):
 
     @nn.nowrap
     def layer_xs(self):
-        idx = jnp.arange(self.num_layers, dtype=jnp.int32)
+        xs = {"layer_idx": jnp.arange(self.num_layers, dtype=jnp.int32)}
+        # is_local only exists for per-layer local/global selection: a
+        # traced selector disqualifies the static-window Pallas/CP fast
+        # paths, and a homogeneous stack must keep window_size STATIC so
+        # (a) windowed attention actually applies without
+        # attention_layers_type and (b) the fast paths engage.
         if self.attention_layers_type is not None:
             if len(self.attention_layers_type) != self.num_layers:
                 raise SMPValidationError(
                     "attention_layers_type must have num_layers entries."
                 )
-            is_local = jnp.asarray(
+            xs["is_local"] = jnp.asarray(
                 [t == "local" for t in self.attention_layers_type], dtype=bool
             )
-        else:
-            is_local = jnp.zeros((self.num_layers,), dtype=bool)
-        return {"layer_idx": idx, "is_local": is_local}
+        return xs
 
     def setup(self):
         body = _LayerScanBody

@@ -86,19 +86,19 @@ def attention_core(
         scale = scale * extra_scale
 
     # Context parallelism (M6): sequence sharded over the cp mesh axis ->
-    # ring / Ulysses manual regions. Unsupported feature combinations fall
+    # ring / Ulysses manual regions. Real-model features (key-padding
+    # masks, attention dropout) are supported in-region; unsupported
+    # combinations (windows, rich biases, per-layer local selection) fall
     # through to the GSPMD path (allgather-KV semantics).
     from smdistributed_modelparallel_tpu.ops.context_parallel import cp_size
 
+    cp_kpad = _as_key_padding_bias(mask, mask_value) if cp_size() > 1 else None
     if (
         cp_size() > 1
         and bias is None
-        and mask is None
+        and (mask is None or cp_kpad is not None)
         and local_select is None
-        and (dropout_rate == 0.0 or dropout_rng is None)
         and window is None
-        and qk_compensation is None
-        and not attention_in_fp32
         and q.shape[1] == k.shape[1]
         and q.shape[1] % cp_size() == 0
     ):
@@ -109,7 +109,22 @@ def attention_core(
 
         impl = state.cfg.context_parallel_impl
         if impl in ("ring", "ulysses"):
-            return cp_attention(q, k, v, scale=scale, causal=causal, impl=impl)
+            cp_scale = scale
+            qq = q
+            if not isinstance(scale, (int, float, np.floating)):
+                # Keep q's dtype (a traced f32 scale would promote bf16 q).
+                qq, cp_scale = (q * scale).astype(q.dtype), 1.0
+            seed = None
+            rate = 0.0
+            if dropout_rate > 0.0 and dropout_rng is not None:
+                rate = float(dropout_rate)
+                seed = jax.lax.bitcast_convert_type(
+                    jax.random.bits(dropout_rng, (), jnp.uint32), jnp.int32
+                )
+            return cp_attention(
+                qq, k, v, scale=cp_scale, causal=causal, impl=impl,
+                kpad=cp_kpad, dropout_rate=rate, seed=seed,
+            )
 
     kpad = _as_key_padding_bias(mask, mask_value)
     if (

@@ -10,12 +10,22 @@ TPU-native design: the sequence axis lives on the ``cp`` mesh axis.
   device holds Q for its sequence block and rotates K/V blocks around the
   ring with ``lax.ppermute`` (ICI neighbor traffic), merging per-block
   partial attention with the online-softmax rule — full attention over the
-  global sequence without ever materializing it on one chip.
+  global sequence without ever materializing it on one chip. For CAUSAL
+  attention the sequence is laid out in ZIGZAG order (device i holds
+  chunks i and 2n-1-i of 2n half-chunks), so every device carries an equal
+  share of the causal triangle — without it, early ring ranks idle on
+  mostly-masked blocks while late ranks do ~2x the unmasked work.
 - **Ulysses**: two ``lax.all_to_all``s re-shard [B, T/cp, H, hd] ->
   [B, T, H/cp, hd] (heads scattered, sequence gathered), run plain local
   attention, and shard back.
 - **allgather** (``context_parallel_impl: allgather``): no manual region;
   GSPMD gathers K/V from the sharding constraints (the baseline).
+
+Real-model support: additive key-padding biases [B, S] travel around the
+ring with K/V (or allgather under Ulysses), and attention dropout uses the
+counter-based hash RNG shared with the Pallas kernels — keyed on GLOBAL
+(batch*head, row, col) indices, so ring and Ulysses produce identical
+dropout patterns and JAX AD replays them exactly in the backward.
 """
 
 import functools
@@ -27,6 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.backend.topology import CP_AXIS
+from smdistributed_modelparallel_tpu.ops.pallas_attention import _dropout_keep
 from smdistributed_modelparallel_tpu.utils.exceptions import SMPValidationError
 
 NEG_INF = -1e30
@@ -46,27 +57,69 @@ def _block_scores(q, k, scale):
     )
 
 
-def ring_attention_local(q, k, v, *, scale, causal, n_blocks, axis_name=CP_AXIS):
+def _keep4d(seed, B, n_heads, h0, rows_g, cols_g, s_total, rate):
+    """[B, n_heads, len(rows), len(cols)] dropout keep mask from GLOBAL
+    indices; ``h0`` is the global index of the first local head (Ulysses
+    shards heads, ring does not). Same hash as the Pallas kernels, keyed
+    by (b*4096 + global_head, row, col) — ring and Ulysses agree exactly.
+    """
+    b = jnp.arange(B)[:, None, None, None]
+    h = (h0 + jnp.arange(n_heads))[None, :, None, None]
+    bh = b * jnp.int32(4096) + h
+    rows = rows_g[None, None, :, None]
+    cols = cols_g[None, None, None, :]
+    return _dropout_keep(seed, bh, rows, cols, s_total, rate)
+
+
+def _zig_index(n, half):
+    """Global sequence order for the zigzag layout: device i holds chunks
+    i and 2n-1-i of 2n half-chunks."""
+    idx = []
+    for i in range(n):
+        idx.append(np.arange(i * half, (i + 1) * half))
+        idx.append(np.arange((2 * n - 1 - i) * half, (2 * n - i) * half))
+    return np.concatenate(idx)
+
+
+def _zig_rows(dev, half, n):
+    """Global row indices of the zigzag-local block held by ``dev``."""
+    a = dev * half + jnp.arange(half)
+    b = (2 * n - 1 - dev) * half + jnp.arange(half)
+    return jnp.concatenate([a, b])
+
+
+def ring_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
+                         zigzag, dropout_rate, axis_name=CP_AXIS):
     """Per-shard ring attention body (runs inside shard_map).
 
-    q, k, v: [B, Tl, H, hd] — this device's sequence block.
-    Rotates K/V around the cp ring; merges blocks with online softmax.
+    q, k, v: [B, Tl, H, hd] — this device's sequence block (zigzag order
+    for causal); kpad: [B, Tl] additive bias or None; seed: int32 or None.
+    Rotates K/V (and kpad) around the cp ring; merges blocks with online
+    softmax.
     """
     B, Tl, H, hd = q.shape
     me = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
+    T_total = Tl * n_blocks
+    half = Tl // 2
 
-    rows_local = jnp.arange(Tl)
-    cols_local = jnp.arange(Tl)
+    def global_rows(dev):
+        if zigzag:
+            return _zig_rows(dev, half, n_blocks)
+        return dev * Tl + jnp.arange(Tl)
+
+    rows_g = global_rows(me)
+    inv_keep = 1.0 / (1.0 - dropout_rate) if dropout_rate > 0.0 else 1.0
 
     def body(i, carry):
-        acc, m, l, k_cur, v_cur = carry
+        acc, m, l, k_cur, v_cur, kp_cur = carry
         src = (me - i) % n_blocks  # whose block we currently hold
         s = _block_scores(q, k_cur, scale)  # [B, H, Tl, Tl]
+        cols_g = global_rows(src)
+        if kp_cur is not None:
+            s = s + kp_cur[:, None, None, :]
         if causal:
-            rows_g = me * Tl + rows_local[:, None]
-            cols_g = src * Tl + cols_local[None, :]
-            mask = cols_g <= rows_g
+            mask = cols_g[None, :] <= rows_g[:, None]
             s = jnp.where(mask[None, None], s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # Guard fully-masked rows/blocks: keep m finite for the exp.
@@ -76,37 +129,47 @@ def ring_attention_local(q, k, v, *, scale, causal, n_blocks, axis_name=CP_AXIS)
             p = jnp.where(mask[None, None], p, 0.0)
         alpha = jnp.exp(jnp.maximum(m, -1e29) - m_safe) * (m > NEG_INF / 2)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _keep4d(seed, B, H, 0, rows_g, cols_g, T_total,
+                           dropout_rate)
+            p = jnp.where(keep, p, 0.0)
         acc_new = acc * alpha + jnp.einsum(
             "bhts,bshd->bthd", p, v_cur.astype(jnp.float32)
         ).transpose(0, 2, 1, 3)
-        # Rotate K/V to the next device (ICI neighbor exchange).
+        # Rotate K/V (and the key-padding bias) to the next device.
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return acc_new, m_new, l_new, k_nxt, v_nxt
+        kp_nxt = (
+            jax.lax.ppermute(kp_cur, axis_name, perm)
+            if kp_cur is not None else None
+        )
+        return acc_new, m_new, l_new, k_nxt, v_nxt, kp_nxt
 
     acc0 = jnp.zeros((B, H, Tl, hd), jnp.float32)
     m0 = jnp.full((B, H, Tl, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
-    acc, m, l, _, _ = jax.lax.fori_loop(
-        0, n_blocks, body, (acc0, m0, l0, k, v)
+    acc, m, l, _, _, _ = jax.lax.fori_loop(
+        0, n_blocks, body, (acc0, m0, l0, k, v, kpad)
     )
-    out = acc / jnp.maximum(l, 1e-30)  # [B, H, Tl, hd]
+    out = acc * inv_keep / jnp.maximum(l, 1e-30)  # [B, H, Tl, hd]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def ulysses_attention_local(q, k, v, *, scale, causal, n_blocks,
-                            axis_name=CP_AXIS):
+def ulysses_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
+                            dropout_rate, axis_name=CP_AXIS):
     """Per-shard Ulysses body: all_to_all heads<->sequence, local attention.
 
     Parity note: the head/sequence exchange is the reference's
     ``scatter_and_merge`` collective (``torch/collectives.py:218-245``).
     """
+    B = q.shape[0]
     H = q.shape[2]
     if H % n_blocks != 0:
         raise SMPValidationError(
             f"Ulysses context parallelism needs heads ({H}) divisible by "
             f"cp degree ({n_blocks})."
         )
+    me = jax.lax.axis_index(axis_name)
 
     def exchange_fwd(x):  # [B, Tl, H, hd] -> [B, T, H/cp, hd]
         return jax.lax.all_to_all(
@@ -116,10 +179,19 @@ def ulysses_attention_local(q, k, v, *, scale, causal, n_blocks,
     qg, kg, vg = exchange_fwd(q), exchange_fwd(k), exchange_fwd(v)
     T = qg.shape[1]
     s = _block_scores(qg, kg, scale)  # [B, H/cp, T, T]
+    if kpad is not None:
+        kp_full = jax.lax.all_gather(kpad, axis_name, axis=1, tiled=True)
+        s = s + kp_full[:, None, None, :]
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate > 0.0:
+        h_local = H // n_blocks
+        rows_g = jnp.arange(T)
+        keep = _keep4d(seed, B, h_local, me * h_local, rows_g, rows_g, T,
+                       dropout_rate)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     out = jnp.einsum("bhts,bshd->bthd", p, vg.astype(jnp.float32))
     out = out.astype(q.dtype)
     # [B, T, H/cp, hd] -> [B, Tl, H, hd]
@@ -128,9 +200,14 @@ def ulysses_attention_local(q, k, v, *, scale, causal, n_blocks,
     )
 
 
-def cp_attention(q, k, v, *, scale, causal, impl=None):
+def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
+                 dropout_rate=0.0, seed=None):
     """Context-parallel attention over logically-full [B, T, H, hd] inputs
-    whose sequence axis is sharded over the cp mesh axis."""
+    whose sequence axis is sharded over the cp mesh axis.
+
+    ``kpad``: additive key-padding bias [B, T] (or None). ``seed``: int32
+    scalar enabling dropout at ``dropout_rate``.
+    """
     n = cp_size()
     mesh = state.mesh
     impl = impl or state.cfg.context_parallel_impl
@@ -139,18 +216,71 @@ def cp_attention(q, k, v, *, scale, causal, impl=None):
         raise SMPValidationError(
             f"Sequence length {T} must be divisible by context_parallel_degree {n}."
         )
-    body = {
-        "ring": ring_attention_local,
-        "ulysses": ulysses_attention_local,
-    }[impl]
-    fn = functools.partial(body, scale=scale, causal=causal, n_blocks=n)
+    if dropout_rate > 0.0 and seed is None:
+        dropout_rate = 0.0
+
+    zigzag = bool(causal) and impl == "ring" and (T // n) % 2 == 0 and n > 1
+    if zigzag:
+        # Re-layout the sequence so each device holds complementary
+        # half-chunks of the causal triangle; undone on the way out. The
+        # permutation is a gather on the cp-sharded axis (one ICI shuffle).
+        zig = _zig_index(n, T // (2 * n))
+        inv = np.argsort(zig)
+        q, k, v = (jnp.take(x, zig, axis=1) for x in (q, k, v))
+        if kpad is not None:
+            kpad = jnp.take(kpad, zig, axis=1)
+
+    if impl == "ring":
+        body = functools.partial(
+            ring_attention_local, scale=scale, causal=causal, n_blocks=n,
+            zigzag=zigzag, dropout_rate=dropout_rate,
+        )
+    elif impl == "ulysses":
+        body = functools.partial(
+            ulysses_attention_local, scale=scale, causal=causal, n_blocks=n,
+            dropout_rate=dropout_rate,
+        )
+    else:
+        raise SMPValidationError(f"Unknown context_parallel_impl {impl!r}")
+
     spec = P(None, CP_AXIS, None, None)
+    out = _call_with_optionals(body, mesh, spec, q, k, v, kpad, seed)
+    if zigzag:
+        out = jnp.take(out, inv, axis=1)
+    return out
+
+
+def _call_with_optionals(body, mesh, spec, q, k, v, kpad, seed):
+    """shard_map with optional operands: build the exact arg list and
+    matching specs (None operands are dropped, the body receives None)."""
+    in_specs = [spec, spec, spec]
+    call_args = [q, k, v]
+    has_kp = kpad is not None
+    has_seed = seed is not None
+    if has_kp:
+        in_specs.append(P(None, CP_AXIS))
+        call_args.append(kpad.astype(jnp.float32))
+    if has_seed:
+        in_specs.append(P())
+        call_args.append(jnp.asarray(seed, jnp.int32))
+
+    def fn(*args):
+        it = iter(args)
+        q, k, v = next(it), next(it), next(it)
+        kp = next(it) if has_kp else None
+        sd = next(it) if has_seed else None
+        return body(q, k, v, kp, sd)
+
     shard_fn = jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=tuple(in_specs),
         out_specs=spec,
         axis_names={CP_AXIS},
         check_vma=False,
     )
-    return shard_fn(q, k, v)
+    # Partial-manual shard_map must be staged under a jit trace (eager
+    # dispatch rejects partial-manual specs). A nested jit wrapper covers
+    # every caller: inlined when already tracing (the compiled step),
+    # compiled when called eagerly (the init/trace pass).
+    return jax.jit(lambda *a: shard_fn(*a))(*call_args)

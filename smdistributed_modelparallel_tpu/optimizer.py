@@ -207,7 +207,7 @@ class DistributedOptimizer:
         from smdistributed_modelparallel_tpu.shard_io import shard_payload
 
         self._ensure_state()
-        return shard_payload(self._opt_state)
+        return shard_payload(self._opt_state, dedupe_global=False)
 
     def load_sharded(self, catalog):
         """Load a sharded optimizer checkpoint (``shard_io`` catalog)."""

@@ -150,12 +150,14 @@ def _layer_cost_inputs(model, spec):
     """(param_bytes_per_layer, time_cost_per_layer) for the cost model.
 
     Parameter bytes come from the materialized stacked layer subtree
-    (``jax.eval_shape``-equivalent — shapes are concrete by partition time);
-    time costs from ``spec.layer_costs`` when the model declares them
-    (heterogeneous stacks, e.g. windowed/alternating attention), else
-    uniform — the reference's timed trace costs
-    (``torch/module_manager.py:435-499``) are declared rather than measured
-    because one compiled SPMD program has no per-module eager timings.
+    (shapes are concrete by partition time). Time costs: declared
+    ``spec.layer_costs`` first; otherwise, for heterogeneous stacks
+    (distinct per-layer xs, e.g. GPT-Neo local/global alternation), each
+    distinct layer variant is MEASURED with a one-time timed run on the
+    current device — the reference's 5-trial timed trace
+    (``torch/patches/tracing.py:41-86``, ``torch/module_manager.py:
+    435-499``); homogeneous stacks stay uniform. ``skip_tracing`` disables
+    the measurement.
     """
     L = spec.num_layers
     params = model._params
@@ -168,13 +170,113 @@ def _layer_cost_inputs(model, spec):
             ) / max(L, 1)
         except (KeyError, TypeError):
             pbytes = 0.0
-    times = list(spec.layer_costs) if spec.layer_costs else [1.0] * L
+    times = list(spec.layer_costs) if spec.layer_costs else None
+    if times is None:
+        times = _measured_layer_times(model, spec)
+    if times is None:
+        times = [1.0] * L
     if len(times) != L:
         raise PartitionError(
             f"pipeline_spec.layer_costs has {len(times)} entries for "
             f"{L} layers."
         )
     return [pbytes] * L, times
+
+
+# Test hook: callable(sig, fn, args) -> seconds, replacing the wall-clock
+# timer (CPU test tiers can't observe kernel-level cost differences).
+_LAYER_TIMER = None
+
+
+def _time_call(sig, fn, *args):
+    import time
+
+    import numpy as np
+
+    if _LAYER_TIMER is not None:
+        return _LAYER_TIMER(sig, fn, args)
+
+    def run():
+        out = fn(*args)
+        # Force completion with a readback (block_until_ready is not
+        # reliable through tunneled TPU transports).
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+
+    run()  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _measured_layer_times(model, spec):
+    """Per-layer time costs measured per distinct xs variant, or None when
+    measurement is off / impossible / pointless (homogeneous stack)."""
+    import numpy as np
+
+    cfg = state.cfg
+    if cfg is None or cfg.skip_tracing or spec.layer_xs is None:
+        return None
+    if model._params is None:
+        return None
+    xs_np = {k: np.asarray(v) for k, v in spec.layer_xs.items()}
+    keys = sorted(k for k in xs_np if k != "layer_idx")
+    if not keys:
+        return None
+    L = spec.num_layers
+    sigs = [tuple(xs_np[k][i].item() for k in keys) for i in range(L)]
+    if len(set(sigs)) < 2:
+        return None
+    D = getattr(spec.layer_module, "hidden_size", None) or getattr(
+        spec.layer_module, "d_model", None
+    )
+    if not D:
+        return None
+    try:
+        sub = _get_subtree(model._params, spec.layer_path)
+    except (KeyError, TypeError):
+        return None
+    lp = jax.tree_util.tree_map(lambda a: a[0], sub)
+    T = int(getattr(spec.layer_module, "causal_mask_size", None) or 128)
+    T = max(8, min(T, 512))
+    x = jnp.zeros((2, T, D), jnp.float32)
+    rngs = {"dropout": jax.random.key(0)}
+
+    times_by_sig = {}
+    for sig in sorted(set(sigs)):
+        xs_one = {k: jnp.asarray(v) for k, v in zip(keys, sig)}
+        if "layer_idx" in xs_np:
+            xs_one["layer_idx"] = jnp.asarray(0, jnp.int32)
+
+        def fn(lp, x, _xs=xs_one):
+            if spec.carry_is_tuple:
+                return spec.layer_module.apply(
+                    {"params": lp}, x, cross_states=None,
+                    attention_mask=None, xs=_xs, rngs=rngs,
+                )
+            return spec.layer_module.apply(
+                {"params": lp}, x, xs=_xs, rngs=rngs
+            )
+
+        times_by_sig[sig] = _time_call(sig, jax.jit(fn), lp, x)
+    if jax.process_count() > 1:
+        # Multi-controller agreement: every process must derive the SAME
+        # boundaries (different stage splits would compile divergent SPMD
+        # programs and hang the first collective). Process 0's timings win
+        # — the reference broadcasts its trace results the same way
+        # (torch/server.py:264).
+        from jax.experimental import multihost_utils
+
+        vals = np.asarray([times_by_sig[s] for s in sorted(times_by_sig)])
+        vals = multihost_utils.broadcast_one_to_all(vals)
+        times_by_sig = dict(zip(sorted(times_by_sig), vals.tolist()))
+    logger.info(
+        "Measured layer-variant costs: %s",
+        {str(k): round(v, 6) for k, v in times_by_sig.items()},
+    )
+    return [times_by_sig[s] for s in sigs]
 
 
 def _choose_boundaries(model, spec, pp):

@@ -37,19 +37,33 @@ def _index_to_json(index, shape):
     return json.dumps(out)
 
 
-def shard_payload(tree):
-    """This process's replica-0 addressable shards of ``tree`` as a flat
+def shard_payload(tree, dedupe_global=True):
+    """This process's addressable shards of ``tree`` as a flat
     ``{"path|bounds": np.ndarray}`` dict (the ``local_state_dict``
-    representation; also the npz file layout)."""
+    representation; also the npz file layout).
+
+    ``dedupe_global=True`` (checkpoint files): only replica-0 shards, so
+    each global element is stored exactly once ACROSS processes.
+    ``dedupe_global=False`` (``local_state_dict``): the lowest-replica
+    addressable shard per index, so every process's payload is complete
+    for its addressable data even when replica 0 lives elsewhere.
+    """
     payload = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         key = path_key(path)
         if not isinstance(leaf, jax.Array):
             payload[f"{key}{_SEP}full"] = np.asarray(leaf)
             continue
-        for shard in leaf.addressable_shards:
-            if shard.replica_id != 0:
-                continue
+        if dedupe_global:
+            chosen = [s for s in leaf.addressable_shards if s.replica_id == 0]
+        else:
+            by_index = {}
+            for s in leaf.addressable_shards:
+                k = _index_to_json(s.index, leaf.shape)
+                if k not in by_index or s.replica_id < by_index[k].replica_id:
+                    by_index[k] = s
+            chosen = list(by_index.values())
+        for shard in chosen:
             idx = _index_to_json(shard.index, leaf.shape)
             payload[f"{key}{_SEP}{idx}"] = np.asarray(shard.data)
     return payload

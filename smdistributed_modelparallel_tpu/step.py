@@ -178,7 +178,8 @@ class StepFunction:
             step_fn._has_backward = loss is not None
             return jnp.zeros(())
 
-        jax.eval_shape(probe, model.params)
+        with jax.set_mesh(state.mesh):
+            jax.eval_shape(probe, model.params)
 
     # ------------------------------------------------------------------
 

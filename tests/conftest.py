@@ -51,6 +51,10 @@ _SLOW_TESTS = (
     "test_memory_systems.py::TestOptimizerStateSharding::test_zero1_moments_sharded",
     "test_partition_wiring.py::TestCostDrivenBoundaries",
     "test_partition_wiring.py::TestManualPins",
+    "test_partition_wiring.py::TestMeasuredLayerCosts",
+    "test_checkpoint.py::TestShardedCheckpoint",
+    "test_huggingface.py::TestEndToEnd",
+    "test_optimizer.py::test_aot_executable_reused",
     "test_pipeline.py::test_pp2_with_more_microbatches",
     "test_pipeline.py::test_pp_matches_single_stage",
     "test_pipeline.py::test_pp_non_divisible_layers_pad",
