@@ -1,0 +1,454 @@
+// Host-side control-plane message bus.
+//
+// Parity target: the reference's native async object collectives (SURVEY
+// §2.1 N2): smp_async_send / smp_async_recv / smp_wait_recv / smp_poll_recv
+// / smp_retrieve_object / smp_clean_recv_resources, called from
+// backend/collectives.py:233-324 — pickled-bytes P2P keyed by
+// (src, transaction-id), serviced by a background listener thread.
+//
+// The reference rides MPI; TPU pods have no MPI, and device-level data
+// movement happens inside compiled XLA programs over ICI.  What the host
+// control plane still needs — checkpoint rendezvous, partition-result
+// exchange, user smp.send/smp.recv_from — is a small TCP mesh between
+// *processes* (one per host), built here:
+//
+//   - one listener thread accepts peer connections and demultiplexes
+//     frames into an (src, tx) -> payload-queue map;
+//   - sends are enqueued and drained by one sender thread per peer, so
+//     smp_async_send never blocks on the network;
+//   - waits use a condition variable (no spin);
+//   - a group barrier (all-to-min then release, reserved tx namespace)
+//     gives smp.barrier(group) real subgroup semantics.
+//
+// Wire format per frame: magic(u32) src(i32) tx(i64) len(i64) payload[len].
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x534d5054;  // "SMPT"
+
+struct Frame {
+  int32_t src;
+  int64_t tx;
+  std::vector<uint8_t> payload;
+};
+
+struct FrameHeader {
+  uint32_t magic;
+  int32_t src;
+  int64_t tx;
+  int64_t len;
+} __attribute__((packed));
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class MessageBus {
+ public:
+  MessageBus() = default;
+  ~MessageBus() { Shutdown(); }
+
+  // Phase 1: bind + start the listener; returns the bound port (supports
+  // port 0 -> ephemeral, so Python can exchange real endpoints afterwards).
+  int Listen(int port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return -1;
+    if (::listen(listen_fd_, 64) < 0) return -1;
+    socklen_t alen = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    running_.store(true);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return ntohs(addr.sin_port);
+  }
+
+  // Phase 2: record identity + peer endpoints ("host:port,host:port,...").
+  int Connect(int rank, int world, const std::string& endpoints) {
+    rank_ = rank;
+    world_ = world;
+    peers_.clear();
+    size_t start = 0;
+    while (start <= endpoints.size() && !endpoints.empty()) {
+      size_t comma = endpoints.find(',', start);
+      std::string item = endpoints.substr(
+          start, comma == std::string::npos ? std::string::npos : comma - start);
+      size_t colon = item.rfind(':');
+      if (colon == std::string::npos) return -1;
+      peers_.push_back({item.substr(0, colon),
+                        std::stoi(item.substr(colon + 1))});
+      if (comma == std::string::npos) break;
+      start = comma + 1;
+    }
+    if (static_cast<int>(peers_.size()) != world) return -1;
+    send_threads_.resize(world);
+    send_queues_ = std::vector<SendQueue>(world);
+    connected_ = true;
+    return 0;
+  }
+
+  int AsyncSend(int dest, const uint8_t* data, int64_t len, int64_t tx) {
+    if (dest == rank_ || (!connected_ && dest == 0 && rank_ == 0)) {
+      // Self-send: deliver locally, no socket round-trip.  Also serves the
+      // single-process (world=1, never-connected) configuration.
+      Frame f{rank_, tx, std::vector<uint8_t>(data, data + len)};
+      Deliver(std::move(f));
+      return 0;
+    }
+    if (!connected_ || dest < 0 || dest >= world_) return -1;
+    {
+      std::lock_guard<std::mutex> lk(send_queues_[dest].mu);
+      send_queues_[dest].frames.push_back(
+          Frame{rank_, tx, std::vector<uint8_t>(data, data + len)});
+    }
+    StartSender(dest);
+    send_queues_[dest].cv.notify_all();
+    return 0;
+  }
+
+  int PollRecv(int src, int64_t tx) {
+    std::lock_guard<std::mutex> lk(recv_mu_);
+    auto it = inbox_.find(Key(src, tx));
+    return (it != inbox_.end() && !it->second.empty()) ? 1 : 0;
+  }
+
+  // Blocks until a frame for (src, tx) arrives; returns its length, or -1
+  // on timeout (timeout_ms < 0 -> wait forever), or -2 on shutdown.
+  int64_t WaitRecv(int src, int64_t tx, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(recv_mu_);
+    auto ready = [&] {
+      auto it = inbox_.find(Key(src, tx));
+      return it != inbox_.end() && !it->second.empty();
+    };
+    if (timeout_ms < 0) {
+      recv_cv_.wait(lk, [&] { return ready() || !running_.load(); });
+    } else if (!recv_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                  [&] { return ready() || !running_.load(); })) {
+      return -1;
+    }
+    if (!ready()) return -2;
+    return static_cast<int64_t>(inbox_[Key(src, tx)].front().size());
+  }
+
+  // Copies the frontmost (src, tx) payload out and removes it.
+  int64_t Retrieve(int src, int64_t tx, uint8_t* out, int64_t cap) {
+    std::lock_guard<std::mutex> lk(recv_mu_);
+    auto it = inbox_.find(Key(src, tx));
+    if (it == inbox_.end() || it->second.empty()) return -1;
+    auto& payload = it->second.front();
+    auto len = static_cast<int64_t>(payload.size());
+    if (len > cap) return -3;
+    std::memcpy(out, payload.data(), payload.size());
+    it->second.pop_front();
+    if (it->second.empty()) inbox_.erase(it);
+    return len;
+  }
+
+  void CleanRecvResources(int src, int64_t tx) {
+    std::lock_guard<std::mutex> lk(recv_mu_);
+    inbox_.erase(Key(src, tx));
+  }
+
+  // Group barrier over the bus.  Every member sends a token to the lowest
+  // member; the lowest waits for all, then sends a release to each.  Tx ids
+  // live in a reserved negative namespace keyed by a per-group counter so
+  // interleaved barriers on different groups never collide.
+  int Barrier(const int* ranks, int n, int timeout_ms) {
+    if (n <= 1) return 0;
+    std::vector<int> group(ranks, ranks + n);
+    int root = *std::min_element(group.begin(), group.end());
+    int64_t seq;
+    {
+      std::lock_guard<std::mutex> lk(barrier_mu_);
+      seq = ++barrier_seq_[GroupHash(group)];
+    }
+    // tx = -(2*(hash*K + seq)) for arrive, -1 offset for release.
+    int64_t base = -((GroupHash(group) % 100003) * 1000003 + seq) * 2;
+    uint8_t token = 1;
+    if (rank_ == root) {
+      for (int r : group) {
+        if (r == root) continue;
+        if (WaitRecv(r, base, timeout_ms) < 0) return -1;
+        Retrieve(r, base, &token, 1);
+      }
+      for (int r : group) {
+        if (r == root) continue;
+        if (AsyncSend(r, &token, 1, base - 1) != 0) return -1;
+      }
+    } else {
+      if (AsyncSend(root, &token, 1, base) != 0) return -1;
+      if (WaitRecv(root, base - 1, timeout_ms) < 0) return -1;
+      Retrieve(root, base - 1, &token, 1);
+    }
+    return 0;
+  }
+
+  void Shutdown() {
+    if (shut_.exchange(true)) return;
+    // Phase 1: drain outgoing queues. Barrier releases and user sends are
+    // async (enqueue-only), so a process may reach shutdown with frames
+    // still queued for peers that are blocked waiting on them; killing the
+    // senders first would strand those peers until their timeouts.
+    send_stop_.store(true);
+    for (auto& q : send_queues_) q.cv.notify_all();
+    for (auto& t : send_threads_)
+      if (t.joinable()) t.join();
+    // Phase 2: stop the receive side. Shut accepted sockets down BEFORE
+    // joining: RecvLoop threads block in read() on sockets whose remote end
+    // is a peer also shutting down — joining first would deadlock two
+    // exiting processes on each other.
+    running_.store(false);
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    recv_cv_.notify_all();
+    {
+      std::lock_guard<std::mutex> lk(fd_mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+    std::lock_guard<std::mutex> lk(fd_mu_);
+    for (int fd : conn_fds_) ::close(fd);
+    conn_fds_.clear();
+  }
+
+ private:
+  struct SendQueue {
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<Frame> frames;
+    int fd = -1;
+    bool thread_started = false;
+  };
+
+  static uint64_t Key(int src, int64_t tx) {
+    return (static_cast<uint64_t>(static_cast<uint32_t>(src)) << 48) ^
+           static_cast<uint64_t>(tx);
+  }
+
+  static uint64_t GroupHash(const std::vector<int>& group) {
+    uint64_t h = 1469598103934665603ull;
+    for (int r : group) {
+      h ^= static_cast<uint64_t>(r) + 1;
+      h *= 1099511628211ull;
+    }
+    return h;
+  }
+
+  void Deliver(Frame&& f) {
+    {
+      std::lock_guard<std::mutex> lk(recv_mu_);
+      inbox_[Key(f.src, f.tx)].push_back(std::move(f.payload));
+    }
+    recv_cv_.notify_all();
+  }
+
+  void AcceptLoop() {
+    while (running_.load()) {
+      sockaddr_in peer{};
+      socklen_t plen = sizeof(peer);
+      int fd = ::accept(listen_fd_, reinterpret_cast<sockaddr*>(&peer), &plen);
+      if (fd < 0) break;
+      {
+        std::lock_guard<std::mutex> lk(fd_mu_);
+        conn_fds_.push_back(fd);
+        conn_threads_.emplace_back([this, fd] { RecvLoop(fd); });
+      }
+    }
+  }
+
+  void RecvLoop(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (running_.load()) {
+      FrameHeader h{};
+      if (!read_exact(fd, &h, sizeof(h)) || h.magic != kMagic) break;
+      Frame f;
+      f.src = h.src;
+      f.tx = h.tx;
+      f.payload.resize(static_cast<size_t>(h.len));
+      if (h.len > 0 && !read_exact(fd, f.payload.data(), f.payload.size()))
+        break;
+      Deliver(std::move(f));
+    }
+  }
+
+  void StartSender(int dest) {
+    std::lock_guard<std::mutex> lk(send_queues_[dest].mu);
+    if (send_queues_[dest].thread_started) return;
+    send_queues_[dest].thread_started = true;
+    send_threads_[dest] = std::thread([this, dest] { SendLoop(dest); });
+  }
+
+  void SendLoop(int dest) {
+    auto& q = send_queues_[dest];
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(peers_[dest].second));
+    ::inet_pton(AF_INET, peers_[dest].first.c_str(), &addr.sin_addr);
+    // Retry connect: peers come up in arbitrary order.
+    for (int attempt = 0; attempt < 600; ++attempt) {
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0)
+        break;
+      if (shut_.load() || attempt == 599) {
+        ::close(fd);
+        return;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (true) {
+      Frame f;
+      {
+        std::unique_lock<std::mutex> lk(q.mu);
+        q.cv.wait(lk, [&] {
+          return !q.frames.empty() || send_stop_.load();
+        });
+        if (q.frames.empty()) {
+          if (send_stop_.load()) break;  // drained; safe to exit
+          continue;
+        }
+        f = std::move(q.frames.front());
+        q.frames.pop_front();
+      }
+      FrameHeader h{kMagic, f.src, f.tx,
+                    static_cast<int64_t>(f.payload.size())};
+      if (!write_exact(fd, &h, sizeof(h))) break;
+      if (!f.payload.empty() &&
+          !write_exact(fd, f.payload.data(), f.payload.size()))
+        break;
+    }
+    ::close(fd);  // sender-owned; not in conn_fds_
+  }
+
+  std::atomic<bool> running_{false};
+  std::atomic<bool> send_stop_{false};
+  std::atomic<bool> shut_{false};
+  bool connected_ = false;
+  int rank_ = 0;
+  int world_ = 1;
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  std::vector<std::pair<std::string, int>> peers_;
+
+  std::vector<SendQueue> send_queues_;
+  std::vector<std::thread> send_threads_;
+  std::vector<std::thread> conn_threads_;
+  std::mutex fd_mu_;
+  std::vector<int> conn_fds_;
+
+  std::mutex recv_mu_;
+  std::condition_variable recv_cv_;
+  std::map<uint64_t, std::deque<std::vector<uint8_t>>> inbox_;
+
+  std::mutex barrier_mu_;
+  std::map<uint64_t, int64_t> barrier_seq_;
+};
+
+MessageBus* g_bus = nullptr;
+std::mutex g_bus_mu;
+
+}  // namespace
+
+extern "C" {
+
+int smp_bus_listen(int port) {
+  std::lock_guard<std::mutex> lk(g_bus_mu);
+  if (g_bus == nullptr) g_bus = new MessageBus();
+  return g_bus->Listen(port);
+}
+
+int smp_bus_connect(int rank, int world, const char* endpoints) {
+  std::lock_guard<std::mutex> lk(g_bus_mu);
+  if (g_bus == nullptr) return -1;
+  return g_bus->Connect(rank, world, endpoints ? endpoints : "");
+}
+
+int smp_async_send(int dest, const uint8_t* data, int64_t len, int64_t tx) {
+  if (g_bus == nullptr) return -1;
+  return g_bus->AsyncSend(dest, data, len, tx);
+}
+
+int smp_poll_recv(int src, int64_t tx) {
+  if (g_bus == nullptr) return 0;
+  return g_bus->PollRecv(src, tx);
+}
+
+int64_t smp_wait_recv(int src, int64_t tx, int timeout_ms) {
+  if (g_bus == nullptr) return -2;
+  return g_bus->WaitRecv(src, tx, timeout_ms);
+}
+
+int64_t smp_retrieve_object(int src, int64_t tx, uint8_t* out, int64_t cap) {
+  if (g_bus == nullptr) return -1;
+  return g_bus->Retrieve(src, tx, out, cap);
+}
+
+void smp_clean_recv_resources(int src, int64_t tx) {
+  if (g_bus != nullptr) g_bus->CleanRecvResources(src, tx);
+}
+
+int smp_bus_barrier(const int* ranks, int n, int timeout_ms) {
+  if (g_bus == nullptr) return -1;
+  return g_bus->Barrier(ranks, n, timeout_ms);
+}
+
+void smp_bus_shutdown() {
+  std::lock_guard<std::mutex> lk(g_bus_mu);
+  if (g_bus != nullptr) {
+    g_bus->Shutdown();
+    delete g_bus;
+    g_bus = nullptr;
+  }
+}
+
+}  // extern "C"

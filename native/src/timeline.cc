@@ -1,0 +1,180 @@
+// Native pipeline-timeline recorder.
+//
+// Parity target: the reference's C++ timeline (SURVEY §2.1 N5:
+// smp_create_timeline / smp_timeline_start_step / smp_timeline_end_step /
+// smp_timeline_record_pipeline_event, bracketed around every server action
+// in torch/server.py:366-478).  The reference records from a hot event loop,
+// so it lives in C++; here the hot path is inside compiled XLA programs, but
+// host-side step brackets still fire per step and per microbatch phase, and
+// a Python append + dict build is measurable at small step times.  This
+// recorder keeps a preallocated event arena behind a mutex (uncontended in
+// the common single-recording-thread case) and serialises to Chrome-trace
+// JSON (chrome://tracing / Perfetto) only at flush.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  uint32_t name_id;
+  uint32_t track_id;
+  double ts_us;
+  double dur_us;  // < 0 -> instant event
+  int64_t step;
+  int32_t microbatch;  // -1 -> absent
+};
+
+class Timeline {
+ public:
+  explicit Timeline(const std::string& path) : path_(path) {
+    events_.reserve(1 << 16);
+    names_.reserve(256);
+    tracks_.reserve(16);
+  }
+
+  uint32_t Intern(std::vector<std::string>& pool, const char* s) {
+    for (uint32_t i = 0; i < pool.size(); ++i)
+      if (pool[i] == s) return i;
+    pool.emplace_back(s);
+    return static_cast<uint32_t>(pool.size() - 1);
+  }
+
+  void StartStep(int64_t step) {
+    std::lock_guard<std::mutex> lk(mu_);
+    step_ = step;
+  }
+
+  int64_t EndStep(int64_t step) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (step_ == step) step_ = -1;
+    return static_cast<int64_t>(events_.size());
+  }
+
+  void Record(const char* name, double begin_us, double end_us, int mb,
+              const char* track) {
+    std::lock_guard<std::mutex> lk(mu_);
+    events_.push_back(Event{Intern(names_, name), Intern(tracks_, track),
+                            begin_us, end_us - begin_us, step_, mb});
+  }
+
+  void Instant(const char* name, double ts_us, const char* track) {
+    std::lock_guard<std::mutex> lk(mu_);
+    events_.push_back(Event{Intern(names_, name), Intern(tracks_, track),
+                            ts_us, -1.0, step_, -1});
+  }
+
+  static std::string JsonEscape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\t': out += "\\t"; break;
+        case '\r': out += "\\r"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += c;
+          }
+      }
+    }
+    return out;
+  }
+
+  int Flush(int pid) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (path_.empty()) return -1;
+    FILE* f = std::fopen(path_.c_str(), "w");
+    if (f == nullptr) return -1;
+    std::vector<std::string> esc_names, esc_tracks;
+    for (const auto& n : names_) esc_names.push_back(JsonEscape(n));
+    for (const auto& t : tracks_) esc_tracks.push_back(JsonEscape(t));
+    std::fputs("{\"displayTimeUnit\":\"ms\",\"traceEvents\":[", f);
+    for (size_t i = 0; i < events_.size(); ++i) {
+      const Event& e = events_[i];
+      if (i) std::fputc(',', f);
+      if (e.dur_us < 0) {
+        std::fprintf(f,
+                     "{\"name\":\"%s\",\"ph\":\"i\",\"ts\":%.3f,\"pid\":%d,"
+                     "\"tid\":\"%s\",\"s\":\"g\"}",
+                     esc_names[e.name_id].c_str(), e.ts_us, pid,
+                     esc_tracks[e.track_id].c_str());
+      } else {
+        std::fprintf(f,
+                     "{\"name\":\"%s\",\"ph\":\"X\",\"ts\":%.3f,\"dur\":%.3f,"
+                     "\"pid\":%d,\"tid\":\"%s\",\"args\":{\"step\":%lld",
+                     esc_names[e.name_id].c_str(), e.ts_us, e.dur_us, pid,
+                     esc_tracks[e.track_id].c_str(),
+                     static_cast<long long>(e.step));
+        if (e.microbatch >= 0)
+          std::fprintf(f, ",\"microbatch\":%d", e.microbatch);
+        std::fputs("}}", f);
+      }
+    }
+    std::fputs("]}", f);
+    std::fclose(f);
+    return static_cast<int>(events_.size());
+  }
+
+  int64_t Count() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return static_cast<int64_t>(events_.size());
+  }
+
+ private:
+  std::string path_;
+  std::mutex mu_;
+  std::vector<Event> events_;
+  std::vector<std::string> names_;
+  std::vector<std::string> tracks_;
+  int64_t step_ = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* smp_create_timeline(const char* path) {
+  return new Timeline(path ? path : "");
+}
+
+void smp_destroy_timeline(void* t) { delete static_cast<Timeline*>(t); }
+
+void smp_timeline_start_step(void* t, int64_t step) {
+  static_cast<Timeline*>(t)->StartStep(step);
+}
+
+int64_t smp_timeline_end_step(void* t, int64_t step) {
+  return static_cast<Timeline*>(t)->EndStep(step);
+}
+
+void smp_timeline_record_pipeline_event(void* t, const char* name,
+                                        double begin_us, double end_us,
+                                        int microbatch, const char* track) {
+  static_cast<Timeline*>(t)->Record(name, begin_us, end_us, microbatch, track);
+}
+
+void smp_timeline_record_instant(void* t, const char* name, double ts_us,
+                                 const char* track) {
+  static_cast<Timeline*>(t)->Instant(name, ts_us, track);
+}
+
+int smp_timeline_flush(void* t, int pid) {
+  return static_cast<Timeline*>(t)->Flush(pid);
+}
+
+int64_t smp_timeline_event_count(void* t) {
+  return static_cast<Timeline*>(t)->Count();
+}
+
+}  // extern "C"

@@ -1,0 +1,157 @@
+"""Perf probe: decompose the bench gap vs plain JAX on the real chip.
+
+Measures (1) plain-JAX step, (2) full framework step via smp.step +
+optimizer.step, (3) the framework's compiled executable called directly with
+steady-state buffers — isolating device-program time from per-call Python
+dispatch. Not part of the test suite; run manually on TPU.
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.models.gpt2 import gpt2_124m
+
+
+def readback(x):
+    import numpy as np
+
+    return float(np.asarray(x.ravel()[0] if hasattr(x, "ravel") else x))
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    seq_len = 1024 if on_tpu else 64
+    batch = 8 if on_tpu else 4
+    num_mb = 4
+    vocab = 50257
+    model_kwargs = {} if on_tpu else dict(d_model=128, n_layers=2, n_heads=4)
+    iters = 10 if on_tpu else 2
+
+    def ce_loss(logits, ids):
+        lg = logits[:, :-1]
+        tgt = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
+        lse = jax.scipy.special.logsumexp(lg.astype(jnp.float32), axis=-1)
+        return jnp.mean(lse - tgt.astype(jnp.float32))
+
+    ids = jax.random.randint(jax.random.key(0), (batch, seq_len), 0, vocab)
+
+    module = gpt2_124m(max_len=seq_len, **model_kwargs)
+    params0 = jax.jit(module.init)(jax.random.key(0), ids)["params"]
+    tx = optax.adamw(1e-4)
+
+    def base_loss(params, mb):
+        if on_tpu:
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.bfloat16)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+        return ce_loss(module.apply({"params": params}, mb), mb)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def base_train(params, opt_state, ids):
+        mbs = ids.reshape(num_mb, batch // num_mb, seq_len)
+
+        def body(acc, mb):
+            loss, g = jax.value_and_grad(base_loss)(params, mb)
+            return jax.tree_util.tree_map(jnp.add, acc, g), loss
+
+        acc0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        grads, losses = jax.lax.scan(body, acc0, mbs)
+        grads = jax.tree_util.tree_map(lambda g: g / num_mb, grads)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, jnp.mean(losses)
+
+    opt_state0 = jax.jit(tx.init)(params0)
+    p, o, l = base_train(params0, opt_state0, ids)
+    readback(l)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        p, o, l = base_train(p, o, ids)
+    readback(l)
+    base_dt = (time.perf_counter() - t0) / iters
+    print(f"[1] plain-JAX step:            {base_dt*1e3:8.2f} ms")
+    del p, o
+
+    smp.reset()
+    smp.init({"microbatches": num_mb, "bf16": bool(on_tpu)})
+    model = smp.DistributedModel(gpt2_124m(max_len=seq_len, **model_kwargs))
+    optimizer = smp.DistributedOptimizer(optax.adamw(1e-4), model)
+
+    @smp.step
+    def train_step(model, batch_ids):
+        loss = ce_loss(model(batch_ids), batch_ids)
+        model.backward(loss)
+        return loss
+
+    for _ in range(2):
+        out = train_step(model, ids)
+        optimizer.step()
+    readback(out.reduce_mean())
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = train_step(model, ids)
+        optimizer.step()
+    readback(out.reduce_mean())
+    fw_dt = (time.perf_counter() - t0) / iters
+    print(f"[2] smp.step + optimizer.step: {fw_dt*1e3:8.2f} ms")
+
+    # [3] direct compiled-executable loop with steady-state buffers.
+    runner = next(iter(train_step._cache.values()))
+    compiled = runner.holder.get("compiled")
+    print(f"    compiled executable available: {compiled is not None}")
+    if compiled is not None:
+        params = model.params
+        opt_state = optimizer._opt_state
+        from smdistributed_modelparallel_tpu.backend.state import state
+
+        rng = state.step_rng
+        scale = jnp.asarray(1.0, jnp.float32)
+        with jax.set_mesh(state.mesh):
+            g, outs, fin, rng, fused_out = compiled(
+                params, opt_state, [ids], [], rng, scale
+            )
+            jax.block_until_ready(outs)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                g, outs, fin, rng2, fused_out = compiled(
+                    params, opt_state, [ids], [], rng, scale
+                )
+                params, opt_state = fused_out
+                rng = rng2
+            readback(outs)
+            raw_dt = (time.perf_counter() - t0) / iters
+        print(f"[3] direct compiled call:      {raw_dt*1e3:8.2f} ms")
+        print(f"    python dispatch overhead [2]-[3]: {(fw_dt-raw_dt)*1e3:6.2f} ms")
+        print(f"    device-program gap [3]-[1]:       {(raw_dt-base_dt)*1e3:6.2f} ms")
+
+    # HLO cost comparison.
+    from smdistributed_modelparallel_tpu.utils.metrics import one_time_compile_report  # noqa
+
+    bl = base_train.lower(params0, opt_state0, ids).compile()
+    ca_b = bl.cost_analysis()
+    ca_f = compiled.cost_analysis() if compiled is not None else None
+    for nm, ca in (("baseline", ca_b), ("framework", ca_f)):
+        if ca is None:
+            continue
+        if isinstance(ca, list):
+            ca = ca[0]
+        print(f"    {nm}: flops={ca.get('flops', 0):.3e} "
+              f"bytes={ca.get('bytes accessed', 0):.3e}")
+    mem_b = bl.memory_analysis()
+    print(f"    baseline temp bytes: {getattr(mem_b, 'temp_size_in_bytes', None)}")
+    if compiled is not None:
+        mem_f = compiled.memory_analysis()
+        print(f"    framework temp bytes: {getattr(mem_f, 'temp_size_in_bytes', None)}")
+
+
+if __name__ == "__main__":
+    main()

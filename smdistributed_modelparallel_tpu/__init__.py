@@ -55,6 +55,7 @@ from smdistributed_modelparallel_tpu.nn.tp_registry import (
     tp_register_with_module,
 )
 from smdistributed_modelparallel_tpu.nn.huggingface import from_hf
+from smdistributed_modelparallel_tpu import amp
 from smdistributed_modelparallel_tpu import nn
 
 __version__ = "0.1.0"
@@ -195,7 +196,59 @@ def get_mesh():
 
 
 def barrier(group=CommGroup.WORLD):
-    state.core.barrier()
+    """Barrier over the host processes of `group` (subgroup barriers ride
+    the native message bus; see backend/collectives.py)."""
+    state.comm.barrier(group=group)
+
+
+def mp_barrier():
+    barrier(CommGroup.MP_GROUP)
+
+
+def pp_barrier():
+    barrier(CommGroup.PP_GROUP)
+
+
+def dp_barrier():
+    barrier(CommGroup.DP_GROUP)
+
+
+def tp_barrier():
+    barrier(CommGroup.TP_GROUP)
+
+
+def rdp_barrier():
+    barrier(CommGroup.RDP_GROUP)
+
+
+def broadcast(obj, group=CommGroup.WORLD, src=0):
+    """Broadcast a picklable object across the processes of `group`.
+    Parity: reference ``smp.broadcast`` (``backend/collectives.py``)."""
+    return state.comm.broadcast(obj, group=group, src=src)
+
+
+def allgather(obj, group=CommGroup.WORLD):
+    """Gather a picklable object from every process of `group`."""
+    return state.comm.allgather(obj, group=group)
+
+
+def send(obj, dest, group=CommGroup.WORLD):
+    """Async-send a picklable object to process `dest` of `group` over the
+    native message bus. Parity: reference ``smp.send``."""
+    state.comm.send(obj, dest, group=group)
+
+
+def recv_from(src, group=CommGroup.WORLD):
+    """Receive the next in-order object from process `src` of `group`.
+    Parity: reference ``smp.recv_from``."""
+    return state.comm.recv_from(src, group=group)
+
+
+def is_tracing():
+    """True inside the first-step init/trace pass (parity: reference
+    ``smp.is_tracing`` — the module-server trace phase; here the eager
+    microbatch-0 run that materializes params and discovers backward)."""
+    return bool(getattr(state, "_tracing", False))
 
 
 def process_index():
@@ -204,6 +257,15 @@ def process_index():
 
 def process_count():
     return state.core.process_count()
+
+
+# Process-group aliases (reference naming: get_*_process_group).
+get_pp_process_group = get_pp_group
+get_tp_process_group = get_tp_group
+get_dp_process_group = get_dp_group
+get_rdp_process_group = get_rdp_group
+get_mp_process_group = get_mp_group
+get_world_process_group = get_world_group
 
 
 # -- partition / tp / checkpoint annotation APIs ------------------------

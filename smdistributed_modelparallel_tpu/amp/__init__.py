@@ -19,8 +19,8 @@ class GradScaler(DynamicLossScaler):
             init_scale=init_scale,
             scale_factor=growth_factor,
             scale_window=growth_interval,
+            backoff_factor=backoff_factor,
         )
-        self.backoff_factor = backoff_factor
         self.enabled = enabled
 
     def scale(self, loss):

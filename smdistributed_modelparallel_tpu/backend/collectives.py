@@ -6,12 +6,28 @@ C++ async object P2P layer (SURVEY §2.1 N2). The TPU build's control plane
 needs far less: under SPMD there is one program, so the reference's
 trace-result broadcast / request routing vanish. What remains is host-level
 coordination between *processes* (config agreement, partition-result
-broadcast under multi-host, checkpoint rendezvous), implemented over
-``jax.experimental.multihost_utils`` — pickled objects ride a uint8 device
-array broadcast. Single-process runs short-circuit to local no-ops.
+broadcast under multi-host, checkpoint rendezvous, user-level object
+send/recv), carried two ways:
+
+- broadcast/allgather ride ``jax.experimental.multihost_utils`` (pickled
+  objects as uint8 device arrays) — always available;
+- point-to-point ``send``/``recv_from`` and *subgroup* barriers ride the
+  native TCP message bus (``native/src/message_bus.cc``, loaded through
+  ``backend/native.py``) — the reference's N2 layer rebuilt for hosts
+  without MPI. Transaction ids follow the reference's
+  ``TransactionIdentifier`` convention (2*id + is_user_api,
+  ``backend/collectives.py:61-66``): user sends use a per-peer-pair
+  monotonic sequence so ``recv_from(src)`` is in-order, like the reference's
+  user API.
+
+Single-process runs short-circuit: broadcast/allgather are local no-ops and
+P2P self-sends are delivered through the bus's local inbox.
 """
 
+import atexit
+import os
 import pickle
+import socket
 from enum import Enum
 
 import numpy as np
@@ -20,6 +36,9 @@ import jax
 
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.utils.exceptions import SMPRuntimeError
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
 
 
 class CommGroup(Enum):
@@ -43,24 +62,189 @@ class RankType(Enum):
     MP_RANK = 5
 
 
+def _local_ip():
+    """Best-effort routable address of this host for peer connections."""
+    override = os.environ.get("SMP_BUS_HOST")
+    if override:
+        return override
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
 class CollectiveCommunicator:
-    """Object broadcast/allgather across *host processes*.
+    """Object broadcast/allgather/P2P across *host processes*.
 
     Note: reference collectives address per-GPU ranks; here device-level
     data movement happens inside compiled programs (psum/all_gather/...),
-    and this class only coordinates host processes.
+    and this class only coordinates host processes. ``dest``/``src`` for
+    P2P are therefore ranks within the *process set* of the given group.
     """
 
     def __init__(self):
-        self._tx_counter = 0
+        self._bus = None
+        self._bus_failed = False
+        self._send_seq = {}
+        self._recv_seq = {}
+        # Internal (framework) P2P streams, kept separate from the user
+        # API's: internal tx ids are even (is_user_api=0), user odd.
+        self._int_send_seq = {}
+        self._int_recv_seq = {}
 
     def _multi(self):
         return jax.process_count() > 1
 
+    # -- bus lifecycle --------------------------------------------------
+
+    def initialize_bus(self):
+        """Bring the native message bus up. Multi-process endpoint exchange
+        is a GLOBAL collective, so this must run at ``smp.init`` time (every
+        process participates there); bringing it up lazily from a subgroup
+        operation would deadlock the processes that never touch the bus.
+        Single-process bring-up involves no collective and stays lazy.
+        Returns the bus, or None when the native library is unavailable."""
+        if self._bus is not None:
+            return self._bus
+        if self._bus_failed:
+            return None
+        from smdistributed_modelparallel_tpu.backend import native
+
+        lib = native.load()
+        world = jax.process_count()
+        # Local bring-up first (library load + listener bind), then ONE
+        # collective endpoint exchange that every process enters no matter
+        # what happened locally — heterogeneous failures (missing .so, bind
+        # error) must disable the bus consistently everywhere rather than
+        # strand the healthy processes inside the collective.
+        bus, endpoint = None, None
+        if lib is not None:
+            bus = native.MessageBus(lib)
+            try:
+                port = bus.listen(0)
+                endpoint = f"{_local_ip()}:{port}"
+            except OSError as e:
+                logger.warning("native bus listener failed: %s", e)
+                bus.shutdown()
+                bus = None
+        if world == 1:
+            if bus is None:
+                self._bus_failed = True
+                return None
+            bus.connect(0, 1, [endpoint])
+        else:
+            endpoints = self._allgather_endpoints(endpoint)
+            if any(e is None for e in endpoints):
+                if bus is not None:
+                    bus.shutdown()
+                logger.warning(
+                    "native message bus disabled: unavailable on at least "
+                    "one peer process."
+                )
+                self._bus_failed = True
+                return None
+            # The gathered list is identical on every process, so a connect
+            # failure (malformed endpoint) is deterministic — raise rather
+            # than leave processes in divergent states.
+            bus.connect(jax.process_index(), world, endpoints)
+        self._bus = bus
+        atexit.register(self.shutdown)
+        logger.debug("native message bus up at %s", endpoint)
+        return bus
+
+    @staticmethod
+    def _allgather_endpoints(endpoint):
+        """One fixed-width collective to exchange "host:port" strings (the
+        generic object allgather is O(P) sequential broadcasts — too slow
+        for the init critical path at pod scale). None (local bring-up
+        failed) travels as an all-zero row."""
+        from jax.experimental import multihost_utils
+
+        width = 256  # SMP_BUS_HOST may be a long FQDN, not just an IP
+        row = np.zeros(width, dtype=np.uint8)
+        if endpoint is not None:
+            enc = endpoint.encode()
+            if len(enc) > width:
+                raise SMPRuntimeError(
+                    f"bus endpoint {endpoint!r} exceeds {width} bytes; "
+                    "shorten SMP_BUS_HOST."
+                )
+            row[: len(enc)] = np.frombuffer(enc, dtype=np.uint8)
+        gathered = np.asarray(multihost_utils.process_allgather(row))
+        out = []
+        for r in gathered:
+            s = bytes(r).rstrip(b"\0").decode()
+            out.append(s or None)
+        return out
+
+    def _get_bus(self, required_by):
+        if self._bus is not None:
+            return self._bus
+        if jax.process_count() == 1 and not self._bus_failed:
+            bus = self.initialize_bus()
+            if bus is not None:
+                return bus
+        raise SMPRuntimeError(
+            f"{required_by} needs the native message bus "
+            "(native/libsmptpu.so), which is not up — it failed to build/"
+            "load, or smp.init ran before the library was available; build "
+            "it with `make -C native` and unset SMP_DISABLE_NATIVE."
+        )
+
+    def shutdown(self):
+        if self._bus is not None:
+            self._bus.shutdown()
+            self._bus = None
+
+    # -- group -> process-set resolution --------------------------------
+
+    def group_processes(self, group=CommGroup.WORLD):
+        """Process indices participating in `group`, for this process's
+        default device. WORLD (and single-process runs) -> all processes."""
+        world = list(range(jax.process_count()))
+        if group in (None, CommGroup.WORLD) or not self._multi():
+            return world
+        if not state.initialized:
+            # Without topology, subgroup membership is unknowable; widening
+            # to WORLD would deadlock the members (non-members never join
+            # the collective) — refuse instead.
+            raise SMPRuntimeError(
+                f"collective over {group} requires smp.init first "
+                "(group membership comes from the device topology)."
+            )
+        core = state.core
+        getter = {
+            CommGroup.PP_GROUP: core.get_pp_group,
+            CommGroup.TP_GROUP: core.get_tp_group,
+            CommGroup.DP_GROUP: core.get_dp_group,
+            CommGroup.RDP_GROUP: core.get_rdp_group,
+            CommGroup.MP_GROUP: core.get_mp_group,
+            CommGroup.CP_GROUP: core.get_cp_group,
+        }.get(group)
+        if getter is None:
+            return world
+        devices = list(core.topology.mesh.devices.flat)
+        procs = sorted({devices[d].process_index for d in getter()})
+        return procs or world
+
+    # -- collectives ----------------------------------------------------
+    # `src` is group-relative throughout (for WORLD the group list is the
+    # identity, so it coincides with the process index) — consistent with
+    # send/recv_from's peer addressing.
+
     def broadcast(self, obj, group=CommGroup.WORLD, src=0):
-        """Broadcast a picklable object from process `src` to all processes."""
+        """Broadcast a picklable object from member `src` of `group` to the
+        group's processes. Full-world broadcasts ride multihost_utils;
+        proper subgroups ride the native bus (only members may call)."""
         if not self._multi():
             return obj
+        procs = self.group_processes(group)
+        if len(procs) < jax.process_count():
+            return self._subgroup_broadcast(obj, procs, src, group)
         from jax.experimental import multihost_utils
 
         payload = pickle.dumps(obj) if jax.process_index() == src else b""
@@ -75,24 +259,125 @@ class CollectiveCommunicator:
         return pickle.loads(np.asarray(out).tobytes()[: int(n[0])])
 
     def allgather(self, obj, group=CommGroup.WORLD):
-        """Gather a picklable object from every process; returns a list
-        indexed by process_index."""
+        """Gather a picklable object from every process of `group`; returns
+        a list indexed by group-relative rank (process_index for WORLD)."""
         if not self._multi():
             return [obj]
+        procs = self.group_processes(group)
+        if len(procs) < jax.process_count():
+            return self._subgroup_allgather(obj, procs, group)
         from jax.experimental import multihost_utils
 
         gathered = []
         for src in range(jax.process_count()):
-            gathered.append(self.broadcast(obj, group=group, src=src))
+            gathered.append(self.broadcast(obj, src=src))
         return gathered
 
-    def barrier(self, name="smp_ccl_barrier"):
+    def _subgroup_broadcast(self, obj, procs, src, group):
+        me = jax.process_index()
+        if me not in procs:
+            raise SMPRuntimeError(
+                f"broadcast over {group} called from process {me}, which is "
+                "not a member of that group."
+            )
+        if src < 0 or src >= len(procs):
+            raise SMPRuntimeError(
+                f"broadcast src {src} out of range for group {group} "
+                f"({len(procs)} processes)."
+            )
+        root = procs[src]
+        if me == root:
+            for p in procs:
+                if p != me:
+                    self._int_send(p, obj)
+            return obj
+        return self._int_recv(root)
+
+    def _subgroup_allgather(self, obj, procs, group):
+        me = jax.process_index()
+        if me not in procs:
+            raise SMPRuntimeError(
+                f"allgather over {group} called from process {me}, which is "
+                "not a member of that group."
+            )
+        root = procs[0]
+        if me == root:
+            gathered = []
+            for p in procs:
+                gathered.append(obj if p == me else self._int_recv(p))
+            for p in procs:
+                if p != me:
+                    self._int_send(p, gathered)
+            return gathered
+        self._int_send(root, obj)
+        return self._int_recv(root)
+
+    def _int_send(self, gdest, obj):
+        bus = self._get_bus("framework collective")
+        seq = self._int_send_seq.get(gdest, 0)
+        bus.send_bytes(gdest, pickle.dumps(obj), 2 * seq)
+        self._int_send_seq[gdest] = seq + 1
+
+    def _int_recv(self, gsrc, timeout_ms=-1):
+        bus = self._get_bus("framework collective")
+        seq = self._int_recv_seq.get(gsrc, 0)
+        payload = bus.recv_bytes(gsrc, 2 * seq, timeout_ms)
+        self._int_recv_seq[gsrc] = seq + 1
+        return pickle.loads(payload)
+
+    def barrier(self, name="smp_ccl_barrier", group=CommGroup.WORLD):
+        """Barrier over the processes of `group`. WORLD barriers are a
+        global device sync; proper subgroups require the native bus — a
+        global sync is NOT a safe substitute there (it waits on non-member
+        processes that may never call barrier, deadlocking the members), so
+        subgroup barriers raise when the bus is down rather than silently
+        widening."""
+        procs = self.group_processes(group)
+        if len(procs) <= 1:
+            return
+        if len(procs) < jax.process_count():
+            self._get_bus(f"smp.barrier({group})").barrier(procs)
+            return
         state.core.barrier(name)
 
-    def send(self, obj, dest, group=CommGroup.WORLD):
-        raise SMPRuntimeError(
-            "Point-to-point host messaging has no SPMD counterpart; use "
-            "broadcast/allgather, or lax collectives inside the compiled step."
-        )
+    # -- point-to-point (native bus; reference N2 user API) -------------
 
-    recv_from = send
+    def send(self, obj, dest, group=CommGroup.WORLD):
+        """Async-send a picklable object to process `dest` of `group`.
+
+        Parity: reference ``CollectiveCommunicator.send``
+        (``backend/collectives.py:233-260``) — returns immediately; delivery
+        is handled by the bus's sender thread.
+        """
+        gdest = self._resolve_peer(dest, group, "send dest")
+        bus = self._get_bus("smp.send")
+        seq = self._send_seq.get(gdest, 0)
+        # TransactionIdentifier parity: 2*seq + is_user_api(=1). The counter
+        # advances only after a successful enqueue so a failed send can be
+        # retried without desynchronizing the per-peer stream.
+        bus.send_bytes(gdest, pickle.dumps(obj), 2 * seq + 1)
+        self._send_seq[gdest] = seq + 1
+
+    def recv_from(self, src, group=CommGroup.WORLD, timeout_ms=-1):
+        """Receive the next in-order object sent by process `src` of `group`."""
+        gsrc = self._resolve_peer(src, group, "recv_from src")
+        bus = self._get_bus("smp.recv_from")
+        seq = self._recv_seq.get(gsrc, 0)
+        payload = bus.recv_bytes(gsrc, 2 * seq + 1, timeout_ms)
+        self._recv_seq[gsrc] = seq + 1
+        return pickle.loads(payload)
+
+    def poll(self, src, group=CommGroup.WORLD):
+        """True when the next in-order object from `src` has arrived."""
+        gsrc = self._resolve_peer(src, group, "poll src")
+        bus = self._get_bus("smp.poll")
+        return bus.poll(gsrc, 2 * self._recv_seq.get(gsrc, 0) + 1)
+
+    def _resolve_peer(self, idx, group, what):
+        procs = self.group_processes(group)
+        if idx < 0 or idx >= len(procs):
+            raise SMPRuntimeError(
+                f"{what} {idx} out of range for group {group} "
+                f"({len(procs)} processes)."
+            )
+        return procs[idx]

@@ -179,6 +179,11 @@ class ModelParallelCore:
     def get_mp_group(self, device_index=None):
         return self.topology.ranker.get_mp_group(self.rank(device_index))
 
+    def get_cp_group(self, device_index=None):
+        from smdistributed_modelparallel_tpu.backend.topology import CP_AXIS
+
+        return self.topology.axis_group(self.rank(device_index), CP_AXIS)
+
     def get_world_group(self):
         self._check()
         return self.topology.ranker.get_world_group()

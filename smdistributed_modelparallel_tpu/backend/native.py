@@ -1,0 +1,251 @@
+"""Loader + ctypes wrappers for the native host runtime (``libsmptpu.so``).
+
+Parity target: the reference loads its C++ backend ``smplib`` via ctypes at
+init (reference ``backend/core.py:234-290``, symbol list in SURVEY §5.8).
+The TPU build's device data plane is compiled XLA — collectives ride ICI
+inside the step program — so the native layer here is deliberately smaller:
+
+- **message bus** (``smp_async_send`` / ``smp_wait_recv`` /
+  ``smp_poll_recv`` / ``smp_retrieve_object`` / ``smp_clean_recv_resources``
+  — N2 parity): TCP mesh between host processes for control-plane object
+  P2P and real subgroup barriers;
+- **timeline recorder** (``smp_create_timeline`` family — N5 parity).
+
+The library is built on demand from ``native/`` with the in-image g++
+toolchain; every caller must tolerate ``load() is None`` (no toolchain, or
+``SMP_DISABLE_NATIVE=1``) and fall back to pure Python.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libsmptpu.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_attempted = False
+
+
+def _stale():
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    src_dir = os.path.join(_NATIVE_DIR, "src")
+    try:
+        return any(
+            os.path.getmtime(os.path.join(src_dir, f)) > lib_mtime
+            for f in os.listdir(src_dir)
+            if f.endswith(".cc")
+        )
+    except OSError:
+        return False
+
+
+def _build():
+    """Build libsmptpu.so under an inter-process file lock, into a temp
+    name, installed by atomic rename — N processes hit smp.init (and so
+    this builder) simultaneously on one host, and an unlocked in-place make
+    can hand a half-written .so to a peer's dlopen (worse: the corrupt file
+    ends up newer than the sources, so _stale() never rebuilds it)."""
+    import fcntl
+
+    lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+    tmp_name = f"libsmptpu.build.{os.getpid()}.so"
+    try:
+        with open(lock_path, "w") as lock_fh:
+            fcntl.flock(lock_fh, fcntl.LOCK_EX)
+            if not _stale():  # a peer built it while we waited
+                return True
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, f"LIB={tmp_name}"],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(os.path.join(_NATIVE_DIR, tmp_name), _LIB_PATH)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native build failed (%s); using pure-Python fallbacks.", e)
+        try:
+            os.unlink(os.path.join(_NATIVE_DIR, tmp_name))
+        except OSError:
+            pass
+        return False
+
+
+def _declare(lib):
+    c = ctypes
+    lib.smp_bus_listen.argtypes = [c.c_int]
+    lib.smp_bus_listen.restype = c.c_int
+    lib.smp_bus_connect.argtypes = [c.c_int, c.c_int, c.c_char_p]
+    lib.smp_bus_connect.restype = c.c_int
+    lib.smp_async_send.argtypes = [c.c_int, c.c_char_p, c.c_int64, c.c_int64]
+    lib.smp_async_send.restype = c.c_int
+    lib.smp_poll_recv.argtypes = [c.c_int, c.c_int64]
+    lib.smp_poll_recv.restype = c.c_int
+    lib.smp_wait_recv.argtypes = [c.c_int, c.c_int64, c.c_int]
+    lib.smp_wait_recv.restype = c.c_int64
+    lib.smp_retrieve_object.argtypes = [
+        c.c_int, c.c_int64, c.POINTER(c.c_uint8), c.c_int64,
+    ]
+    lib.smp_retrieve_object.restype = c.c_int64
+    lib.smp_clean_recv_resources.argtypes = [c.c_int, c.c_int64]
+    lib.smp_clean_recv_resources.restype = None
+    lib.smp_bus_barrier.argtypes = [c.POINTER(c.c_int), c.c_int, c.c_int]
+    lib.smp_bus_barrier.restype = c.c_int
+    lib.smp_bus_shutdown.argtypes = []
+    lib.smp_bus_shutdown.restype = None
+
+    lib.smp_create_timeline.argtypes = [c.c_char_p]
+    lib.smp_create_timeline.restype = c.c_void_p
+    lib.smp_destroy_timeline.argtypes = [c.c_void_p]
+    lib.smp_destroy_timeline.restype = None
+    lib.smp_timeline_start_step.argtypes = [c.c_void_p, c.c_int64]
+    lib.smp_timeline_start_step.restype = None
+    lib.smp_timeline_end_step.argtypes = [c.c_void_p, c.c_int64]
+    lib.smp_timeline_end_step.restype = c.c_int64
+    lib.smp_timeline_record_pipeline_event.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_double, c.c_double, c.c_int, c.c_char_p,
+    ]
+    lib.smp_timeline_record_pipeline_event.restype = None
+    lib.smp_timeline_record_instant.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_double, c.c_char_p,
+    ]
+    lib.smp_timeline_record_instant.restype = None
+    lib.smp_timeline_flush.argtypes = [c.c_void_p, c.c_int]
+    lib.smp_timeline_flush.restype = c.c_int
+    lib.smp_timeline_event_count.argtypes = [c.c_void_p]
+    lib.smp_timeline_event_count.restype = c.c_int64
+    return lib
+
+
+def load():
+    """Return the loaded native library, building it if needed; None when
+    unavailable (caller falls back to pure Python)."""
+    global _lib, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        if os.environ.get("SMP_DISABLE_NATIVE", "0") == "1":
+            return None
+        if _stale() and not _build():
+            return None
+        try:
+            _lib = _declare(ctypes.CDLL(_LIB_PATH))
+        except OSError as e:
+            logger.warning("could not load %s: %s", _LIB_PATH, e)
+            _lib = None
+        return _lib
+
+
+def available():
+    return load() is not None
+
+
+class MessageBus:
+    """Python face of the native bus; one per process.
+
+    Transaction ids follow the reference's ``TransactionIdentifier``
+    convention (2*id + is_user_api, reference ``backend/collectives.py:61-66``)
+    — the bus itself only sees opaque int64 keys.
+    """
+
+    def __init__(self, lib):
+        self._lib = lib
+        self.rank = 0
+        self.world = 1
+        self.port = None
+        self._connected = False
+
+    def listen(self, port=0):
+        self.port = self._lib.smp_bus_listen(port)
+        if self.port < 0:
+            raise OSError("smp_bus_listen failed")
+        return self.port
+
+    def connect(self, rank, world, endpoints):
+        """endpoints: list of "host:port" strings indexed by process."""
+        joined = ",".join(endpoints).encode()
+        if self._lib.smp_bus_connect(rank, world, joined) != 0:
+            raise OSError("smp_bus_connect failed")
+        self.rank, self.world = rank, world
+        self._connected = True
+
+    def send_bytes(self, dest, payload, tx):
+        rc = self._lib.smp_async_send(dest, payload, len(payload), tx)
+        if rc != 0:
+            raise OSError(f"smp_async_send to {dest} failed ({rc})")
+
+    def poll(self, src, tx):
+        return bool(self._lib.smp_poll_recv(src, tx))
+
+    def recv_bytes(self, src, tx, timeout_ms=-1):
+        n = self._lib.smp_wait_recv(src, tx, timeout_ms)
+        if n == -1:
+            raise TimeoutError(f"recv from {src} (tx={tx}) timed out")
+        if n < 0:
+            raise OSError(f"smp_wait_recv failed ({n})")
+        buf = (ctypes.c_uint8 * int(n))()
+        got = self._lib.smp_retrieve_object(src, tx, buf, n)
+        if got != n:
+            raise OSError(f"smp_retrieve_object failed ({got})")
+        return bytes(buf)
+
+    def clean(self, src, tx):
+        self._lib.smp_clean_recv_resources(src, tx)
+
+    def barrier(self, ranks, timeout_ms=600000):
+        arr = (ctypes.c_int * len(ranks))(*sorted(ranks))
+        if self._lib.smp_bus_barrier(arr, len(ranks), timeout_ms) != 0:
+            raise OSError(f"bus barrier over {sorted(ranks)} failed")
+
+    def shutdown(self):
+        self._lib.smp_bus_shutdown()
+        self._connected = False
+
+
+class NativeTimeline:
+    """ctypes face of the native timeline recorder (N5)."""
+
+    def __init__(self, lib, path):
+        self._lib = lib
+        self._handle = lib.smp_create_timeline(path.encode())
+
+    def start_step(self, step):
+        self._lib.smp_timeline_start_step(self._handle, step)
+
+    def end_step(self, step):
+        return self._lib.smp_timeline_end_step(self._handle, step)
+
+    def record_event(self, name, begin_us, end_us, microbatch=None, track="pipeline"):
+        self._lib.smp_timeline_record_pipeline_event(
+            self._handle, name.encode(), begin_us, end_us,
+            -1 if microbatch is None else microbatch, track.encode(),
+        )
+
+    def record_instant(self, name, ts_us, track="pipeline"):
+        self._lib.smp_timeline_record_instant(
+            self._handle, name.encode(), ts_us, track.encode()
+        )
+
+    def flush(self, pid=0):
+        return self._lib.smp_timeline_flush(self._handle, pid)
+
+    def event_count(self):
+        return self._lib.smp_timeline_event_count(self._handle)
+
+    def close(self):
+        if self._handle:
+            self._lib.smp_destroy_timeline(self._handle)
+            self._handle = None

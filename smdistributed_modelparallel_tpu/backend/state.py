@@ -29,6 +29,20 @@ class ModelParallelState:
         self.loaded_model_state = None      # deferred checkpoint payloads
         self.loaded_optimizer_state = None
         self.last_compile_report = None     # one_time_compile_report output
+        self._comm = None                   # lazy CollectiveCommunicator
+
+    @property
+    def comm(self):
+        """Host control-plane communicator (parity: reference
+        ``state.comm``, ``backend/state_mod.py:14-93``). Lazy: collectives
+        imports this module, so construction defers to first use."""
+        if self._comm is None:
+            from smdistributed_modelparallel_tpu.backend.collectives import (
+                CollectiveCommunicator,
+            )
+
+            self._comm = CollectiveCommunicator()
+        return self._comm
 
     @property
     def initialized(self):
@@ -71,6 +85,13 @@ class ModelParallelState:
 
         self.timeline = Timeline()
         self.memory_metrics = StepMemoryMetricsCollector()
+        import jax
+
+        if jax.process_count() > 1:
+            # Multi-process bus bring-up is a global collective (endpoint
+            # allgather) and so must happen HERE, where every process is
+            # known to participate — not lazily from a subgroup op.
+            self.comm.initialize_bus()
 
     def _check(self):
         if not self.initialized:

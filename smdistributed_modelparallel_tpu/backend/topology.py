@@ -100,6 +100,18 @@ class DeviceTopology:
     def ep_rank(self, rank):
         return self.coords(rank)[EP_AXIS]
 
+    def axis_group(self, rank, axis):
+        """Flat device indices of the devices sharing `rank`'s coordinates
+        on every mesh axis except `axis` (i.e. `rank`'s group along that
+        axis), in axis order."""
+        mine = self.coords(rank)
+        group = []
+        for r in range(self.size):
+            c = self.coords(r)
+            if all(c[a] == mine[a] for a in self.axis_names if a != axis):
+                group.append(r)
+        return group
+
     def __repr__(self):
         dims = "x".join(
             f"{n}={s}" for n, s in zip(self.axis_names, self.axis_sizes)

@@ -46,9 +46,15 @@ class DynamicLossScaler(LossScaler):
 
     def __init__(self, init_scale=2.0 ** 32, scale_factor=2.0,
                  scale_window=1000, min_scale=1.0, delayed_shift=1,
-                 consecutive_hysteresis=False):
+                 consecutive_hysteresis=False, backoff_factor=None):
         super().__init__(init_scale)
         self.scale_factor = float(scale_factor)
+        # Backoff multiplier on overflow; default 1/scale_factor preserves
+        # the reference DynamicLossScaler's halve-on-overflow behavior.
+        self.backoff_factor = (
+            1.0 / self.scale_factor if backoff_factor is None
+            else float(backoff_factor)
+        )
         self.scale_window = int(scale_window)
         self.min_scale = float(min_scale)
         self.delayed_shift = int(delayed_shift)
@@ -61,7 +67,7 @@ class DynamicLossScaler(LossScaler):
         if found_overflow:
             self.overflows += 1
             if self.delayed_shift == 1 or self.cur_hysteresis == 1:
-                self._scale = max(self._scale / self.scale_factor, self.min_scale)
+                self._scale = max(self._scale * self.backoff_factor, self.min_scale)
                 logger.info("Gradient overflow; loss scale -> %.1f", self._scale)
             else:
                 self.cur_hysteresis -= 1

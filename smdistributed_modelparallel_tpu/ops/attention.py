@@ -37,6 +37,26 @@ def causal_window_mask(T, S, window=None, dtype=jnp.bool_):
     return mask.astype(dtype)
 
 
+def _fold_scale_and_seed(q, scale, dropout_rate, dropout_rng):
+    """Shared prologue of the Pallas and CP fast paths: fold a traced scale
+    into q (their scale arguments are static; keep q's dtype so a traced
+    f32 scalar cannot promote bf16 q), and derive the int32 dropout seed
+    from the rng — one definition, so the ring/Ulysses/Pallas dropout
+    patterns cannot silently diverge."""
+    if isinstance(scale, (int, float, np.floating)):
+        qq, static_scale = q, float(scale)
+    else:
+        qq, static_scale = (q * scale).astype(q.dtype), 1.0
+    seed = None
+    rate = 0.0
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        rate = float(dropout_rate)
+        seed = jax.lax.bitcast_convert_type(
+            jax.random.bits(dropout_rng, (), jnp.uint32), jnp.int32
+        )
+    return qq, static_scale, seed, rate
+
+
 def attention_core(
     q,
     k,
@@ -109,24 +129,17 @@ def attention_core(
 
         impl = state.cfg.context_parallel_impl
         if impl in ("ring", "ulysses"):
-            cp_scale = scale
-            qq = q
-            if not isinstance(scale, (int, float, np.floating)):
-                # Keep q's dtype (a traced f32 scale would promote bf16 q).
-                qq, cp_scale = (q * scale).astype(q.dtype), 1.0
-            seed = None
-            rate = 0.0
-            if dropout_rate > 0.0 and dropout_rng is not None:
-                rate = float(dropout_rate)
-                seed = jax.lax.bitcast_convert_type(
-                    jax.random.bits(dropout_rng, (), jnp.uint32), jnp.int32
-                )
+            qq, static_scale, seed, rate = _fold_scale_and_seed(
+                q, scale, dropout_rate, dropout_rng
+            )
             return cp_attention(
-                qq, k, v, scale=cp_scale, causal=causal, impl=impl,
+                qq, k, v, scale=static_scale, causal=causal, impl=impl,
                 kpad=cp_kpad, dropout_rate=rate, seed=seed,
             )
 
-    kpad = _as_key_padding_bias(mask, mask_value)
+    kpad = (
+        cp_kpad if cp_kpad is not None else _as_key_padding_bias(mask, mask_value)
+    )
     if (
         use_pallas
         and _pallas_ok(q, k, v)
@@ -140,20 +153,9 @@ def attention_core(
             flash_attention,
         )
 
-        if isinstance(scale, (int, float, np.floating)):
-            qq, kernel_scale = q, float(scale)
-        else:
-            # Traced scale (e.g. scale_attn_by_layer_idx under lax.scan):
-            # fold into q — the kernel's scale argument is static. Keep q's
-            # dtype (a traced f32 scalar would promote bf16 q to f32).
-            qq, kernel_scale = (q * scale).astype(q.dtype), 1.0
-        seed = None
-        rate = 0.0
-        if dropout_rate > 0.0 and dropout_rng is not None:
-            rate = float(dropout_rate)
-            seed = jax.lax.bitcast_convert_type(
-                jax.random.bits(dropout_rng, (), jnp.uint32), jnp.int32
-            )
+        qq, kernel_scale, seed, rate = _fold_scale_and_seed(
+            q, scale, dropout_rate, dropout_rng
+        )
         return flash_attention(
             qq, k, v, kpad, seed, kernel_scale, causal, window, rate
         )

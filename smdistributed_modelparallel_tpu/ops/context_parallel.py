@@ -57,15 +57,17 @@ def _block_scores(q, k, scale):
     )
 
 
-def _keep4d(seed, B, n_heads, h0, rows_g, cols_g, s_total, rate):
+def _keep4d(seed, B, n_heads, h0, h_total, rows_g, cols_g, s_total, rate):
     """[B, n_heads, len(rows), len(cols)] dropout keep mask from GLOBAL
-    indices; ``h0`` is the global index of the first local head (Ulysses
-    shards heads, ring does not). Same hash as the Pallas kernels, keyed
-    by (b*4096 + global_head, row, col) — ring and Ulysses agree exactly.
+    indices; ``h0`` is the global index of the first local head and
+    ``h_total`` the global head count (Ulysses shards heads, ring does
+    not). Same hash AND same key as the Pallas kernels: bh = b*H + h
+    (the kernel's flat program_id over a [B*H] grid) — ring, Ulysses, and
+    the Pallas path produce identical dropout patterns for one model.
     """
     b = jnp.arange(B)[:, None, None, None]
     h = (h0 + jnp.arange(n_heads))[None, :, None, None]
-    bh = b * jnp.int32(4096) + h
+    bh = b * jnp.int32(h_total) + h
     rows = rows_g[None, None, :, None]
     cols = cols_g[None, None, None, :]
     return _dropout_keep(seed, bh, rows, cols, s_total, rate)
@@ -130,7 +132,7 @@ def ring_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
         alpha = jnp.exp(jnp.maximum(m, -1e29) - m_safe) * (m > NEG_INF / 2)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_rate > 0.0:
-            keep = _keep4d(seed, B, H, 0, rows_g, cols_g, T_total,
+            keep = _keep4d(seed, B, H, 0, H, rows_g, cols_g, T_total,
                            dropout_rate)
             p = jnp.where(keep, p, 0.0)
         acc_new = acc * alpha + jnp.einsum(
@@ -189,7 +191,7 @@ def ulysses_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
     if dropout_rate > 0.0:
         h_local = H // n_blocks
         rows_g = jnp.arange(T)
-        keep = _keep4d(seed, B, h_local, me * h_local, rows_g, rows_g, T,
+        keep = _keep4d(seed, B, h_local, me * h_local, H, rows_g, rows_g, T,
                        dropout_rate)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     out = jnp.einsum("bhts,bshd->bthd", p, vg.astype(jnp.float32))
@@ -231,38 +233,48 @@ def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
             kpad = jnp.take(kpad, zig, axis=1)
 
     if impl == "ring":
-        body = functools.partial(
-            ring_attention_local, scale=scale, causal=causal, n_blocks=n,
-            zigzag=zigzag, dropout_rate=dropout_rate,
-        )
+        body_fn = ring_attention_local
+        body_kw = dict(scale=scale, causal=causal, n_blocks=n,
+                       zigzag=zigzag, dropout_rate=dropout_rate)
     elif impl == "ulysses":
-        body = functools.partial(
-            ulysses_attention_local, scale=scale, causal=causal, n_blocks=n,
-            dropout_rate=dropout_rate,
-        )
+        body_fn = ulysses_attention_local
+        body_kw = dict(scale=scale, causal=causal, n_blocks=n,
+                       dropout_rate=dropout_rate)
     else:
         raise SMPValidationError(f"Unknown context_parallel_impl {impl!r}")
 
     spec = P(None, CP_AXIS, None, None)
-    out = _call_with_optionals(body, mesh, spec, q, k, v, kpad, seed)
+    call_args = [q, k, v]
+    if kpad is not None:
+        call_args.append(kpad.astype(jnp.float32))
+    if seed is not None:
+        call_args.append(jnp.asarray(seed, jnp.int32))
+    jitted = _build_cp_call(
+        body_fn, tuple(sorted(body_kw.items())), mesh, spec,
+        kpad is not None, seed is not None,
+    )
+    out = jitted(*call_args)
     if zigzag:
         out = jnp.take(out, inv, axis=1)
     return out
 
 
-def _call_with_optionals(body, mesh, spec, q, k, v, kpad, seed):
-    """shard_map with optional operands: build the exact arg list and
-    matching specs (None operands are dropped, the body receives None)."""
+@functools.lru_cache(maxsize=64)
+def _build_cp_call(body_fn, body_kw_items, mesh, spec, has_kp, has_seed):
+    """Cached jit-of-shard_map builder with optional operands (kpad/seed
+    dropped from the arg list when absent; the body receives None).
+
+    Cached by (body fn, static kwargs, mesh, presence flags): eager callers
+    (the init/trace pass calls cp_attention per layer) reuse one compiled
+    executable instead of paying a fresh shard_map trace + XLA compile per
+    call.
+    """
+    body = functools.partial(body_fn, **dict(body_kw_items))
     in_specs = [spec, spec, spec]
-    call_args = [q, k, v]
-    has_kp = kpad is not None
-    has_seed = seed is not None
     if has_kp:
         in_specs.append(P(None, CP_AXIS))
-        call_args.append(kpad.astype(jnp.float32))
     if has_seed:
         in_specs.append(P())
-        call_args.append(jnp.asarray(seed, jnp.int32))
 
     def fn(*args):
         it = iter(args)
@@ -283,4 +295,4 @@ def _call_with_optionals(body, mesh, spec, q, k, v, kpad, seed):
     # dispatch rejects partial-manual specs). A nested jit wrapper covers
     # every caller: inlined when already tracing (the compiled step),
     # compiled when called eagerly (the init/trace pass).
-    return jax.jit(lambda *a: shard_fn(*a))(*call_args)
+    return jax.jit(lambda *a: shard_fn(*a))

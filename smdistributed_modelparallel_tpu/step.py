@@ -150,9 +150,11 @@ class StepFunction:
         mb_args, mb_kwargs = _resolve_model_refs(mb_args, mb_kwargs, model)
         model._tls.in_step = True
         model._tls.rngs = {s: state.rng_manager.next_key("init_" + s) for s in model.rng_streams}
+        state._tracing = True
         try:
             self.fn(*mb_args, **mb_kwargs)
         finally:
+            state._tracing = False
             self._has_backward = model._end_step_trace() is not None
         from smdistributed_modelparallel_tpu.parallel.partition import maybe_auto_partition
 

@@ -7,6 +7,11 @@ server action in ``torch/server.py:366-478``). The TPU build has no server
 loop; events bracket host-side phases (trace, partition, compile, step) and
 per-step device execution, and the JSON file loads in chrome://tracing or
 Perfetto alongside ``jax.profiler`` traces.
+
+Recording backend: the native C++ recorder (``native/src/timeline.cc``,
+N5 rebuilt — interned strings, preallocated arena, C-side JSON
+serialization) when ``libsmptpu.so`` loads; pure-Python list append
+otherwise. Same API either way.
 """
 
 import json
@@ -22,19 +27,33 @@ class Timeline:
         self._lock = threading.Lock()
         self._step = -1
         self._t0 = time.perf_counter()
+        self._native = None
+        if self.enabled:
+            from smdistributed_modelparallel_tpu.backend import native
+
+            lib = native.load()
+            if lib is not None:
+                self._native = native.NativeTimeline(lib, self.path)
 
     def _now_us(self):
         return (time.perf_counter() - self._t0) * 1e6
 
     def start_step(self, step):
         self._step = step
+        if self._native is not None:
+            self._native.start_step(step)
         self.record_instant(f"step_{step}_begin")
 
     def end_step(self, step):
         self.record_instant(f"step_{step}_end")
+        if self._native is not None:
+            self._native.end_step(step)
 
     def record_event(self, name, begin_us, end_us, microbatch=None, track="pipeline"):
         if not self.enabled:
+            return
+        if self._native is not None:
+            self._native.record_event(name, begin_us, end_us, microbatch, track)
             return
         args = {"step": self._step}
         if microbatch is not None:
@@ -47,6 +66,9 @@ class Timeline:
 
     def record_instant(self, name, track="pipeline"):
         if not self.enabled:
+            return
+        if self._native is not None:
+            self._native.record_instant(name, self._now_us(), track)
             return
         with self._lock:
             self._events.append(
@@ -73,7 +95,12 @@ class Timeline:
         return self._Span(self, name, microbatch, track)
 
     def flush(self):
-        if not self.enabled or not self._events:
+        if not self.enabled:
+            return
+        if self._native is not None:
+            self._native.flush(pid=os.getpid())
+            return
+        if not self._events:
             return
         with self._lock:
             payload = {"traceEvents": self._events, "displayTimeUnit": "ms"}

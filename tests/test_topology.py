@@ -97,3 +97,26 @@ def test_collective_communicator_single_process():
     comm = smp.CollectiveCommunicator()
     assert comm.broadcast({"a": 1}) == {"a": 1}
     assert comm.allgather([1, 2]) == [[1, 2]]
+
+
+def test_axis_group_cp():
+    """axis_group returns the devices varying only along the given axis
+    (backs CommGroup.CP_GROUP resolution in backend/collectives.py)."""
+    import smdistributed_modelparallel_tpu as smp
+    from smdistributed_modelparallel_tpu.backend.state import state
+    from smdistributed_modelparallel_tpu.backend.topology import CP_AXIS, TP_AXIS
+
+    smp.reset()
+    smp.init({"context_parallel_degree": 2, "tensor_parallel_degree": 2,
+              "ddp": True, "microbatches": 1})
+    topo = state.topology
+    for rank in range(topo.size):
+        grp = topo.axis_group(rank, CP_AXIS)
+        assert len(grp) == 2 and rank in grp
+        my = topo.coords(rank)
+        for r in grp:
+            c = topo.coords(r)
+            assert all(c[a] == my[a] for a in topo.axis_names if a != CP_AXIS)
+    tp_grp = topo.axis_group(0, TP_AXIS)
+    assert tp_grp == list(state.core.get_tp_group(0))
+    assert state.core.get_cp_group(0) == topo.axis_group(0, CP_AXIS)
