@@ -29,16 +29,22 @@ class HFFamily:
     config_to_smp: Callable
     translate_from_hf: Optional[Callable]  # hf sd -> flat smp dict
     translate_to_hf: Optional[Callable]    # flat smp dict -> hf sd
+    # Distributed module the family maps onto: "lmhead" (full model ->
+    # DistributedTransformerLMHead) or "transformer" (encoder stack ->
+    # DistributedTransformer; the reference's scope for ViT).
+    target: str = "lmhead"
 
 
 def _families():
     from smdistributed_modelparallel_tpu.nn.huggingface import (
-        bert, gpt2, gptj, gptneox,
+        bert, gpt2, gptj, gptneo, gptneox, roberta, vit,
     )
 
     fams = {}
     for name, mod in (
-        ("gpt2", gpt2), ("gptj", gptj), ("gptneox", gptneox), ("bert", bert),
+        ("gpt2", gpt2), ("gptj", gptj), ("gptneo", gptneo),
+        ("gptneox", gptneox), ("bert", bert), ("roberta", roberta),
+        ("vit", vit),
     ):
         fams[name] = HFFamily(
             name=name,
@@ -46,6 +52,7 @@ def _families():
             config_to_smp=mod.config_to_smp,
             translate_from_hf=mod.translate_hf_state_dict,
             translate_to_hf=mod.translate_state_dict_to_hf,
+            target=getattr(mod, "TARGET", "lmhead"),
         )
     return fams
 
@@ -93,6 +100,7 @@ def translate_model(model_or_config, **overrides):
     for a bare config.
     """
     from smdistributed_modelparallel_tpu.nn.transformer import (
+        DistributedTransformer,
         DistributedTransformerLMHead,
     )
 
@@ -100,7 +108,11 @@ def translate_model(model_or_config, **overrides):
     config = getattr(model_or_config, "config", model_or_config)
     kwargs = fam.config_to_smp(config)
     kwargs.update(overrides)
-    module = DistributedTransformerLMHead(**kwargs)
+    target_cls = (
+        DistributedTransformer if fam.target == "transformer"
+        else DistributedTransformerLMHead
+    )
+    module = target_cls(**kwargs)
     flat = None
     if hasattr(model_or_config, "state_dict"):
         flat = fam.translate_from_hf(model_or_config.state_dict(), config=config)
@@ -118,10 +130,15 @@ def register_predefined_hooks(registry):
         return
 
     from smdistributed_modelparallel_tpu.nn.transformer import (
+        DistributedTransformer,
         DistributedTransformerLMHead,
     )
 
     for fam in families().values():
+        target_cls = (
+            DistributedTransformer if fam.target == "transformer"
+            else DistributedTransformerLMHead
+        )
         for arch in fam.architectures:
             hf_cls = getattr(transformers, arch, None)
             if hf_cls is None:
@@ -133,12 +150,12 @@ def register_predefined_hooks(registry):
                 return (), out
 
             # translate_functions deliberately NOT registered here: the
-            # registry keys them by distributed class, and all four
-            # families share DistributedTransformerLMHead — the accurate
-            # channel is the per-instance functions smp.from_hf installs.
+            # registry keys them by distributed class, and the families
+            # share their target classes — the accurate channel is the
+            # per-instance functions smp.from_hf installs.
             registry.register(
                 hf_cls,
-                DistributedTransformerLMHead,
+                target_cls,
                 init_hook=_init_hook,
             )
 

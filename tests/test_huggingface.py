@@ -42,6 +42,23 @@ def _tiny_configs():
             type_vocab_size=2, attention_probs_dropout_prob=0.0,
             hidden_dropout_prob=0.0,
         ),
+        "gptneo": transformers.GPTNeoConfig(
+            hidden_size=32, num_layers=2, num_heads=2, vocab_size=64,
+            max_position_embeddings=32, intermediate_size=64,
+            attention_types=[[["global", "local"], 1]], window_size=8,
+            attention_dropout=0.0, resid_dropout=0.0, embed_dropout=0.0,
+        ),
+        "roberta": transformers.RobertaConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=64, vocab_size=64, max_position_embeddings=36,
+            type_vocab_size=1, pad_token_id=1,
+            attention_probs_dropout_prob=0.0, hidden_dropout_prob=0.0,
+        ),
+        "vit": transformers.ViTConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+            intermediate_size=64, image_size=8, patch_size=4,
+            attention_probs_dropout_prob=0.0, hidden_dropout_prob=0.0,
+        ),
     }
 
 
@@ -51,6 +68,9 @@ def _hf_model(name, config):
         "gptj": transformers.GPTJForCausalLM,
         "gptneox": transformers.GPTNeoXForCausalLM,
         "bert": transformers.BertModel,
+        "gptneo": transformers.GPTNeoForCausalLM,
+        "roberta": transformers.RobertaModel,
+        "vit": transformers.ViTModel,
     }[name]
     torch.manual_seed(0)
     m = cls(config)
@@ -61,14 +81,16 @@ def _hf_model(name, config):
 def _hf_logits(name, hf, ids):
     with torch.no_grad():
         t_ids = torch.tensor(np.asarray(ids))
-        if name == "bert":
+        if name in ("bert", "roberta"):
             out = hf(t_ids, token_type_ids=torch.zeros_like(t_ids))
             return out.last_hidden_state.numpy()
         return hf(t_ids).logits.numpy()
 
 
 class TestLogitsParity:
-    @pytest.mark.parametrize("name", ["gpt2", "gptj", "gptneox", "bert"])
+    @pytest.mark.parametrize(
+        "name", ["gpt2", "gptj", "gptneox", "bert", "gptneo", "roberta"]
+    )
     def test_forward_matches_hf(self, name):
         config = _tiny_configs()[name]
         hf = _hf_model(name, config)
@@ -76,7 +98,7 @@ class TestLogitsParity:
         smp.init({})
         model = smp.from_hf(hf, deterministic=True)
         ids = jax.random.randint(jax.random.key(0), (2, 16), 0, 64)
-        if name == "bert":
+        if name in ("bert", "roberta"):
             ours = np.asarray(
                 model(ids, token_type_ids=jnp.zeros_like(ids))
             )
@@ -85,9 +107,58 @@ class TestLogitsParity:
         ref = _hf_logits(name, hf, ids)
         np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
 
+    def test_roberta_padded_positions_match_hf(self):
+        """Pad-aware position ids (HF create_position_ids_from_input_ids):
+        left- and right-padded inputs must match HF exactly."""
+        config = _tiny_configs()["roberta"]
+        hf = _hf_model("roberta", config)
+        smp.reset()
+        smp.init({})
+        model = smp.from_hf(hf, deterministic=True)
+        pad = config.pad_token_id
+        ids = np.array(
+            jax.random.randint(jax.random.key(3), (2, 16), 0, 64)
+        )
+        ids[ids == pad] = pad + 1
+        ids[0, :5] = pad   # left padding
+        ids[1, -4:] = pad  # right padding
+        j_ids = jnp.asarray(ids)
+        ours = np.asarray(
+            model(j_ids, token_type_ids=jnp.zeros_like(j_ids),
+                  attention_mask=(j_ids != pad)[:, None, None, :])
+        )
+        with torch.no_grad():
+            t_ids = torch.tensor(ids)
+            ref = hf(
+                t_ids,
+                attention_mask=(t_ids != pad).long(),
+                token_type_ids=torch.zeros_like(t_ids),
+            ).last_hidden_state.numpy()
+        # Compare non-pad rows only (HF runs pad tokens through attention
+        # with mask; values at pad rows are unspecified for consumers).
+        mask = ids != pad
+        np.testing.assert_allclose(ours[mask], ref[mask], atol=2e-4, rtol=2e-3)
+
+    def test_vit_encoder_matches_hf(self):
+        """ViT family scope is the encoder stack (reference vit.py):
+        hidden-states in, hidden-states out."""
+        config = _tiny_configs()["vit"]
+        hf = _hf_model("vit", config)
+        smp.reset()
+        smp.init({})
+        model = smp.from_hf(hf, deterministic=True)
+        hidden = np.random.RandomState(0).randn(2, 5, 32).astype(np.float32)
+        ours = np.asarray(model(jnp.asarray(hidden)))
+        with torch.no_grad():
+            ref = hf.encoder(torch.tensor(hidden)).last_hidden_state.numpy()
+        np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
 
 class TestRoundTrip:
-    @pytest.mark.parametrize("name", ["gpt2", "gptj", "gptneox", "bert"])
+    @pytest.mark.parametrize(
+        "name",
+        ["gpt2", "gptj", "gptneox", "bert", "gptneo", "roberta", "vit"],
+    )
     def test_state_dict_round_trip(self, name):
         """hf -> smp -> hf is the identity on every tensor."""
         from smdistributed_modelparallel_tpu.nn import huggingface as hfmod
@@ -114,6 +185,9 @@ class TestRoundTrip:
         assert state.tp_registry.is_supported(transformers.GPTJForCausalLM)
         assert state.tp_registry.is_supported(transformers.GPTNeoXForCausalLM)
         assert state.tp_registry.is_supported(transformers.BertModel)
+        assert state.tp_registry.is_supported(transformers.GPTNeoForCausalLM)
+        assert state.tp_registry.is_supported(transformers.RobertaModel)
+        assert state.tp_registry.is_supported(transformers.ViTModel)
 
 
 @pytest.mark.slow
