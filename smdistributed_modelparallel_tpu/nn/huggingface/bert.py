@@ -6,6 +6,11 @@ distributes ``BertEncoder`` only, keeping HF embeddings; here the whole
 layernorm, post-LN encoder stack — maps onto
 ``DistributedTransformerLMHead``; the pooler has no counterpart and is
 dropped, as in the reference).
+
+State-dict convention: the from-HF translator accepts bare ``BertModel``
+keys or ``bert.``-prefixed ones; the to-HF translator EMITS bare body keys
+(the registered architecture's layout — wrapper models prepend their own
+prefix).
 """
 
 import numpy as np
@@ -116,14 +121,14 @@ def translate_state_dict_to_hf(flat, config=None):
     n_layers = flat[f"{c.L}/attention/qkv/kernel"].shape[0]
     D = flat[c.WTE].shape[1]
     out = {
-        "bert.embeddings.word_embeddings.weight": flat[c.WTE],
-        "bert.embeddings.position_embeddings.weight": flat[c.WPE],
-        "bert.embeddings.token_type_embeddings.weight": flat[c.TTE],
-        "bert.embeddings.LayerNorm.weight": flat[f"{c.EMB_LN}/scale"],
-        "bert.embeddings.LayerNorm.bias": flat[f"{c.EMB_LN}/bias"],
+        "embeddings.word_embeddings.weight": flat[c.WTE],
+        "embeddings.position_embeddings.weight": flat[c.WPE],
+        "embeddings.token_type_embeddings.weight": flat[c.TTE],
+        "embeddings.LayerNorm.weight": flat[f"{c.EMB_LN}/scale"],
+        "embeddings.LayerNorm.bias": flat[f"{c.EMB_LN}/bias"],
     }
     for i in range(n_layers):
-        p = f"bert.encoder.layer.{i}"
+        p = f"encoder.layer.{i}"
         a = f"{p}.attention"
         g = lambda key: np.asarray(flat[f"{c.L}/{key}"][i])
         qw, kw, vw = c.separate_qkv_from_fused(

@@ -4,10 +4,15 @@ Parity target: reference ``torch/nn/huggingface/roberta.py`` (the reference
 distributes ``RobertaEncoder`` only; here, as with BERT, the whole
 ``RobertaModel`` body maps onto ``DistributedTransformerLMHead``).
 
-RoBERTa is architecturally BERT with one embedding quirk: position ids
-start at ``padding_idx + 1`` (= 2), and the position table carries
+RoBERTa is architecturally BERT with one embedding quirk: position ids are
+pad-aware (HF ``create_position_ids_from_input_ids`` — real tokens count
+from ``padding_idx + 1`` skipping pads), and the position table carries
 ``max_position_embeddings`` (= 514 for the 512-token model) rows — carried
-here by ``position_offset``. Token-type table has a single row.
+here by ``position_ids_from_padding``. Token-type table has a single row.
+
+State-dict convention: translators accept either bare ``RobertaModel``
+keys or ``roberta.``-prefixed ones, and EMIT bare body keys (the
+registered architecture's layout).
 """
 
 from smdistributed_modelparallel_tpu.nn.huggingface import bert
@@ -41,9 +46,6 @@ def _reprefix(fn):
 translate_hf_state_dict = _reprefix(bert.translate_hf_state_dict)
 
 
-def translate_state_dict_to_hf(flat, config=None):
-    out = bert.translate_state_dict_to_hf(flat, config=config)
-    return {
-        ("roberta." + k[len("bert."):]) if k.startswith("bert.") else k: v
-        for k, v in out.items()
-    }
+# bert's to-HF emitter already produces bare body keys, which is also the
+# RobertaModel layout.
+translate_state_dict_to_hf = bert.translate_state_dict_to_hf

@@ -90,11 +90,6 @@ def translate_hf_state_dict(sd, config=None):
                 sd[f"{a}.value.weight"],
                 H, hd, transpose=True,
             ),
-            "attention/qkv/bias": np.stack([
-                sd[f"{a}.query.bias"].reshape(H, hd),
-                sd[f"{a}.key.bias"].reshape(H, hd),
-                sd[f"{a}.value.bias"].reshape(H, hd),
-            ], axis=0),
             "attention/dense/kernel": c.attn_out_from_hf(
                 sd[f"{p}.attention.output.dense.weight"], H, hd, transpose=True
             ),
@@ -106,6 +101,12 @@ def translate_hf_state_dict(sd, config=None):
             "output/proj/kernel": sd[f"{p}.output.dense.weight"].T,
             "output/proj/bias": sd[f"{p}.output.dense.bias"],
         }
+        if f"{a}.query.bias" in sd:  # absent when config.qkv_bias=False
+            lay["attention/qkv/bias"] = np.stack([
+                sd[f"{a}.query.bias"].reshape(H, hd),
+                sd[f"{a}.key.bias"].reshape(H, hd),
+                sd[f"{a}.value.bias"].reshape(H, hd),
+            ], axis=0)
         layers.append(lay)
     out = {}
     for k, v in c.stack_layers(layers).items():
@@ -117,9 +118,12 @@ def translate_state_dict_to_hf(flat, config=None):
     """Flat smp param dict -> HF ViT encoder naming (torch layout)."""
     n_layers = flat[f"{L_ENC}/attention/qkv/kernel"].shape[0]
     D = flat[f"{L_ENC}/attention/dense/bias"].shape[1]
+    has_bias = f"{L_ENC}/attention/qkv/bias" in flat
     out = {}
     for i in range(n_layers):
-        p = f"vit.encoder.layer.{i}"
+        # Bare body keys — the registered ViTModel layout (wrapper models
+        # like ViTForImageClassification prepend "vit." themselves).
+        p = f"encoder.layer.{i}"
         a = f"{p}.attention.attention"
         g = lambda key: np.asarray(flat[f"{L_ENC}/{key}"][i])
         out[f"{p}.layernorm_before.weight"] = g("attention/layernorm/scale")
@@ -127,10 +131,16 @@ def translate_state_dict_to_hf(flat, config=None):
         qw, kw, vw = c.separate_qkv_from_fused(
             g("attention/qkv/kernel"), transpose=True
         )
-        qb, kb, vb = (g("attention/qkv/bias")[j].reshape(-1) for j in range(3))
-        out[f"{a}.query.weight"], out[f"{a}.query.bias"] = qw, qb
-        out[f"{a}.key.weight"], out[f"{a}.key.bias"] = kw, kb
-        out[f"{a}.value.weight"], out[f"{a}.value.bias"] = vw, vb
+        out[f"{a}.query.weight"] = qw
+        out[f"{a}.key.weight"] = kw
+        out[f"{a}.value.weight"] = vw
+        if has_bias:
+            qb, kb, vb = (
+                g("attention/qkv/bias")[j].reshape(-1) for j in range(3)
+            )
+            out[f"{a}.query.bias"] = qb
+            out[f"{a}.key.bias"] = kb
+            out[f"{a}.value.bias"] = vb
         out[f"{p}.attention.output.dense.weight"] = (
             g("attention/dense/kernel").reshape(-1, D).T
         )

@@ -679,13 +679,11 @@ class DistributedTransformerLMHead(nn.Module):
     rotary_emb_base: Optional[float] = None
     gpt_neox_type_rotary: bool = False
     use_positional_embedding: bool = True
-    # Position ids = arange(T) + position_offset (RoBERTa starts positions
-    # at padding_idx + 1 = 2; its embedding table carries the extra rows).
-    position_offset: int = 0
     # RoBERTa-style pad-aware positions: when set to the pad token id,
     # position ids are cumsum(ids != pad) * (ids != pad) + pad_id (HF
     # create_position_ids_from_input_ids) — pad tokens sit at the pad
-    # position and real tokens skip pads. Overrides position_offset.
+    # position and real tokens skip pads (the embedding table carries the
+    # pad_id + 1 extra rows).
     position_ids_from_padding: Optional[int] = None
     parallel_attn_output: bool = False
     use_lm_head_bias: bool = False
@@ -794,7 +792,7 @@ class DistributedTransformerLMHead(nn.Module):
                 ne = (input_ids != self.position_ids_from_padding).astype(jnp.int32)
                 pos = jnp.cumsum(ne, axis=-1) * ne + self.position_ids_from_padding
             else:
-                pos = jnp.arange(input_ids.shape[-1])[None, :] + self.position_offset
+                pos = jnp.arange(input_ids.shape[-1])[None, :]
             x = x + self.position_embedding(pos)
         if self.num_token_types > 0 and token_type_ids is not None:
             x = x + self.token_type_embedding(token_type_ids)

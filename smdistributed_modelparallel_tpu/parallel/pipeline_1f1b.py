@@ -17,8 +17,12 @@ statically in Python and baked into ONE ``lax.scan`` over ticks:
 - stage-to-stage transfers (forward activations and backward cotangents)
   move through pp-sharded buffers via ``jnp.roll`` on the stage axis, which
   GSPMD lowers to a collective-permute over ICI;
-- the last stage's backward composes head + user loss into the stage VJP, so
-  gradients of head/tied/replicated parameters fall out of the same pass;
+- the last stage's forward OUTPUT is stashed in its own ring; its backward
+  tick runs only the cheap head + user-loss VJP on that stashed output to
+  get (replicated/head param grads, the stage-output cotangent), and the
+  uniform vmapped stage backward then treats the last stage like any other
+  — no stage forward is ever executed twice, and the only replicated
+  (non-stage-parallel) work per tick is the head/loss VJP itself;
   embedding gradients are applied after the tick loop from the collected
   stage-0 input cotangents.
 
@@ -240,11 +244,6 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             tree,
         )
 
-    def gather_side(m):
-        if sides is None:
-            return None
-        return tuple(gather_mb(s, m) for s in sides)
-
     def gather_sides_rows(ms):
         """Per-stage side tuples for a [S] vector of microbatch indices."""
         if sides is None:
@@ -301,6 +300,10 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     inbuf0 = zeros_ring(W1)      # inbuf[s, m % W1] = input for stage s's fwd of m
     stash0 = zeros_ring(W1)      # stash[s, m % W1] = input consumed by fwd of m
     cotbuf0 = zeros_ring(W1)     # cotbuf[s, m % W1] = cotangent for stage s's output of m
+    outbuf0 = zeros_ring(W1)     # outbuf[S-1, m % W1] = last stage's fwd output of m
+    #                              (only row S-1 is ever written; keeping the
+    #                              [S] axis keeps the buffer pp-sharded like
+    #                              its siblings instead of replicated)
     dlay0 = param_grad_zeros(staged_params)
     drep0 = param_grad_zeros(params)          # head/tied/replicated contributions
     dembed0 = jax.tree_util.tree_map(
@@ -361,7 +364,8 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
         return jax.tree_util.tree_map(upd, buf, val)
 
     def tick(carry, t):
-        inbuf, stash, cotbuf, dlay, drep, dembed, dsides, losses, outs = carry
+        (inbuf, stash, cotbuf, outbuf, dlay, drep, dembed, dsides,
+         losses, outs) = carry
 
         # ---------------- forward sub-step ----------------
         fm = fwd_sched[t]                       # [S]; -1 idle
@@ -389,6 +393,9 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
         shifted_slots = jnp.roll(f_slots, 1)
         shifted_active = jnp.roll(f_active, 1).at[0].set(False)
         inbuf = set_ring(inbuf, shifted_slots, shifted_vals, shifted_active)
+        # The last stage's output feeds the head/loss at its backward tick.
+        last_row_active = f_active & (stage_ids == S - 1)
+        outbuf = set_ring(outbuf, f_slots, outs_f, last_row_active)
 
         # ---------------- backward sub-step ----------------
         bm = bwd_sched[t]
@@ -396,36 +403,38 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
         bmc = jnp.maximum(bm, 0)
         b_slots = bmc % W1
 
-        # Last stage: compose stage fwd + head + loss into one VJP.
+        # Head + user loss VJP on the last stage's STASHED output: yields
+        # the replicated/head param grads and the stage-output cotangent.
+        # The stage forward itself is NOT in this VJP — the uniform vmapped
+        # stage backward below recomputes it once, same as every stage.
         m_last = bmc[S - 1]
-        last_in = jax.tree_util.tree_map(
-            lambda st: jax.lax.dynamic_index_in_dim(
-                st[S - 1], b_slots[S - 1], 0, keepdims=False
-            ),
-            stash,
-        )
-        last_side = gather_side(m_last)
         key_last = jax.lax.dynamic_index_in_dim(mb_keys, m_last, 0, keepdims=False)
-        last_lp = jax.tree_util.tree_map(lambda p: p[S - 1], staged_params)
-        last_lxs = jax.tree_util.tree_map(lambda p: p[S - 1], staged_xs)
+        out_last = jax.tree_util.tree_map(
+            lambda ob: jax.lax.dynamic_index_in_dim(
+                ob[S - 1], b_slots[S - 1], 0, keepdims=False
+            ),
+            outbuf,
+        )
 
-        def last_stage_loss(lp, x, side, p_rep):
-            out = stage_fwd(lp, last_lxs, x, side, S - 1, m_last, active_rows[S - 1])
+        def head_loss(p_rep, out):
             final = head_apply(p_rep, out, key_last)
             loss, user_out = mb_loss_fn(final, m_last, key_last)
             return loss, user_out
 
-        loss_m, last_vjp, user_out = jax.vjp(
-            last_stage_loss, last_lp, last_in, last_side, params,
-            has_aux=True,
+        loss_m, head_vjp, user_out = jax.vjp(
+            head_loss, params, out_last, has_aux=True
         )
         seed = jnp.asarray(loss_seed_scale, jnp.float32) * jnp.where(
             b_active[S - 1], 1.0, 0.0
         )
-        d_last_lp, d_last_in, d_last_side, d_rep = last_vjp(seed.astype(loss_m.dtype))
+        d_rep, d_out_last = head_vjp(seed.astype(loss_m.dtype))
 
-        # Other stages: plain stage VJP with cotangents from cotbuf.
+        # All stages: plain stage VJP; cotangents come from cotbuf except
+        # the last stage's, which is the head/loss cotangent just computed.
         cot_in = get_ring(cotbuf, b_slots)
+        cot_in = jax.tree_util.tree_map(
+            lambda c, d: c.at[S - 1].set(d.astype(c.dtype)), cot_in, d_out_last
+        )
         b_sides = gather_sides_rows(bmc)
         stash_in = get_ring(stash, b_slots)
 
@@ -441,17 +450,6 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0, 0),
         )(staged_params, staged_xs, stash_in,
           b_sides, cot_in, stage_ids, bmc, active_rows)
-
-        # Merge the last stage's composed results over the vmapped rows.
-        def merge_last(rows, last_val):
-            return jax.tree_util.tree_map(
-                lambda r, lv: r.at[S - 1].set(lv.astype(r.dtype)), rows, last_val
-            )
-
-        d_lp_rows = merge_last(d_lp_rows, d_last_lp)
-        d_x_rows = merge_last(d_x_rows, d_last_in)
-        if sides is not None:
-            d_side_rows = merge_last(d_side_rows, d_last_side)
 
         # Accumulate layer grads (mask idle rows).
         mask_b = b_active
@@ -506,17 +504,18 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
         )
         outs = scatter_set_mb(outs, m_last, user_out, b_active[S - 1])
 
-        return (inbuf, stash, cotbuf, dlay, drep, dembed, dsides, losses, outs), None
+        return (inbuf, stash, cotbuf, outbuf, dlay, drep, dembed, dsides,
+                losses, outs), None
 
     def _scatter_add_leaf(buf, m, val, active):
         cur = jax.lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
         new = cur + jnp.where(active, val.astype(buf.dtype), jnp.zeros_like(cur))
         return jax.lax.dynamic_update_index_in_dim(buf, new, m, 0)
 
-    carry0 = (inbuf0, stash0, cotbuf0, dlay0, drep0, dembed0, dsides0,
-              losses0, outs0)
+    carry0 = (inbuf0, stash0, cotbuf0, outbuf0, dlay0, drep0, dembed0,
+              dsides0, losses0, outs0)
     carry_end, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
-    (_, _, _, dlay, drep, dembed, dsides, losses, outs) = carry_end
+    (_, _, _, _, dlay, drep, dembed, dsides, losses, outs) = carry_end
 
     # ---- embedding backward ------------------------------------------
 

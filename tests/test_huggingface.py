@@ -169,9 +169,14 @@ class TestRoundTrip:
         sd = {k: v.numpy() for k, v in hf.state_dict().items()}
         flat = fam.translate_from_hf(sd, config=config)
         back = fam.translate_to_hf(flat, config=config)
+        # Every emitted key must exist in the source model's state dict:
+        # a silently skipped mismatch would make this test vacuous (and
+        # means the export could not be loaded back into the HF model).
+        missing = sorted(k for k in back if k not in sd)
+        assert not missing, f"{name}: emitted keys absent from HF sd: {missing[:6]}"
+        assert len(back) >= 10 * config.num_hidden_layers if hasattr(
+            config, "num_hidden_layers") else len(back) >= 10
         for k, v in back.items():
-            if k not in sd:
-                continue  # e.g. synthesized tied lm_head
             np.testing.assert_allclose(
                 np.asarray(v), sd[k], atol=1e-6, err_msg=f"{name}:{k}"
             )
