@@ -152,7 +152,12 @@ class CollectiveCommunicator:
             # than leave processes in divergent states.
             bus.connect(jax.process_index(), world, endpoints)
         self._bus = bus
-        atexit.register(self.shutdown)
+        if world == 1:
+            # Multi-process teardown is owned by core.shutdown (which must
+            # relay the exit status over the bus FIRST — an atexit handler
+            # here would run before core's in LIFO order and close the bus
+            # under it). Single-process runs have no relay; close at exit.
+            atexit.register(self.shutdown)
         logger.debug("native message bus up at %s", endpoint)
         return bus
 

@@ -36,6 +36,7 @@ class ModelParallelCore:
         self.topology = None
         self._initialized = False
         self._timeline = None
+        self.exit_hook = None
 
     # -- lifecycle ------------------------------------------------------
 
@@ -46,9 +47,22 @@ class ModelParallelCore:
         self._maybe_init_distributed()
         self.topology = DeviceTopology(cfg, devices=devices)
         self._initialized = True
+        self.attach_exit_hook()
         atexit.register(self.shutdown)
         logger.info("Initialized %r over %d device(s), %d process(es).",
                     self.topology, self.topology.size, jax.process_count())
+
+    def attach_exit_hook(self):
+        """Parity: reference ``attach_exit_hook`` (``backend/core.py:204``)."""
+        if self.exit_hook is None:
+            from smdistributed_modelparallel_tpu.utils.exit_hook import ExitHook
+
+            self.exit_hook = ExitHook()
+        self.exit_hook.hook()
+
+    def exit_status(self):
+        """True when this process is shutting down cleanly."""
+        return self.exit_hook.success if self.exit_hook is not None else True
 
     def _maybe_init_distributed(self):
         """Multi-host bootstrap. Under SageMaker/launcher envs with a
@@ -63,11 +77,67 @@ class ModelParallelCore:
                 logger.debug("jax.distributed.initialize skipped: %s", e)
 
     def shutdown(self):
+        """Parity: reference ``shutdown`` (``backend/core.py:226-231``) —
+        derive the consistent exit status from the exit hook and relay it
+        (reference: ``smp_shutdown(success)``; here: best-effort status
+        report to process 0 over the bus, which logs failing peers)."""
         if not self._initialized:
             return
         self._initialized = False
+        success = self.exit_status()
+        if not success:
+            logger.error(
+                "process %d shutting down after failure (exit_code=%r, "
+                "exception=%r)", jax.process_index(),
+                self.exit_hook.exit_code, self.exit_hook.exception,
+            )
+        self._relay_exit_status(success)
         if self._timeline is not None:
             self._timeline.flush()
+
+    def _relay_exit_status(self, success):
+        """Tell process 0 how this process ended; process 0 polls for peer
+        reports against ONE shared deadline and logs failures. Best-effort:
+        peers may already be gone at exit, so never block shutdown on this.
+        Runs before the bus closes (this method owns closing it — atexit
+        LIFO would otherwise tear the bus down under the relay)."""
+        if jax.process_count() <= 1:
+            return
+        from smdistributed_modelparallel_tpu.backend.state import state
+
+        comm = state._comm
+        bus = comm._bus if comm is not None else None
+        if bus is None:
+            return
+        try:
+            import time
+
+            # Reserved status tx: negative namespace distinct from barriers
+            # (barrier ids are even*; -1 is never produced there).
+            me = jax.process_index()
+            if me != 0:
+                bus.send_bytes(0, b"\x01" if success else b"\x00", -1)
+            else:
+                failed = [] if success else [0]
+                pending = set(range(1, jax.process_count()))
+                deadline = time.monotonic() + 2.0
+                while pending and time.monotonic() < deadline:
+                    for peer in list(pending):
+                        if bus.poll(peer, -1):
+                            if bus.recv_bytes(peer, -1, timeout_ms=0) == b"\x00":
+                                failed.append(peer)
+                            pending.discard(peer)
+                    if pending:
+                        time.sleep(0.01)
+                if failed:
+                    logger.error(
+                        "shutdown status: process(es) %s reported failure.",
+                        sorted(failed),
+                    )
+        except Exception:  # pragma: no cover - never block exit
+            pass
+        finally:
+            comm.shutdown()
 
     @property
     def initialized(self):

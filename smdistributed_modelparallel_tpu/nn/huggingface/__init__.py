@@ -92,6 +92,46 @@ def family_for(config_or_model):
     )
 
 
+_BODY_PREFIXES = (
+    "bert.", "roberta.", "vit.", "transformer.", "gpt_neox.", "model.",
+)
+
+
+def _adapt_to_source_keys(to_hf, source_keys):
+    """Wrap a family's to-HF translator so its output keys match a SPECIFIC
+    source model's layout.
+
+    Translators emit each family's canonical layout (bare body keys for
+    encoder families, ``transformer.``-prefixed for the GPT LMHead
+    families); wrapper architectures (``BertForMaskedLM`` -> ``bert.*``,
+    bare ``GPT2Model`` -> unprefixed) differ only by a body prefix. The
+    wrapper renames each emitted key by adding/stripping a known prefix
+    when that makes it match the source state dict, so full-checkpoint
+    exports load back into whatever class ``smp.from_hf`` was given.
+    """
+    source_keys = frozenset(source_keys)
+
+    def adapted(flat, config=None):
+        out = to_hf(flat, config=config)
+        fixed = {}
+        for k, v in out.items():
+            if k in source_keys:
+                fixed[k] = v
+                continue
+            hit = None
+            for p in _BODY_PREFIXES:
+                if p + k in source_keys:
+                    hit = p + k
+                    break
+                if k.startswith(p) and k[len(p):] in source_keys:
+                    hit = k[len(p):]
+                    break
+            fixed[hit or k] = v
+        return fixed
+
+    return adapted
+
+
 def translate_model(model_or_config, **overrides):
     """Build the DistributedTransformerLMHead for an HF model/config.
 
@@ -115,7 +155,16 @@ def translate_model(model_or_config, **overrides):
     module = target_cls(**kwargs)
     flat = None
     if hasattr(model_or_config, "state_dict"):
-        flat = fam.translate_from_hf(model_or_config.state_dict(), config=config)
+        sd = model_or_config.state_dict()
+        flat = fam.translate_from_hf(sd, config=config)
+        fam = HFFamily(
+            name=fam.name,
+            architectures=fam.architectures,
+            config_to_smp=fam.config_to_smp,
+            translate_from_hf=fam.translate_from_hf,
+            translate_to_hf=_adapt_to_source_keys(fam.translate_to_hf, sd.keys()),
+            target=fam.target,
+        )
     return module, flat, fam
 
 

@@ -73,21 +73,59 @@ def _keep4d(seed, B, n_heads, h0, h_total, rows_g, cols_g, s_total, rate):
     return _dropout_keep(seed, bh, rows, cols, s_total, rate)
 
 
-def _zig_index(n, half):
-    """Global sequence order for the zigzag layout: device i holds chunks
-    i and 2n-1-i of 2n half-chunks."""
-    idx = []
-    for i in range(n):
-        idx.append(np.arange(i * half, (i + 1) * half))
-        idx.append(np.arange((2 * n - 1 - i) * half, (2 * n - i) * half))
-    return np.concatenate(idx)
-
-
 def _zig_rows(dev, half, n):
     """Global row indices of the zigzag-local block held by ``dev``."""
     a = dev * half + jnp.arange(half)
     b = (2 * n - 1 - dev) * half + jnp.arange(half)
     return jnp.concatenate([a, b])
+
+
+def _zig_owner(h, n):
+    """Zigzag owner device of half-chunk h (of 2n): device h for the first
+    n half-chunks, mirrored back for the rest."""
+    return h if h < n else 2 * n - 1 - h
+
+
+def _zig_perms(n):
+    """Device permutations realizing the natural->zigzag re-layout.
+
+    Natural layout: device d holds half-chunks (2d, 2d+1). Zigzag: device
+    d holds (d, 2n-1-d). Each device's first half goes to one distinct
+    device and its second half to another — TWO ppermutes move the whole
+    re-layout as point-to-point ICI neighbor traffic (vs. the generic
+    gather GSPMD emits for a global take on the sharded axis).
+    """
+    perm1 = [(d, _zig_owner(2 * d, n)) for d in range(n)]
+    perm2 = [(d, _zig_owner(2 * d + 1, n)) for d in range(n)]
+    return perm1, perm2
+
+
+def _zig_enter(x, me, n, axis_name):
+    """Natural-layout local block [B, Tl, ...] -> zigzag-layout block."""
+    half = x.shape[1] // 2
+    perm1, perm2 = _zig_perms(n)
+    a = jax.lax.ppermute(x[:, :half], axis_name, perm1)
+    b = jax.lax.ppermute(x[:, half:], axis_name, perm2)
+    # Zigzag slot 0 holds h=me (a first half iff me is even), slot 1 holds
+    # h=2n-1-me (first half iff me is odd).
+    even = (me % 2) == 0
+    slot0 = jnp.where(even, a, b)
+    slot1 = jnp.where(even, b, a)
+    return jnp.concatenate([slot0, slot1], axis=1)
+
+
+def _zig_exit(x, me, n, axis_name):
+    """Zigzag-layout local block -> natural layout (inverse of enter)."""
+    half = x.shape[1] // 2
+    perm1, perm2 = _zig_perms(n)
+    inv1 = [(dst, src) for src, dst in perm1]
+    inv2 = [(dst, src) for src, dst in perm2]
+    even = (me % 2) == 0
+    even_chunk = jnp.where(even, x[:, :half], x[:, half:])  # h even
+    odd_chunk = jnp.where(even, x[:, half:], x[:, :half])   # h odd
+    first = jax.lax.ppermute(even_chunk, axis_name, inv1)
+    second = jax.lax.ppermute(odd_chunk, axis_name, inv2)
+    return jnp.concatenate([first, second], axis=1)
 
 
 def ring_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
@@ -101,6 +139,16 @@ def ring_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
     """
     B, Tl, H, hd = q.shape
     me = jax.lax.axis_index(axis_name)
+    if zigzag:
+        # Re-layout to zigzag IN-REGION (two ppermutes each way) so every
+        # device carries an equal share of the causal triangle; undone on
+        # the way out. The block-index math below addresses the zigzag
+        # layout through global_rows().
+        q = _zig_enter(q, me, n_blocks, axis_name)
+        k = _zig_enter(k, me, n_blocks, axis_name)
+        v = _zig_enter(v, me, n_blocks, axis_name)
+        if kpad is not None:
+            kpad = _zig_enter(kpad, me, n_blocks, axis_name)
     perm = [(i, (i + 1) % n_blocks) for i in range(n_blocks)]
     T_total = Tl * n_blocks
     half = Tl // 2
@@ -154,7 +202,10 @@ def ring_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
         0, n_blocks, body, (acc0, m0, l0, k, v, kpad)
     )
     out = acc * inv_keep / jnp.maximum(l, 1e-30)  # [B, H, Tl, hd]
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    out = out.transpose(0, 2, 1, 3).astype(q.dtype)
+    if zigzag:
+        out = _zig_exit(out, me, n_blocks, axis_name)
+    return out
 
 
 def ulysses_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
@@ -221,16 +272,11 @@ def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
     if dropout_rate > 0.0 and seed is None:
         dropout_rate = 0.0
 
+    # Zigzag causal load balance: the natural->zigzag re-layout (and its
+    # inverse) happens INSIDE the manual region as two ppermutes each way
+    # (ring_attention_local), so each call costs point-to-point ICI
+    # transfers instead of a generic global gather on the sharded axis.
     zigzag = bool(causal) and impl == "ring" and (T // n) % 2 == 0 and n > 1
-    if zigzag:
-        # Re-layout the sequence so each device holds complementary
-        # half-chunks of the causal triangle; undone on the way out. The
-        # permutation is a gather on the cp-sharded axis (one ICI shuffle).
-        zig = _zig_index(n, T // (2 * n))
-        inv = np.argsort(zig)
-        q, k, v = (jnp.take(x, zig, axis=1) for x in (q, k, v))
-        if kpad is not None:
-            kpad = jnp.take(kpad, zig, axis=1)
 
     if impl == "ring":
         body_fn = ring_attention_local
@@ -253,10 +299,7 @@ def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
         body_fn, tuple(sorted(body_kw.items())), mesh, spec,
         kpad is not None, seed is not None,
     )
-    out = jitted(*call_args)
-    if zigzag:
-        out = jnp.take(out, inv, axis=1)
-    return out
+    return jitted(*call_args)
 
 
 @functools.lru_cache(maxsize=64)

@@ -1,0 +1,60 @@
+"""Exit-status capture for consistent multi-process shutdown.
+
+Parity target: reference ``backend/core.py:165-189`` (``ExitHook``) — hooks
+``sys.exit`` and ``sys.excepthook`` so the shutdown path knows whether the
+process is dying cleanly, and ``shutdown()`` passes that status to the
+backend (``smp_shutdown(success)``) so every rank exits with the same
+story. The reference's C++ backend relays the flag between its helper and
+main processes; here the relay is a best-effort status message to process
+0 over the native bus (``backend/collectives.py``), which logs which peers
+failed — recovery itself remains checkpoint/resume, as in the reference
+(SURVEY §5.3: "no elasticity").
+"""
+
+import sys
+
+
+class ExitHook:
+    """Captures sys.exit codes and uncaught exceptions.
+
+    Same surface as the reference class: ``hook()`` installs, ``exit_code``
+    / ``exception`` record what ended the process, ``success`` derives the
+    consistent status. ``unhook()`` restores the original handlers (the
+    reference never unhooks; tests need to).
+    """
+
+    def __init__(self):
+        self.exit_code = None
+        self.exception = None
+        self._orig_exit = None
+        self._orig_excepthook = None
+
+    def hook(self):
+        if self._orig_exit is not None:
+            return  # already installed
+        self._orig_exit = sys.exit
+        sys.exit = self.exit
+        self._orig_excepthook = sys.excepthook
+        sys.excepthook = self.exc_handler
+
+    def unhook(self):
+        if self._orig_exit is None:
+            return
+        sys.exit = self._orig_exit
+        sys.excepthook = self._orig_excepthook
+        self._orig_exit = None
+        self._orig_excepthook = None
+
+    def exit(self, code=0):
+        self.exit_code = code
+        self._orig_exit(code)
+
+    def exc_handler(self, exc_type, exc, *args):
+        self.exception = exc
+        self._orig_excepthook(exc_type, exc, *args)
+
+    @property
+    def success(self):
+        """True when nothing recorded a failing exit: no uncaught
+        exception, and sys.exit (if called) carried a falsy code."""
+        return not self.exit_code and self.exception is None

@@ -1,0 +1,96 @@
+"""Failure-detection tests (SURVEY §5.3).
+
+Parity target: reference ``ExitHook`` (``backend/core.py:165-189``) +
+``shutdown`` status derivation (``:226-231``).
+"""
+
+import sys
+
+import pytest
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils.exit_hook import ExitHook
+
+
+class TestExitHook:
+    def test_captures_exit_code(self):
+        hook = ExitHook()
+        hook.hook()
+        try:
+            with pytest.raises(SystemExit):
+                sys.exit(3)
+            assert hook.exit_code == 3
+            assert hook.success is False
+        finally:
+            hook.unhook()
+
+    def test_clean_exit_is_success(self):
+        hook = ExitHook()
+        hook.hook()
+        try:
+            with pytest.raises(SystemExit):
+                sys.exit(0)
+            assert hook.exit_code == 0
+            assert hook.success is True
+        finally:
+            hook.unhook()
+
+    def test_captures_uncaught_exception(self):
+        hook = ExitHook()
+        hook.hook()
+        try:
+            err = RuntimeError("boom")
+            # Simulate the interpreter's top-level dispatch.
+            sys.excepthook(RuntimeError, err, None)
+            assert hook.exception is err
+            assert hook.success is False
+        finally:
+            hook.unhook()
+
+    def test_unhook_restores(self):
+        hook = ExitHook()
+        orig_exit, orig_hook = sys.exit, sys.excepthook
+        hook.hook()
+        hook.unhook()
+        assert sys.exit is orig_exit
+        assert sys.excepthook is orig_hook
+
+    def test_hook_idempotent(self):
+        hook = ExitHook()
+        hook.hook()
+        try:
+            hooked = sys.exit
+            hook.hook()  # second install must not capture its own wrapper
+            assert sys.exit is hooked
+        finally:
+            hook.unhook()
+
+
+class TestCoreIntegration:
+    def test_init_attaches_and_status_flows_to_shutdown(self, monkeypatch):
+        smp.reset()
+        smp.init({"microbatches": 1})
+        core = state.core
+        assert core.exit_hook is not None
+        # Earlier tests' simulated exits/exceptions chain into this hook
+        # (handlers wrap the previously-installed ones); reset for isolation.
+        core.exit_hook.exit_code = None
+        core.exit_hook.exception = None
+        assert core.exit_status() is True
+        try:
+            with pytest.raises(SystemExit):
+                sys.exit(7)
+            assert core.exit_status() is False
+            from smdistributed_modelparallel_tpu.backend import core as core_mod
+
+            errors = []
+            monkeypatch.setattr(
+                core_mod.logger, "error",
+                lambda msg, *a, **k: errors.append(msg % a if a else msg),
+            )
+            core.shutdown()
+            assert any("failure" in m for m in errors)
+        finally:
+            core.exit_hook.exit_code = None
+            core.exit_hook.unhook()

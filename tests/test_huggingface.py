@@ -181,6 +181,29 @@ class TestRoundTrip:
                 np.asarray(v), sd[k], atol=1e-6, err_msg=f"{name}:{k}"
             )
 
+    def test_wrapper_architecture_export_matches_source_keys(self):
+        """from_hf on a WRAPPER architecture (BertForMaskedLM: body under
+        'bert.') must export keys that load back into that wrapper."""
+        config = _tiny_configs()["bert"]
+        torch.manual_seed(0)
+        hf = transformers.BertForMaskedLM(config)
+        hf.eval()
+        smp.reset()
+        smp.init({})
+        from smdistributed_modelparallel_tpu.nn import huggingface as hfmod
+
+        module, flat, fam = hfmod.translate_model(hf)
+        back = fam.translate_to_hf(flat, config=config)
+        sd = hf.state_dict()
+        body = [k for k in back if "encoder.layer" in k or "embeddings." in k]
+        assert body, "no body keys emitted"
+        missing = sorted(k for k in body if k not in sd)
+        assert not missing, f"wrapper-mismatched keys: {missing[:6]}"
+        for k in body:
+            np.testing.assert_allclose(
+                np.asarray(back[k]), sd[k].numpy(), atol=1e-6, err_msg=k
+            )
+
     def test_registry_has_predefined_hooks(self):
         smp.reset()
         smp.init({})
