@@ -137,7 +137,9 @@ class CollectiveCommunicator:
                 return None
             bus.connect(0, 1, [endpoint])
         else:
-            endpoints = self._allgather_endpoints(endpoint)
+            # Two-collective object allgather; None (local bring-up failed)
+            # travels as a pickled value, keeping the exchange all-or-nothing.
+            endpoints = self.allgather(endpoint)
             if any(e is None for e in endpoints):
                 if bus is not None:
                     bus.shutdown()
@@ -160,31 +162,6 @@ class CollectiveCommunicator:
             atexit.register(self.shutdown)
         logger.debug("native message bus up at %s", endpoint)
         return bus
-
-    @staticmethod
-    def _allgather_endpoints(endpoint):
-        """One fixed-width collective to exchange "host:port" strings (the
-        generic object allgather is O(P) sequential broadcasts — too slow
-        for the init critical path at pod scale). None (local bring-up
-        failed) travels as an all-zero row."""
-        from jax.experimental import multihost_utils
-
-        width = 256  # SMP_BUS_HOST may be a long FQDN, not just an IP
-        row = np.zeros(width, dtype=np.uint8)
-        if endpoint is not None:
-            enc = endpoint.encode()
-            if len(enc) > width:
-                raise SMPRuntimeError(
-                    f"bus endpoint {endpoint!r} exceeds {width} bytes; "
-                    "shorten SMP_BUS_HOST."
-                )
-            row[: len(enc)] = np.frombuffer(enc, dtype=np.uint8)
-        gathered = np.asarray(multihost_utils.process_allgather(row))
-        out = []
-        for r in gathered:
-            s = bytes(r).rstrip(b"\0").decode()
-            out.append(s or None)
-        return out
 
     def _get_bus(self, required_by):
         if self._bus is not None:
@@ -265,7 +242,11 @@ class CollectiveCommunicator:
 
     def allgather(self, obj, group=CommGroup.WORLD):
         """Gather a picklable object from every process of `group`; returns
-        a list indexed by group-relative rank (process_index for WORLD)."""
+        a list indexed by group-relative rank (process_index for WORLD).
+
+        Full-world gathers are TWO collectives (max-length exchange, then
+        one padded uint8 process_allgather) — not P sequential broadcasts.
+        """
         if not self._multi():
             return [obj]
         procs = self.group_processes(group)
@@ -273,10 +254,19 @@ class CollectiveCommunicator:
             return self._subgroup_allgather(obj, procs, group)
         from jax.experimental import multihost_utils
 
-        gathered = []
-        for src in range(jax.process_count()):
-            gathered.append(self.broadcast(obj, src=src))
-        return gathered
+        payload = pickle.dumps(obj)
+        lens = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([len(payload)], np.int64)
+            )
+        ).reshape(-1)
+        row = np.zeros(int(lens.max()), np.uint8)
+        row[: len(payload)] = np.frombuffer(payload, np.uint8)
+        rows = np.asarray(multihost_utils.process_allgather(row))
+        return [
+            pickle.loads(bytes(rows[i])[: int(lens[i])])
+            for i in range(jax.process_count())
+        ]
 
     def _subgroup_broadcast(self, obj, procs, src, group):
         me = jax.process_index()

@@ -35,3 +35,7 @@ from smdistributed_modelparallel_tpu.nn.transformer import (
     DistributedTransformerLMHead,
     DistributedTransformerOutputLayer,
 )
+from smdistributed_modelparallel_tpu.nn.moe import (
+    DistributedMoE,
+    moe_aux_losses,
+)

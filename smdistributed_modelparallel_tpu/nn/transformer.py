@@ -371,6 +371,12 @@ class DistributedTransformerLayer(nn.Module):
     window_size: Optional[int] = None
     parallel_attn_output: bool = False
     causal_mask_size: Optional[int] = None
+    # MoE (TPU extension; reference has no MoE — SURVEY §2.6): when
+    # num_experts > 0 the MLP block is a DistributedMoE routed over the
+    # ep mesh axis instead of a dense DistributedTransformerOutputLayer.
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -402,17 +408,34 @@ class DistributedTransformerLayer(nn.Module):
             dtype=self.dtype,
             name="attention",
         )
-        mlp = DistributedTransformerOutputLayer(
-            hidden_size=self.hidden_size,
-            intermediate_size=self.intermediate_size,
-            hidden_dropout_prob=self.hidden_dropout_prob,
-            activation=self.activation,
-            initializer_range=self.initializer_range,
-            fused_bias_gelu=self.fused_bias_gelu,
-            deterministic=self.deterministic,
-            dtype=self.dtype,
-            name="output",
-        )
+        if self.num_experts > 0:
+            from smdistributed_modelparallel_tpu.nn.moe import DistributedMoE
+
+            mlp = DistributedMoE(
+                hidden_size=self.hidden_size,
+                intermediate_size=self.intermediate_size,
+                num_experts=self.num_experts,
+                top_k=self.moe_top_k,
+                capacity_factor=self.moe_capacity_factor,
+                hidden_dropout_prob=self.hidden_dropout_prob,
+                activation=self.activation,
+                initializer_range=self.initializer_range,
+                deterministic=self.deterministic,
+                dtype=self.dtype,
+                name="output",
+            )
+        else:
+            mlp = DistributedTransformerOutputLayer(
+                hidden_size=self.hidden_size,
+                intermediate_size=self.intermediate_size,
+                hidden_dropout_prob=self.hidden_dropout_prob,
+                activation=self.activation,
+                initializer_range=self.initializer_range,
+                fused_bias_gelu=self.fused_bias_gelu,
+                deterministic=self.deterministic,
+                dtype=self.dtype,
+                name="output",
+            )
 
         res_dtype = jnp.float32 if self.fp32_residual_addition else hidden.dtype
         x = hidden
@@ -534,6 +557,9 @@ class DistributedTransformer(nn.Module):
     causal_mask_size: Optional[int] = None
     attention_layers_type: Optional[tuple] = None
     activation_checkpointing: bool = False
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -568,6 +594,9 @@ class DistributedTransformer(nn.Module):
             window_size=self.window_size,
             parallel_attn_output=self.parallel_attn_output,
             causal_mask_size=self.causal_mask_size,
+            num_experts=self.num_experts,
+            moe_top_k=self.moe_top_k,
+            moe_capacity_factor=self.moe_capacity_factor,
             deterministic=self.deterministic,
             dtype=self.dtype,
         )
@@ -601,7 +630,9 @@ class DistributedTransformer(nn.Module):
             body = nn.remat(body, policy=remat_policy())
         ScanLayers = nn.scan(
             body,
-            variable_axes={"params": 0},
+            # intermediates: per-layer sown values (MoE aux losses) stack
+            # on the layer axis when applied with mutable=["intermediates"].
+            variable_axes={"params": 0, "intermediates": 0},
             split_rngs={"params": True, "dropout": True},
             length=self.num_layers,
             in_axes=(0,),
@@ -698,6 +729,9 @@ class DistributedTransformerLMHead(nn.Module):
     scale_attn_by_layer_idx: bool = False
     activation_checkpointing: bool = False
     use_embedding_layernorm: bool = False  # BERT-family post-embedding LN
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -779,6 +813,9 @@ class DistributedTransformerLMHead(nn.Module):
             causal_mask_size=self.causal_mask_size,
             attention_layers_type=self.attention_layers_type,
             activation_checkpointing=self.activation_checkpointing,
+            num_experts=self.num_experts,
+            moe_top_k=self.moe_top_k,
+            moe_capacity_factor=self.moe_capacity_factor,
             deterministic=self.deterministic,
             dtype=self.dtype,
         )

