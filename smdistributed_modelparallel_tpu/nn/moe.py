@@ -30,12 +30,16 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from smdistributed_modelparallel_tpu.backend.topology import EP_AXIS, TP_AXIS
-# One activation table / init helper for dense MLP and MoE paths (a copy
-# here would silently drift from the transformer's supported set).
-from smdistributed_modelparallel_tpu.nn.transformer import _activation, _init
+# Shared helpers with the dense MLP path (copies here would silently
+# drift): activation table, init, config lookup, residual-stream spec.
+from smdistributed_modelparallel_tpu.nn.transformer import (
+    _activation,
+    _cfg,
+    _hidden_spec,
+    _init,
+)
 from smdistributed_modelparallel_tpu.nn.utils import (
     axis_partitioned,
-    batch_seq_spec,
     resolve_deterministic,
     shard_activation,
 )
@@ -70,6 +74,15 @@ class DistributedMoE(nn.Module):
             raise SMPValidationError(
                 f"moe top_k ({self.top_k}) must be in [1, num_experts="
                 f"{self.num_experts}]."
+            )
+        from smdistributed_modelparallel_tpu.backend.state import state
+
+        ep = state.mesh.shape.get(EP_AXIS, 1) if state.initialized else 1
+        if ep > 1 and self.num_experts % ep != 0:
+            raise SMPValidationError(
+                f"num_experts ({self.num_experts}) must be divisible by "
+                f"expert_parallel_degree ({ep}) so experts shard evenly "
+                "over the ep mesh axis."
             )
         D, F, E, K = (
             self.hidden_size, self.intermediate_size, self.num_experts,
@@ -109,11 +122,15 @@ class DistributedMoE(nn.Module):
         # Position of each assignment within its expert, ordered k-major
         # (all first choices before any second choice) then token-major —
         # first choices are never dropped in favor of second choices.
+        # Bookkeeping in int32: a float32 cumsum stops representing
+        # consecutive integers past 2^24 assignments and would silently
+        # collide capacity slots at pod-scale batches.
         sel = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [N, K, E]
-        sel_km = sel.transpose(1, 0, 2).reshape(K * N, E)
+        sel_i = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)
+        sel_km = sel_i.transpose(1, 0, 2).reshape(K * N, E)
         pos_km = jnp.cumsum(sel_km, axis=0) - sel_km
         pos = pos_km.reshape(K, N, E).transpose(1, 0, 2)        # [N, K, E]
-        pos_k = jnp.sum(pos * sel, axis=-1).astype(jnp.int32)   # [N, K]
+        pos_k = jnp.sum(pos * sel_i, axis=-1)                   # [N, K] int32
         keep = (pos_k < capacity).astype(jnp.float32)
 
         pos_oh = jax.nn.one_hot(pos_k, capacity, dtype=jnp.float32)
@@ -159,7 +176,10 @@ class DistributedMoE(nn.Module):
 
         out = jnp.einsum("nec,ecd->nd", combine.astype(y.dtype), y)
         out = out.reshape(B, T, D)
-        out = shard_activation(out, *batch_seq_spec())
+        # Residual-stream layout matches the dense MLP it replaces (incl.
+        # the optimize='memory' sequence-parallel sharding).
+        memory_opt = _cfg("optimize", "speed") == "memory"
+        out = shard_activation(out, *_hidden_spec(memory_opt))
         if self.hidden_dropout_prob > 0.0 and not deterministic:
             out = nn.Dropout(self.hidden_dropout_prob, deterministic=False)(out)
         return out

@@ -58,9 +58,7 @@ def shard_activation(x, *spec):
     if mesh is None:
         return x
     sizes = mesh.shape
-    used = [a for axes in spec if axes is not None
-            for a in (axes if isinstance(axes, tuple) else (axes,))]
-    if not used or all(sizes.get(a, 1) == 1 for a in used):
+    if _axes_all_trivial(spec):
         return x
     # Drop axes that don't divide the dim (tiny test shapes).
     fixed = []
