@@ -22,6 +22,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 import functools
 import json
+import os
 import sys
 import time
 
@@ -61,7 +62,43 @@ def _readback(x):
     return float(np.asarray(x.ravel()[0] if hasattr(x, "ravel") else x))
 
 
+def _devices_or_die(timeout_s=180):
+    """jax.devices() with a watchdog: the tunneled TPU backend can wedge so
+    hard that devices() never returns — fail with a diagnostic instead of
+    hanging the driver. os._exit because the stuck thread is in C++."""
+    import threading
+
+    out = {}
+
+    def probe():
+        try:
+            import jax
+
+            out["devices"] = jax.devices()
+        except BaseException as e:  # report, don't die silently in a thread
+            out["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        sys.stderr.write(
+            f"bench: jax.devices() did not return within {timeout_s}s — "
+            "accelerator backend unreachable (wedged TPU tunnel?).\n"
+        )
+        sys.stderr.flush()
+        os._exit(3)
+    if "error" in out:
+        sys.stderr.write(
+            f"bench: accelerator backend failed to initialize: {out['error']!r}\n"
+        )
+        sys.stderr.flush()
+        os._exit(4)
+    return out["devices"]
+
+
 def main():
+    _devices_or_die()
     import jax
     import jax.numpy as jnp
     import optax
@@ -120,17 +157,8 @@ def main():
     opt_state0 = jax.jit(tx.init)(params0)
     p, o, l = base_train(params0, opt_state0, ids)
     _readback(l)
-    base_times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            p, o, l = base_train(p, o, ids)
-        _readback(l)
-        base_times.append((time.perf_counter() - t0) / iters)
-    base_dt = sorted(base_times)[1]  # median of 3 repeats
-    del p, o
 
-    # ---- framework run ----
+    # ---- framework setup ----
     smp.reset()
     smp.init({"microbatches": num_mb, "bf16": bool(on_tpu)})
     model = smp.DistributedModel(gpt2_124m(max_len=seq_len, **model_kwargs))
@@ -147,15 +175,29 @@ def main():
         optimizer.step()
     _readback(out.reduce_mean())
 
-    times = []
+    # ---- interleaved timing (A/B/A/B) ----
+    # Chip clock/thermal state drifts over tens of seconds; timing all
+    # baseline iterations then all framework iterations folds that drift
+    # straight into vs_baseline. Alternating blocks exposes both paths to
+    # the same conditions; medians are robust to one slow block.
+    base_times, times = [], []
+    final_loss = None
     for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, o, l = base_train(p, o, ids)
+        _readback(l)
+        base_times.append((time.perf_counter() - t0) / iters)
+
         t0 = time.perf_counter()
         for _ in range(iters):
             out = train_step(model, ids)
             optimizer.step()
         final_loss = _readback(out.reduce_mean())
         times.append((time.perf_counter() - t0) / iters)
-    dt = sorted(times)[1]  # median of 3 repeats
+    base_dt = sorted(base_times)[1]  # median of 3 repeats
+    dt = sorted(times)[1]
+    del p, o
 
     tokens = batch * seq_len
     tok_per_sec_chip = tokens / dt / max(n_chips, 1)
