@@ -72,6 +72,21 @@ _SLOW_TESTS = (
     "test_pipeline_1f1b.py::TestInterleavedParity",
     "test_step.py::test_loss_decreases_transformer",
     "test_checkpoint.py::TestSaveLoad::test_partial_roundtrip",
+    # Re-tiered from --durations with the compile cache off (each >= ~15s
+    # single-core; all are end-to-end training loops, tier 2 by nature).
+    "test_memory_systems.py::TestFp16LossScaling::test_fp16_training_runs_and_matches",
+    "test_memory_systems.py::TestOptimizerStateSharding::test_zero1_loss_parity",
+    "test_memory_systems.py::TestActivationOffload::test_offload_config_runs",
+    "test_config_honored.py::TestManualPartition::test_partition_file_save_and_load",
+    "test_config_honored.py::TestManualPartition::test_default_partition_with_pins",
+    "test_checkpoint.py::TestSaveCheckpointDir::test_deferred_application",
+    "test_checkpoint.py::TestSaveCheckpointDir::test_full_checkpoint",
+    "test_checkpoint.py::TestSaveCheckpointDir::test_roundtrip_with_newest",
+    "test_context_parallel.py::TestCpRealModelFeatures::test_lmhead_mask_dropout_runs_ring_with_ppermute",
+    "test_moe.py::TestExpertParallel::test_transformer_layer_moe_trains",
+    "test_delayed_init.py::test_delayed_init_matches_eager_init_numerically",
+    "test_huggingface.py::TestRoundTrip::test_vit_encoder_trains_under_smp_step",
+    "test_multiprocess.py::test_two_process_control_plane",
 )
 
 

@@ -204,6 +204,34 @@ class TestRoundTrip:
                 np.asarray(back[k]), sd[k].numpy(), atol=1e-6, err_msg=k
             )
 
+    def test_vit_encoder_trains_under_smp_step(self):
+        """The encoder-scope family trains through the full smp.step path
+        (DistributedTransformer exposes pipeline_spec/backward support)."""
+        config = _tiny_configs()["vit"]
+        hf = _hf_model("vit", config)
+        smp.reset()
+        smp.init({"microbatches": 2, "ddp": True})
+        model = smp.from_hf(hf, deterministic=True)
+        opt = smp.DistributedOptimizer(optax.sgd(0.05), model)
+
+        @smp.step
+        def train_step(model, hidden, target):
+            out = model(hidden)
+            loss = jnp.mean((out - target) ** 2)
+            model.backward(loss)
+            return loss
+
+        rng = np.random.RandomState(0)
+        hidden = jnp.asarray(rng.randn(4, 5, 32), jnp.float32)
+        target = jnp.asarray(rng.randn(4, 5, 32), jnp.float32)
+        losses = []
+        for _ in range(4):
+            out = train_step(model, hidden, target)
+            opt.step()
+            losses.append(float(out.reduce_mean()))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
     def test_registry_has_predefined_hooks(self):
         smp.reset()
         smp.init({})
