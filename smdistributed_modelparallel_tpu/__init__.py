@@ -49,6 +49,7 @@ from smdistributed_modelparallel_tpu.checkpoint import (
     resume_from_checkpoint,
     save,
     save_checkpoint,
+    wait_for_checkpoints,
 )
 from smdistributed_modelparallel_tpu.nn.tp_registry import (
     tp_register,

@@ -116,38 +116,80 @@ def verify_smp_config(saved):
 
 def save_checkpoint(path, tag=None, model=None, optimizer=None,
                     user_content=None, partial=True,
-                    num_kept_partial_checkpoints=None, translate_if_full=True):
+                    num_kept_partial_checkpoints=None, translate_if_full=True,
+                    blocking=True):
     """Write a checkpoint directory.
 
     Parity: reference ``smp.save_checkpoint`` (``torch/checkpoint.py:180-298``):
     ``{path}/{tag}_partial/`` with per-rank files, ``newest`` pointer,
     retention GC. With ``partial=False`` a single gathered file
     ``{path}/{tag}`` is written (optionally HF-translated).
+
+    ``blocking=False`` (TPU extension; the reference has no async saves):
+    everything mutable is snapshotted at submission time — this process's
+    addressable shards are copied to HOST memory immediately (so later
+    ``optimizer.step()`` donation can free the device buffers safely) and
+    ``user_content`` is deep-copied — then serialization and disk IO run
+    on a background thread while training continues. Saves are serialized
+    in submission order (one writer thread), so ``newest`` always ends at
+    the latest tag; call ``smp.wait_for_checkpoints()`` to drain and
+    surface errors (also runs at exit). For full (gathered) checkpoints
+    the gather itself happens eagerly — only serialization/IO is deferred.
     """
     model = model if model is not None else state.model
     optimizer = optimizer if optimizer is not None else state.optimizer
     tag = tag if tag is not None else f"step_{state.step_count}"
     os.makedirs(path, exist_ok=True)
 
+    # Snapshot everything NOW; the job below touches only captured values.
+    # Device trees become host numpy shard payloads eagerly: holding jax
+    # Array references would break under the standalone optimizer update's
+    # donation (donate_argnums deletes the exact captured buffers).
+    user_content = pickle.loads(pickle.dumps(user_content, protocol=4))
     if partial:
-        from smdistributed_modelparallel_tpu.shard_io import save_sharded
+        from smdistributed_modelparallel_tpu.shard_io import shard_payload
 
-        ckpt_dir = os.path.join(path, f"{tag}_partial")
-        os.makedirs(ckpt_dir, exist_ok=True)
-        if model is not None and model.params is not None:
-            # True per-rank shards (reference: per-rank partial files,
-            # torch/checkpoint.py:124-165): each process writes only its
-            # replica-0 addressable shards; no process gathers the tree.
-            save_sharded(model.params, ckpt_dir, "model")
-        if optimizer is not None and optimizer.opt_state is not None:
-            save_sharded(optimizer.opt_state, ckpt_dir, "optimizer")
-        if state.loss_scaler is not None:
-            save(state.loss_scaler.state_dict(),
-                 os.path.join(ckpt_dir, "fp16_states.pt"))
-        with open(os.path.join(ckpt_dir, "user_content.pt"), "wb") as fh:
-            pickle.dump(user_content, fh, protocol=4)
-        with open(os.path.join(ckpt_dir, "smp_config.pt"), "wb") as fh:
-            pickle.dump(_smp_config_snapshot(), fh, protocol=4)
+        model_payload = (
+            shard_payload(model.params)
+            if model is not None and model.params is not None else None
+        )
+        opt_payload = (
+            shard_payload(optimizer.opt_state)
+            if optimizer is not None and optimizer.opt_state is not None
+            else None
+        )
+        scaler_sd = (
+            state.loss_scaler.state_dict() if state.loss_scaler else None
+        )
+        cfg_snapshot = _smp_config_snapshot()
+
+        def job():
+            import numpy as np
+
+            ckpt_dir = os.path.join(path, f"{tag}_partial")
+            os.makedirs(ckpt_dir, exist_ok=True)
+            me = _process_index()
+            if model_payload is not None:
+                # True per-rank shards (reference: per-rank partial files,
+                # torch/checkpoint.py:124-165): each process writes only
+                # its replica-0 addressable shards; no process gathers the
+                # tree.
+                np.savez(
+                    os.path.join(ckpt_dir, f"model_shards_p{me}.npz"),
+                    **model_payload,
+                )
+            if opt_payload is not None:
+                np.savez(
+                    os.path.join(ckpt_dir, f"optimizer_shards_p{me}.npz"),
+                    **opt_payload,
+                )
+            if scaler_sd is not None:
+                save(scaler_sd, os.path.join(ckpt_dir, "fp16_states.pt"))
+            with open(os.path.join(ckpt_dir, "user_content.pt"), "wb") as fh:
+                pickle.dump(user_content, fh, protocol=4)
+            with open(os.path.join(ckpt_dir, "smp_config.pt"), "wb") as fh:
+                pickle.dump(cfg_snapshot, fh, protocol=4)
+            _finish_checkpoint(path, tag, partial, num_kept_partial_checkpoints)
     else:
         sd = model.state_dict() if model is not None else {}
         if translate_if_full:
@@ -159,16 +201,70 @@ def save_checkpoint(path, tag=None, model=None, optimizer=None,
         }
         if optimizer is not None and optimizer.opt_state is not None:
             payload["optimizer"] = optimizer.state_dict()
-        with open(os.path.join(path, tag), "wb") as fh:
-            pickle.dump(payload, fh, protocol=4)
 
+        def job():
+            with open(os.path.join(path, tag), "wb") as fh:
+                pickle.dump(payload, fh, protocol=4)
+            _finish_checkpoint(path, tag, partial, num_kept_partial_checkpoints)
+
+    if blocking:
+        if _SAVER is not None:
+            # Serialize behind any in-flight async saves: running inline
+            # would race the writer thread on `newest` and retention GC.
+            _saver_executor().submit(job).result()
+        else:
+            job()
+    else:
+        _PENDING_SAVES.append(_saver_executor().submit(job))
+
+
+def _process_index():
+    import jax
+
+    return jax.process_index()
+
+
+def _finish_checkpoint(path, tag, partial, num_kept):
     with open(os.path.join(path, "newest"), "w") as fh:
         fh.write(tag)
     logger.info("Saved %s checkpoint '%s' under %s.",
                 "partial" if partial else "full", tag, path)
+    if partial and num_kept is not None:
+        _gc_partial_checkpoints(path, num_kept)
 
-    if partial and num_kept_partial_checkpoints is not None:
-        _gc_partial_checkpoints(path, num_kept_partial_checkpoints)
+
+_SAVER = None
+_PENDING_SAVES = []
+
+
+def _saver_executor():
+    global _SAVER
+    if _SAVER is None:
+        import atexit
+        from concurrent.futures import ThreadPoolExecutor
+
+        # ONE worker: saves execute in submission order, so the `newest`
+        # pointer always converges to the latest submitted tag.
+        _SAVER = ThreadPoolExecutor(max_workers=1, thread_name_prefix="smp-ckpt")
+        atexit.register(wait_for_checkpoints)
+    return _SAVER
+
+
+def wait_for_checkpoints():
+    """Drain pending non-blocking saves; re-raises the first failure.
+    Registered atexit so fire-and-forget saves still complete."""
+    global _PENDING_SAVES
+    pending, _PENDING_SAVES = _PENDING_SAVES, []
+    first_err = None
+    for fut in pending:
+        try:
+            fut.result()
+        except Exception as e:  # noqa: BLE001 - surfaced to the caller
+            logger.error("async checkpoint save failed: %s", e)
+            if first_err is None:
+                first_err = e
+    if first_err is not None:
+        raise first_err
 
 
 def _gc_partial_checkpoints(path, keep):
