@@ -56,6 +56,11 @@ from smdistributed_modelparallel_tpu.nn.tp_registry import (
     tp_register_with_module,
 )
 from smdistributed_modelparallel_tpu.nn.huggingface import from_hf
+from smdistributed_modelparallel_tpu.utils.data import (
+    dataloader,
+    prefetch_to_device,
+    shard_batches,
+)
 from smdistributed_modelparallel_tpu import amp
 from smdistributed_modelparallel_tpu import nn
 

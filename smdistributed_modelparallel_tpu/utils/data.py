@@ -1,0 +1,175 @@
+"""Input pipeline helpers: per-process batch sharding + device prefetch.
+
+The reference leans on torch ``DataLoader`` + its one-process-per-GPU model
+(each rank trivially loads its own shard); under SPMD one process feeds
+many chips, so the framework provides the two pieces that replace that
+pattern TPU-natively:
+
+- ``shard_batches(it)`` — slice each yielded batch down to this PROCESS's
+  portion of the global batch (multi-host input pipelines load disjoint
+  data per host);
+- ``prefetch_to_device(it, size=2)`` — a bounded background pipeline that
+  stages upcoming batches onto device with the step engine's input
+  shardings, so host->device transfer overlaps the previous step's
+  compute (the classic double-buffering recipe; on TPU the transfer
+  rides DMA while the MXU works).
+
+``smp.dataloader(it)`` composes both.
+"""
+
+import queue
+import threading
+
+import jax
+import numpy as np
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils.exceptions import SMPValidationError
+
+
+def _global_batch_sharding(arr):
+    # EXACTLY the step engine's input placement (same helper), with the
+    # configured microbatch count — so prefetched arrays are already where
+    # step.py::_place wants them and the per-step device_put is skipped.
+    from smdistributed_modelparallel_tpu.step import _input_sharding
+
+    num_mb = state.cfg.microbatches
+    return _input_sharding(state.mesh, state.cfg, arr, (0, num_mb, False))
+
+
+def shard_batches(iterator, batch_axis=0):
+    """Slice each batch pytree down to this process's portion.
+
+    Every process must iterate the SAME global stream (same order, same
+    batch sizes); process p keeps rows [p*B/P, (p+1)*B/P) of each leaf's
+    ``batch_axis``. Leaves without a batch dim (scalars, metadata) pass
+    through unchanged, as do whole batches on single-process runs.
+    """
+    P_ = jax.process_count()
+    me = jax.process_index()
+    for batch in iterator:
+        if P_ == 1:
+            yield batch
+            continue
+
+        def cut(leaf):
+            arr = np.asarray(leaf)
+            if arr.ndim <= batch_axis:
+                return leaf  # scalar / metadata leaf: nothing to slice
+            B = arr.shape[batch_axis]
+            if B % P_ != 0:
+                raise SMPValidationError(
+                    f"Global batch dim {B} must be divisible by the "
+                    f"process count ({P_})."
+                )
+            per = B // P_
+            idx = [slice(None)] * arr.ndim
+            idx[batch_axis] = slice(me * per, (me + 1) * per)
+            return arr[tuple(idx)]
+
+        yield jax.tree_util.tree_map(cut, batch)
+
+
+class prefetch_to_device:
+    """Iterator wrapper staging up to ``size`` upcoming batches on device.
+
+    A daemon thread pulls host batches and calls ``jax.device_put`` with
+    the framework's batch shardings; consumers receive device-committed
+    arrays, so the step engine's placement check
+    (``step.py::_place``) is a no-op and the NEXT batch's host->device
+    transfer overlaps the CURRENT step's compute. Exceptions from the
+    source iterator re-raise at the consumption point; once exhausted (or
+    failed) the iterator keeps raising StopIteration (or the error).
+
+    ``close()`` (also the context-manager exit) stops the fill thread and
+    releases the staged batches — call it when abandoning the iterator
+    mid-stream, or the queued device batches stay alive until GC.
+    """
+
+    _DONE = object()
+
+    def __init__(self, iterator, size=2):
+        if size < 1:
+            raise SMPValidationError("prefetch size must be >= 1")
+        if not state.initialized:
+            raise SMPValidationError(
+                "smp.init must run before prefetch_to_device (shardings "
+                "come from the mesh)."
+            )
+        self._q = queue.Queue(maxsize=size)
+        self._stop = threading.Event()
+        self._terminal = None  # StopIteration or the source exception
+        self._thread = threading.Thread(
+            target=self._fill, args=(iterator,), daemon=True,
+            name="smp-prefetch",
+        )
+        self._thread.start()
+
+    def _put(self, item):
+        """Bounded put that gives up when the consumer closed us."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill(self, iterator):
+        try:
+            for batch in iterator:
+                if self._stop.is_set():
+                    return
+                staged = jax.tree_util.tree_map(
+                    lambda leaf: jax.device_put(
+                        leaf, _global_batch_sharding(leaf)
+                    ),
+                    batch,
+                )
+                if not self._put(staged):
+                    return
+        except Exception as e:  # noqa: BLE001 - re-raised at consumption
+            self._put(e)
+            return
+        self._put(self._DONE)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._terminal is not None:
+            raise self._terminal
+        item = self._q.get()
+        if item is self._DONE:
+            self._terminal = StopIteration()
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._terminal = item
+            raise item
+        return item
+
+    def close(self):
+        """Stop the fill thread and drop staged batches."""
+        self._stop.set()
+        self._terminal = StopIteration()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def dataloader(iterator, size=2, batch_axis=0):
+    """``prefetch_to_device(shard_batches(iterator))`` — the standard
+    multi-host input pipeline composition."""
+    return prefetch_to_device(
+        shard_batches(iterator, batch_axis=batch_axis), size=size
+    )
