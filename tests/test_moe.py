@@ -120,6 +120,43 @@ class TestDispatchMath:
         assert float(aux) >= 1.0 - 1e-5
 
 
+
+
+def _lm_loss_step():
+    """Shared @smp.step LM-loss train step used by the e2e MoE tests."""
+
+    @smp.step
+    def train_step(model, ids):
+        logits = model(ids)
+        lg = logits[:, :-1]
+        tgt = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
+        lse = jax.scipy.special.logsumexp(lg.astype(jnp.float32), axis=-1)
+        loss = jnp.mean(lse - tgt.astype(jnp.float32))
+        model.backward(loss)
+        return loss
+
+    return train_step
+
+
+def _train_moe_lmhead(n_steps, ids, **lmhead_kwargs):
+    """Build an MoE LMHead, train n_steps with Adam, return (model, losses)."""
+    module = smp.nn.DistributedTransformerLMHead(
+        num_attention_heads=2, vocab_size=64,
+        pre_layernorm=True, post_layernorm=False, final_layernorm=True,
+        attention_dropout_prob=0.0, hidden_dropout_prob=0.0,
+        embedding_dropout_prob=0.0, deterministic=True, **lmhead_kwargs,
+    )
+    model = smp.DistributedModel(module)
+    opt = smp.DistributedOptimizer(optax.adam(1e-2), model)
+    train_step = _lm_loss_step()
+    losses = []
+    for _ in range(n_steps):
+        out = train_step(model, ids)
+        opt.step()
+        losses.append(float(out.reduce_mean()))
+    return model, losses
+
+
 class TestExpertParallel:
     def test_ep4_matches_ep1(self):
         """The same params/input produce the same output whether experts
@@ -144,36 +181,34 @@ class TestExpertParallel:
         loop under an ep mesh decreases the loss."""
         smp.reset()
         smp.init({"expert_parallel_degree": 2, "ddp": True, "microbatches": 2})
-        module = smp.nn.DistributedTransformerLMHead(
-            num_layers=2, num_attention_heads=2, attention_head_size=16,
-            hidden_size=32, intermediate_size=64, vocab_size=64,
-            num_positions=16, causal_mask_size=16, pre_layernorm=True,
-            post_layernorm=False, final_layernorm=True,
-            attention_dropout_prob=0.0, hidden_dropout_prob=0.0,
-            embedding_dropout_prob=0.0, num_experts=4, deterministic=True,
-        )
-        model = smp.DistributedModel(module)
-        opt = smp.DistributedOptimizer(optax.adam(1e-2), model)
-
-        @smp.step
-        def train_step(model, ids):
-            logits = model(ids)
-            lg = logits[:, :-1]
-            tgt = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
-            lse = jax.scipy.special.logsumexp(lg.astype(jnp.float32), axis=-1)
-            loss = jnp.mean(lse - tgt.astype(jnp.float32))
-            model.backward(loss)
-            return loss
-
         ids = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
-        losses = []
-        for _ in range(5):
-            out = train_step(model, ids)
-            opt.step()
-            losses.append(float(out.reduce_mean()))
+        model, losses = _train_moe_lmhead(
+            5, ids, num_layers=2, attention_head_size=16, hidden_size=32,
+            intermediate_size=64, num_positions=16, causal_mask_size=16,
+            num_experts=4,
+        )
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0]
         # Expert params exist with the [L, E, ...] stacked layout.
         lay = model.params["transformer"]["seq_layers"]["layer"]["output"]
-        assert lay["fc/kernel"].shape[1] == 4  # [L, E, D, F]
-        assert lay["fc/kernel"].shape[0] == 2
+        assert lay["fc/kernel"].shape[:2] == (2, 4)  # [L, E, D, F]
+
+
+@pytest.mark.slow
+class TestMoEPipeline:
+    def test_moe_under_pipeline_parallelism(self):
+        """MoE layers ([L, E, ...] stacked params) slice cleanly into the
+        1F1B executor's [S, maxp, ...] stage views and train."""
+        smp.reset()
+        smp.init({"pipeline_parallel_degree": 2, "ddp": True,
+                  "microbatches": 2})
+        ids = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
+        model, losses = _train_moe_lmhead(
+            3, ids, num_layers=4, attention_head_size=8, hidden_size=16,
+            intermediate_size=32, num_positions=16, causal_mask_size=16,
+            num_experts=2,
+        )
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+        lay = model.params["transformer"]["seq_layers"]["layer"]["output"]
+        assert lay["fc/kernel"].shape[:2] == (4, 2)  # [L, E, D, F]
