@@ -1,1 +1,9 @@
 """Model zoo: flax implementations of the reference's supported families."""
+
+from smdistributed_modelparallel_tpu.models.encoder_decoder import (
+    EncoderDecoderLM,
+    t5_style,
+    t5_style_3b,
+)
+from smdistributed_modelparallel_tpu.models.gpt2 import gpt2, gpt2_124m, gpt2_1p5b
+from smdistributed_modelparallel_tpu.models.transformer_lm import TransformerLM
