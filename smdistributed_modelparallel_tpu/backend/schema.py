@@ -355,6 +355,15 @@ SCHEMA = {
         "lower_bound": 1,
         "description": "TPU extension: expert parallelism degree for MoE layers.",
     },
+    "moe_aux_loss_weight": {
+        "type": float,
+        "default": 1.0,
+        "lower_bound": 0.0,
+        "description": "TPU extension: global multiplier on the MoE router "
+        "load-balancing auxiliary loss folded into the differentiated step "
+        "loss (each DistributedMoE layer's own aux_loss_coef still applies). "
+        "0 disables the aux term.",
+    },
     "use_pallas_kernels": {
         "type": bool,
         "default": True,

@@ -124,8 +124,19 @@ class DistributedModel:
             params = self._params
         rngs = getattr(self._tls, "rngs", None)
         variables = {"params": params}
-        mutable = False
-        out = self.module.apply(variables, *args, rngs=rngs, mutable=mutable, **kwargs)
+        # Run with intermediates mutable so MoE router load-balancing losses
+        # (sown under "moe_aux_loss", nn/moe.py) reach the step engine; they
+        # are folded into the differentiated loss in _end_step_trace.
+        from smdistributed_modelparallel_tpu.nn.moe import collect_moe_aux
+
+        out, mut = self.module.apply(
+            variables, *args, rngs=rngs, mutable=["intermediates"], **kwargs
+        )
+        if getattr(self._tls, "in_step", False):
+            aux = collect_moe_aux(mut.get("intermediates"))
+            if aux is not None:
+                prev = getattr(self._tls, "aux_loss", None)
+                self._tls.aux_loss = aux if prev is None else prev + aux
         self._output_aval = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), out
         )
@@ -161,6 +172,7 @@ class DistributedModel:
         self._tls.in_step = True
         self._tls.call_mode = None
         self._tls.captured_calls = []
+        self._tls.aux_loss = None
 
     def _begin_capture(self, out_aval):
         """Intercept the model call: record inputs, return zeros(out_aval)."""
@@ -174,6 +186,7 @@ class DistributedModel:
 
     def _end_step_trace(self):
         loss = getattr(self._tls, "backward_loss", None)
+        aux = getattr(self._tls, "aux_loss", None)
         self._tls.captured = getattr(self._tls, "captured_calls", [])
         self._tls.bound_params = None
         self._tls.rngs = None
@@ -181,6 +194,13 @@ class DistributedModel:
         self._tls.in_step = False
         self._tls.call_mode = None
         self._tls.captured_calls = []
+        self._tls.aux_loss = None
+        if loss is not None and aux is not None:
+            weight = getattr(state.cfg, "moe_aux_loss_weight", 1.0)
+            if weight:
+                loss = loss + jnp.asarray(weight, loss.dtype) * aux.astype(
+                    loss.dtype
+                )
         return loss
 
     @property

@@ -185,13 +185,29 @@ class DistributedMoE(nn.Module):
         return out
 
 
-def moe_aux_losses(intermediates):
-    """Sum every ``moe_aux_loss`` sown anywhere in an intermediates tree
-    (one entry per MoE layer; scanned stacks sow a [num_layers] vector)."""
-    total = 0.0
+def collect_moe_aux(intermediates):
+    """Sum every sown ``moe_aux_loss`` in an intermediates tree, or None
+    when nothing was sown (so MoE-free models add no term to traced
+    losses). One entry per MoE layer; scanned stacks sow a [num_layers]
+    vector."""
+    if not intermediates:
+        return None
+    total = None
     for path, leaf in jax.tree_util.tree_leaves_with_path(intermediates):
         if any(
             getattr(k, "key", None) == "moe_aux_loss" for k in path
         ):
-            total = total + jnp.sum(leaf)
+            s = jnp.sum(leaf)
+            total = s if total is None else total + s
     return total
+
+
+def moe_aux_losses(intermediates):
+    """Sum every ``moe_aux_loss`` sown anywhere in an intermediates tree
+    (0.0 when none). Kept for users reading aux losses from their own
+    ``module.apply(..., mutable=["intermediates"])`` calls; the standard
+    ``DistributedModel`` / pipeline paths fold the aux loss into the
+    differentiated step loss automatically (weighted by the
+    ``moe_aux_loss_weight`` config key)."""
+    total = collect_moe_aux(intermediates)
+    return 0.0 if total is None else total

@@ -449,7 +449,8 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
       rngs_key: PRNG key for dropout etc. (folded per microbatch and layer).
 
     Returns:
-      stacked outputs with leading [num_microbatches].
+      (stacked outputs with leading [num_microbatches], summed MoE aux loss
+      over all microbatches and layers — a 0.0 scalar for MoE-free models).
     """
     spec = model._pipeline_spec
     cfg = state.cfg
@@ -463,49 +464,32 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
 
     layer_params = _get_subtree(params, spec.layer_path)
 
+    # embed/head also run with aux collection so an MoE living outside the
+    # layer stack keeps its balancing loss under pp (parity with pp=1,
+    # where DistributedModel.__call__ collects from the whole module).
     def embed_mb(mb_input, key):
         args, kwargs = mb_input
         if spec.embed_method is None:
             # The module IS the layer stack; the model(...) input is the carry.
-            return args[0]
-        return module.apply(
-            {"params": params},
-            *args,
+            return args[0], jnp.zeros((), jnp.float32)
+        return apply_collecting_aux(
+            module, {"params": params}, *args,
             rngs=_mk_rngs(model, key, "embed"),
-            method=spec.embed_method,
-            **kwargs,
+            method=spec.embed_method, **kwargs,
         )
 
     def head_mb(carry, key):
         # `carry` here is the collected hidden only (side values never
         # leave the layer stack).
         if spec.head_method is None:
-            return carry
-        return module.apply(
-            {"params": params},
-            carry,
+            return carry, jnp.zeros((), jnp.float32)
+        return apply_collecting_aux(
+            module, {"params": params}, carry,
             rngs=_mk_rngs(model, key, "head"),
             method=spec.head_method,
         )
 
-    from smdistributed_modelparallel_tpu.parallel.memory import (
-        name_layer_activation,
-    )
-
-    def apply_one_layer(lp, carry, layer_xs, key):
-        rngs = _mk_rngs(model, key, "layer")
-        if spec.carry_is_tuple:
-            x, cross, amask = carry
-            out = layer_module.apply(
-                {"params": lp}, x, cross_states=cross, attention_mask=amask,
-                xs=layer_xs, rngs=rngs,
-            )
-            return (name_layer_activation(out), cross, amask)
-        if spec.layer_xs is not None:
-            out = layer_module.apply({"params": lp}, carry, xs=layer_xs, rngs=rngs)
-        else:
-            out = layer_module.apply({"params": lp}, carry, rngs=rngs)
-        return name_layer_activation(out)
+    apply_one_layer = make_layer_apply(model, spec, layer_module)
 
     if spec.carry_remat:
         from smdistributed_modelparallel_tpu.parallel.memory import remat_policy
@@ -514,25 +498,27 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
 
     def stage_body(stage_layer_params, stage_layer_xs, carry, key, active_row):
         """Apply this stage's layer slots sequentially (scan over the local
-        layer axis); padded slots pass the carry through unchanged."""
+        layer axis); padded slots pass the carry through unchanged. Returns
+        (carry, summed MoE aux loss of the active slots)."""
 
         def body(c, xs):
             lp, lxs, i, act = xs
-            new_c = apply_one_layer(lp, c, lxs, jax.random.fold_in(key, i))
-            return jax.tree_util.tree_map(
+            new_c, aux = apply_one_layer(lp, c, lxs, jax.random.fold_in(key, i))
+            out_c = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(act, n, o), new_c, c
-            ), None
+            )
+            return out_c, jnp.where(act, aux, 0.0)
 
         idx = jnp.arange(active_row.shape[0])
-        out, _ = jax.lax.scan(
+        out, auxs = jax.lax.scan(
             body, carry, (stage_layer_params, stage_layer_xs, idx, active_row)
         )
-        return out
+        return out, jnp.sum(auxs)
 
     mb_keys = jax.random.split(rngs_key, num_mb)
 
     # Embed all microbatches upfront (the pipeline's input queue).
-    embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
+    embedded, embed_auxs = _scan_map(embed_mb, stacked_inputs, mb_keys)
 
     # [L, ...] -> [S, maxp, ...]; dim 0 stays sharded on pp. Uniform
     # boundaries collapse to a reshape; uneven ones gather padded slots.
@@ -561,9 +547,11 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     stage_keys = jax.random.split(rngs_key, S)
     stage_ids = jnp.arange(S)
 
-    def tick(buf, t):
+    def tick(tick_carry, t):
         # Feed stage 0 with microbatch t (clamped; invalid ticks produce
-        # garbage that is never collected).
+        # garbage that is never collected — and whose aux loss is masked
+        # out below).
+        buf, aux_acc = tick_carry
         mb_idx = jnp.minimum(t, num_mb - 1)
         feed = jax.tree_util.tree_map(
             lambda e, b: b.at[0].set(
@@ -590,24 +578,94 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
             carry_in = feed
         # Distinct dropout keys per (stage, tick).
         tick_keys = jax.vmap(lambda k: jax.random.fold_in(k, t))(stage_keys)
-        outs = vmapped_stages(
+        outs, aux_row = vmapped_stages(
             staged_params, staged_xs, carry_in, tick_keys, active_rows
         )
         x_outs = outs[0] if sides is not None else outs
+        # MoE aux: stage s holds microbatch t - s; fill/drain ticks where
+        # that index is invalid computed on garbage/duplicate inputs and
+        # must not contribute.
+        valid = (t - stage_ids >= 0) & (t - stage_ids < num_mb)
+        aux_acc = aux_acc + jnp.sum(jnp.where(valid, aux_row, 0.0))
         # Collect last stage's output (microbatch t - (S-1) when valid).
         tail = jax.tree_util.tree_map(lambda o: o[S - 1], x_outs)
         # Shift stage outputs forward one stage: collective-permute on pp.
         nxt = jax.tree_util.tree_map(
             lambda o: jnp.roll(o, shift=1, axis=0), x_outs
         )
-        return nxt, tail
+        return (nxt, aux_acc), tail
 
-    _, tails = jax.lax.scan(tick, buf0, jnp.arange(n_ticks))
+    (_, aux_total), tails = jax.lax.scan(
+        tick, (buf0, jnp.zeros((), jnp.float32)), jnp.arange(n_ticks)
+    )
     # tails[t] is microbatch t-(S-1); keep the last num_mb ticks.
     collected = jax.tree_util.tree_map(lambda x: x[S - 1:], tails)
 
-    outputs = _scan_map(head_mb, collected, mb_keys)
-    return outputs
+    outputs, head_auxs = _scan_map(head_mb, collected, mb_keys)
+    return outputs, aux_total + jnp.sum(embed_auxs) + jnp.sum(head_auxs)
+
+
+def apply_collecting_aux(module, variables, *args, **kwargs):
+    """Flax apply with ``mutable=["intermediates"]``: returns (out, aux)
+    where ``aux`` is the summed sown MoE load-balancing loss as an f32
+    scalar (0.0 when nothing was sown). Running with the collection mutable
+    is what lets ``sow`` escape the apply — the executors fold the summed
+    aux into the differentiated loss (see ``step.py`` /
+    ``pipeline_1f1b.py``)."""
+    from smdistributed_modelparallel_tpu.nn.moe import collect_moe_aux
+
+    out, mut = module.apply(
+        variables, *args, mutable=["intermediates"], **kwargs
+    )
+    aux = collect_moe_aux(mut.get("intermediates"))
+    aux = (
+        jnp.zeros((), jnp.float32) if aux is None else aux.astype(jnp.float32)
+    )
+    return out, aux
+
+
+def make_layer_apply(model, spec, layer_module, side_in_carry=True):
+    """Single-layer application shared by both pipeline executors.
+
+    Returns ``apply_one_layer(lp, carry, layer_xs, key, side=None) ->
+    (new_carry, aux)`` with ``aux`` the layer's MoE aux loss (0.0 for dense
+    layers). For tuple-carry specs the two executors thread the side values
+    differently: the fill-drain executor keeps them inside the carry
+    (``side_in_carry=True``: carry is (x, cross, amask) in and out), while
+    1F1B rolls only the hidden and passes (cross, amask) via ``side``
+    (``side_in_carry=False``)."""
+    from smdistributed_modelparallel_tpu.parallel.memory import (
+        name_layer_activation,
+    )
+
+    def apply_one_layer(lp, carry, layer_xs, key, side=None):
+        rngs = _mk_rngs(model, key, "layer")
+        if spec.carry_is_tuple:
+            if side_in_carry:
+                x, cross, amask = carry
+            else:
+                x = carry
+                cross, amask = side
+            out, aux = apply_collecting_aux(
+                layer_module, {"params": lp}, x, cross_states=cross,
+                attention_mask=amask, xs=layer_xs, rngs=rngs,
+            )
+            new_c = (
+                (name_layer_activation(out), cross, amask)
+                if side_in_carry else name_layer_activation(out)
+            )
+            return new_c, aux
+        if spec.layer_xs is not None:
+            out, aux = apply_collecting_aux(
+                layer_module, {"params": lp}, carry, xs=layer_xs, rngs=rngs
+            )
+        else:
+            out, aux = apply_collecting_aux(
+                layer_module, {"params": lp}, carry, rngs=rngs
+            )
+        return name_layer_activation(out), aux
+
+    return apply_one_layer
 
 
 def _scan_map(fn, stacked, keys):

@@ -196,24 +196,15 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
 
     # ---- per-stage forward (pure in stage params and carry) ----------
 
-    from smdistributed_modelparallel_tpu.parallel.memory import (
-        name_layer_activation,
-        remat_policy,
+    from smdistributed_modelparallel_tpu.parallel.memory import remat_policy
+    from smdistributed_modelparallel_tpu.parallel.pipeline import (
+        apply_collecting_aux,
+        make_layer_apply,
     )
 
-    def apply_one_layer(lp, carry, layer_xs, key, side):
-        rngs = _mk_rngs(model, key, "layer")
-        if spec.carry_is_tuple:
-            cross, amask = side
-            out = layer_module.apply(
-                {"params": lp}, carry, cross_states=cross,
-                attention_mask=amask, xs=layer_xs, rngs=rngs,
-            )
-        elif spec.layer_xs is not None:
-            out = layer_module.apply({"params": lp}, carry, xs=layer_xs, rngs=rngs)
-        else:
-            out = layer_module.apply({"params": lp}, carry, rngs=rngs)
-        return name_layer_activation(out)
+    apply_one_layer = make_layer_apply(
+        model, spec, layer_module, side_in_carry=False
+    )
 
     if spec.carry_remat:
         apply_one_layer = jax.checkpoint(apply_one_layer, policy=remat_policy())
@@ -221,22 +212,25 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     def stage_fwd(stage_lp, stage_lxs, x, side, s_idx, m_idx, act_row):
         """Apply this stage's layer slots; keys derived from (stage, mb) so
         the backward recompute reproduces dropout exactly. Padded slots pass
-        the carry through unchanged."""
+        the carry through unchanged. Returns (carry, summed MoE aux loss of
+        the active slots) — the aux output is what lets the backward VJP
+        seed router load-balancing gradients (see stage_bwd)."""
         base = jax.random.fold_in(jax.random.fold_in(rng, s_idx), m_idx)
         stage_lp = cast_half(stage_lp)
 
         def body(c, xs):
             lp, lxs, i, act = xs
-            new_c = apply_one_layer(
+            new_c, aux = apply_one_layer(
                 lp, c, lxs, jax.random.fold_in(base, i), side
             )
-            return jax.tree_util.tree_map(
+            out_c = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(act, n, o), new_c, c
-            ), None
+            )
+            return out_c, jnp.where(act, aux, 0.0)
 
         idx = jnp.arange(maxp)
-        out, _ = jax.lax.scan(body, x, (stage_lp, stage_lxs, idx, act_row))
-        return out
+        out, auxs = jax.lax.scan(body, x, (stage_lp, stage_lxs, idx, act_row))
+        return out, jnp.sum(auxs)
 
     def gather_mb(tree, m):
         return jax.tree_util.tree_map(
@@ -260,13 +254,16 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
 
     # ---- head + user loss (last stage only) --------------------------
 
-    def head_apply(p, carry, key):
+    def head_apply_aux(p, carry, key):
         if spec.head_method is None:
-            return carry
-        return module.apply(
-            {"params": cast_half(p)}, carry,
+            return carry, jnp.zeros((), jnp.float32)
+        return apply_collecting_aux(
+            module, {"params": cast_half(p)}, carry,
             rngs=_mk_rngs(model, key, "head"), method=spec.head_method,
         )
+
+    def head_apply(p, carry, key):
+        return head_apply_aux(p, carry, key)[0]
 
     # Abstract shapes of (loss, user_out) for the collection buffers.
     loss_out_aval = jax.eval_shape(
@@ -324,6 +321,14 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     )
 
     stage_ids = jnp.arange(S)
+    # MoE aux-loss backward seed: d(total_loss)/d(stage_aux) for one
+    # microbatch under mean-over-microbatch semantics. loss_seed_scale is
+    # loss_scale / num_microbatches, exactly the task-loss seed.
+    aux_w = float(getattr(cfg, "moe_aux_loss_weight", 1.0))
+    aux_seed = (
+        jnp.asarray(aux_w, jnp.float32)
+        * jnp.asarray(loss_seed_scale, jnp.float32)
+    )
 
     def set_ring(buf, row_slots, row_vals, row_active):
         """buf[s, row_slots[s]] = row_vals[s] where row_active[s]."""
@@ -379,7 +384,7 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             lambda q, b: b.at[0].set(q), from_q, buf_in
         )
         f_sides = gather_sides_rows(fmc)
-        outs_f = jax.vmap(
+        outs_f, _aux_f = jax.vmap(
             stage_fwd,
             in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0),
         )(staged_params, staged_xs, x_in, f_sides, stage_ids, fmc, active_rows)
@@ -417,8 +422,13 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
         )
 
         def head_loss(p_rep, out):
-            final = head_apply(p_rep, out, key_last)
+            final, h_aux = head_apply_aux(p_rep, out, key_last)
             loss, user_out = mb_loss_fn(final, m_last, key_last)
+            # Head-resident MoE aux joins the differentiated loss with the
+            # same weight as the layer-stack aux (parity with pp=1).
+            loss = loss + jnp.asarray(aux_w, loss.dtype) * h_aux.astype(
+                loss.dtype
+            )
             return loss, user_out
 
         loss_m, head_vjp, user_out = jax.vjp(
@@ -443,7 +453,11 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
                 return stage_fwd(lp_, lxs, x_, side_, s_idx, m_idx, act_row)
 
             _, vjp = jax.vjp(f, lp, x, side)
-            return vjp(cot)
+            # Seed both outputs: the downstream cotangent for the hidden
+            # carry, and the MoE aux-loss seed (same mean-loss scaling as
+            # the task loss; idle-stage contributions are masked when
+            # accumulated below).
+            return vjp((cot, aux_seed))
 
         d_lp_rows, d_x_rows, d_side_rows = jax.vmap(
             stage_bwd,
@@ -522,25 +536,26 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     def embed_bwd(acc, xs):
         mb_input, key, dcarry, dside_row = xs
 
-        def embed_mb_with(p):
+        def embed_inexact(p):
             args, kwargs = mb_input
-            return module.apply(
-                {"params": cast_half(p)}, *args,
+            out, aux = apply_collecting_aux(
+                module, {"params": cast_half(p)}, *args,
                 rngs=_mk_rngs(model, key, "embed"),
                 method=spec.embed_method, **kwargs,
             )
-
-        def embed_inexact(p):
-            out = embed_mb_with(p)
             leaves, _, idx = _inexact_leaves(out)
-            return [leaves[i] for i in idx]
+            # The embed's own MoE aux (0.0 for dense embeds) rides along as
+            # a final output so its balancing gradient is seeded below.
+            return [leaves[i] for i in idx] + [aux]
 
         out_aval = jax.eval_shape(embed_inexact, params)
-        # Cotangent list: hidden cotangent (+ side cotangents for tuples).
+        # Cotangent list: hidden cotangent (+ side cotangents for tuples),
+        # then the aux seed.
         if sides is not None:
             cots = list(jax.tree_util.tree_leaves(dcarry)) + list(dside_row)
         else:
             cots = jax.tree_util.tree_leaves(dcarry)
+        cots = cots + [aux_seed]
         cots = [c.astype(a.dtype) for c, a in zip(cots, out_aval)]
         _, vjp = jax.vjp(embed_inexact, params)
         (dp,) = vjp(cots)

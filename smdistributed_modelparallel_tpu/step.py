@@ -511,7 +511,7 @@ class StepFunction:
                         if jnp.issubdtype(x.dtype, jnp.floating) else x,
                         p,
                     )
-                outs = pipeline_forward(model, run_p, stacked_inputs, rng)
+                outs, pipe_aux = pipeline_forward(model, run_p, stacked_inputs, rng)
 
                 def post_body(_, xs):
                     mb_leaves, out, key = xs
@@ -537,7 +537,11 @@ class StepFunction:
                 _, (losses, user_outs) = jax.lax.scan(
                     post_body, 0, (scan_leaves, outs, keys)
                 )
-                return jnp.mean(losses) * loss_scale, user_outs
+                # MoE aux loss from the layer stack (0.0 for dense models);
+                # mean-over-microbatch semantics matching the task loss.
+                aux_w = float(getattr(cfg, "moe_aux_loss_weight", 1.0))
+                total = jnp.mean(losses) + aux_w * pipe_aux / num_mb
+                return total * loss_scale, user_outs
 
             if has_backward:
                 (_, outs), grads = jax.value_and_grad(forward_all, has_aux=True)(params)
