@@ -165,6 +165,18 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     staged_params, staged_xs, active_rows = staged_layer_views(
         spec, layer_params, S
     )
+    # The head/loss and embed VJPs differentiate only the NON-layer subtree
+    # (head, tied/replicated, embedding params): layer gradients come from
+    # the per-stage VJPs, so carrying full-tree zero cotangents through the
+    # per-tick head VJP would add accumulator traffic proportional to total
+    # params on every tick for nothing. Protocol note: embed/head methods
+    # must not read the layer-stack subtree (true of every pipelineable
+    # module in the package — the stack is applied only via
+    # spec.layer_module).
+    params_rest = _set_subtree(params, spec.layer_path, {})
+
+    def with_layers(p_rest):
+        return _set_subtree(p_rest, spec.layer_path, layer_params)
     idx_np, active_np, maxp = stage_layout(spec, S)
 
     mb_keys = jax.random.split(rng, M)
@@ -302,7 +314,7 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     #                              [S] axis keeps the buffer pp-sharded like
     #                              its siblings instead of replicated)
     dlay0 = param_grad_zeros(staged_params)
-    drep0 = param_grad_zeros(params)          # head/tied/replicated contributions
+    drep0 = param_grad_zeros(params_rest)     # head/tied/replicated contributions
     dembed0 = jax.tree_util.tree_map(
         lambda a: jnp.zeros((M,) + a.shape, grad_dtype), carry_aval
     )
@@ -421,8 +433,8 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             outbuf,
         )
 
-        def head_loss(p_rep, out):
-            final, h_aux = head_apply_aux(p_rep, out, key_last)
+        def head_loss(p_rest, out):
+            final, h_aux = head_apply_aux(with_layers(p_rest), out, key_last)
             loss, user_out = mb_loss_fn(final, m_last, key_last)
             # Head-resident MoE aux joins the differentiated loss with the
             # same weight as the layer-stack aux (parity with pp=1).
@@ -432,7 +444,7 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             return loss, user_out
 
         loss_m, head_vjp, user_out = jax.vjp(
-            head_loss, params, out_last, has_aux=True
+            head_loss, params_rest, out_last, has_aux=True
         )
         seed = jnp.asarray(loss_seed_scale, jnp.float32) * jnp.where(
             b_active[S - 1], 1.0, 0.0
@@ -536,10 +548,10 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     def embed_bwd(acc, xs):
         mb_input, key, dcarry, dside_row = xs
 
-        def embed_inexact(p):
+        def embed_inexact(p_rest):
             args, kwargs = mb_input
             out, aux = apply_collecting_aux(
-                module, {"params": cast_half(p)}, *args,
+                module, {"params": cast_half(with_layers(p_rest))}, *args,
                 rngs=_mk_rngs(model, key, "embed"),
                 method=spec.embed_method, **kwargs,
             )
@@ -548,7 +560,7 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             # a final output so its balancing gradient is seeded below.
             return [leaves[i] for i in idx] + [aux]
 
-        out_aval = jax.eval_shape(embed_inexact, params)
+        out_aval = jax.eval_shape(embed_inexact, params_rest)
         # Cotangent list: hidden cotangent (+ side cotangents for tuples),
         # then the aux seed.
         if sides is not None:
@@ -557,7 +569,7 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             cots = jax.tree_util.tree_leaves(dcarry)
         cots = cots + [aux_seed]
         cots = [c.astype(a.dtype) for c, a in zip(cots, out_aval)]
-        _, vjp = jax.vjp(embed_inexact, params)
+        _, vjp = jax.vjp(embed_inexact, params_rest)
         (dp,) = vjp(cots)
         acc = jax.tree_util.tree_map(
             lambda a, g: a + g.astype(a.dtype), acc, dp
@@ -565,7 +577,7 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
         return acc, None
 
     if spec.embed_method is not None:
-        demb_params0 = param_grad_zeros(params)
+        demb_params0 = param_grad_zeros(params_rest)
         dside_stack = tuple(dsides) if dsides is not None else ()
         demb_params, _ = jax.lax.scan(
             embed_bwd, demb_params0,
@@ -592,20 +604,15 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             return jnp.zeros((L,) + g.shape[2:], g.dtype).at[flat_idx].add(gf)
 
         layer_grads = jax.tree_util.tree_map(to_layers, dlay)
-    grads = _set_subtree(drep, spec.layer_path, layer_grads)
     if demb_params is not None:
-        # Embedding contributions exclude the layer subtree (zeros there).
-        demb_wo_layers = _set_subtree(
-            demb_params, spec.layer_path,
-            jax.tree_util.tree_map(jnp.zeros_like, layer_grads),
+        # Embedding contributions (a rest-tree like drep; the layer
+        # subtree never appears in either).
+        drep = jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(a.dtype), drep, demb_params
         )
-        grads = jax.tree_util.tree_map(
-            lambda a, b: a + b.astype(a.dtype), grads, demb_wo_layers
-        )
-    elif spec.embed_method is None:
-        # Module IS the layer stack: the model input's cotangent is dembed;
-        # no embed params. Nothing further to add.
-        pass
+    # Install the stage-accumulated layer grads into the rest-tree: the
+    # result has the full parameter structure.
+    grads = _set_subtree(drep, spec.layer_path, layer_grads)
     grads = jax.tree_util.tree_map(
         lambda g, p: g.astype(jnp.result_type(p)), grads, params
     )
