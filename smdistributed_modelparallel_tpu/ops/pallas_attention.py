@@ -114,12 +114,44 @@ def _q_bounds(k_lo, k_hi, *, q_len, kv_len, causal, window, block_q, num_q):
 # Forward
 # ----------------------------------------------------------------------
 
+def _ids_mask(rows_loc, cols_loc, rid, cid, *, q_len, kv_len, causal, window):
+    """Mask for index-vector ("ids") mode: padding by LOCAL indices,
+    causal/window by the GLOBAL ids carried in the q_ids/kv_ids inputs —
+    this is what lets a kernel call over one ring-attention block pair
+    apply the global causal relation (including zigzag-reordered rows)."""
+    keep = (rows_loc < q_len) & (cols_loc < kv_len)
+    if causal:
+        keep &= cid <= rid
+        if window is not None:
+            keep &= rid - cid < window
+    elif window is not None:
+        keep &= jnp.abs(rid - cid) < window
+    return keep
+
+
+def _ids_rmax(qid_ref, q_offset, block_q, q_len):
+    """Max global row id among this program's valid q rows (for causal
+    block skipping)."""
+    ids = qid_ref[0, pl.ds(q_offset, block_q)][None, :]
+    loc = q_offset + jax.lax.broadcasted_iota(jnp.int32, (1, block_q), 1)
+    return jnp.max(jnp.where(loc < q_len, ids, -1))
+
+
+def _ids_cmin(kid_ref, k_offset, block_k, kv_len):
+    """Min global col id among valid kv cols of a block (for skipping)."""
+    ids = kid_ref[0, pl.ds(k_offset, block_k)][None, :]
+    loc = k_offset + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    return jnp.min(jnp.where(loc < kv_len, ids, jnp.int32(2**30)))
+
+
 def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
-                window, rate, has_kpm, has_seed, s_total):
+                window, rate, has_kpm, has_seed, s_total, has_ids=False):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     kpm_ref = next(it) if has_kpm else None
     seed_ref = next(it) if has_seed else None
+    qid_ref = next(it) if has_ids else None
+    kid_ref = next(it) if has_ids else None
     o_ref, lse_ref = next(it), next(it)
 
     b = pl.program_id(0)
@@ -127,8 +159,11 @@ def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
     q = q_ref[0].astype(jnp.float32) * scale          # [bq, hd]
     hd = q.shape[-1]
     q_offset = i * block_q
+    if has_ids:
+        q_ids = qid_ref[0, pl.ds(q_offset, block_q)]
+        r_max = _ids_rmax(qid_ref, q_offset, block_q, q_len)
 
-    def body(j, carry):
+    def compute(j, carry):
         acc, m, l = carry
         k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -140,8 +175,14 @@ def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
         cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if kpm_ref is not None:
             s = s + kpm_ref[0, pl.ds(j * block_k, block_k)][None, :]
-        keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
-                          causal=causal, window=window)
+        if has_ids:
+            kv_ids = kid_ref[0, pl.ds(j * block_k, block_k)]
+            keep = _ids_mask(rows, cols, q_ids[:, None], kv_ids[None, :],
+                             q_len=q_len, kv_len=kv_len, causal=causal,
+                             window=window)
+        else:
+            keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
+                              causal=causal, window=window)
         s = jnp.where(keep, s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -157,11 +198,26 @@ def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
         )
         return acc_new, m_new, l_new
 
+    if has_ids and causal:
+        # Data-dependent block skip: the static _kv_bounds cannot see the
+        # global ids, so each kv block is skipped at runtime when its
+        # minimum col id exceeds every row id in this q block.
+        def body(j, carry):
+            visible = _ids_cmin(kid_ref, j * block_k, block_k, kv_len) <= r_max
+            return jax.lax.cond(
+                visible, lambda c: compute(j, c), lambda c: c, carry
+            )
+    else:
+        body = compute
+
     num_kv = k_ref.shape[1] // block_k
-    lo, hi = _kv_bounds(
-        q_offset, q_offset + block_q, q_len=q_len, kv_len=kv_len,
-        causal=causal, window=window, block_k=block_k, num_kv=num_kv,
-    )
+    if has_ids:
+        lo, hi = 0, num_kv
+    else:
+        lo, hi = _kv_bounds(
+            q_offset, q_offset + block_q, q_len=q_len, kv_len=kv_len,
+            causal=causal, window=window, block_k=block_k, num_kv=num_kv,
+        )
     acc0 = jnp.zeros((block_q, hd), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
@@ -180,11 +236,13 @@ def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
 # ----------------------------------------------------------------------
 
 def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
-                   window, rate, has_kpm, has_seed, s_total):
+                   window, rate, has_kpm, has_seed, s_total, has_ids=False):
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (next(it) for _ in range(6))
     kpm_ref = next(it) if has_kpm else None
     seed_ref = next(it) if has_seed else None
+    qid_ref = next(it) if has_ids else None
+    kid_ref = next(it) if has_ids else None
     dq_ref = next(it)
 
     b = pl.program_id(0)
@@ -195,8 +253,11 @@ def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
     delta = delta_ref[0, 0, :][:, None]
     q_offset = i * block_q
     inv_keep = 1.0 / (1.0 - rate) if rate > 0.0 else 1.0
+    if has_ids:
+        q_ids = qid_ref[0, pl.ds(q_offset, block_q)]
+        r_max = _ids_rmax(qid_ref, q_offset, block_q, q_len)
 
-    def body(j, dq_acc):
+    def compute(j, dq_acc):
         k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(
@@ -207,8 +268,14 @@ def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
         cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if kpm_ref is not None:
             s = s + kpm_ref[0, pl.ds(j * block_k, block_k)][None, :]
-        keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
-                          causal=causal, window=window)
+        if has_ids:
+            kv_ids = kid_ref[0, pl.ds(j * block_k, block_k)]
+            keep = _ids_mask(rows, cols, q_ids[:, None], kv_ids[None, :],
+                             q_len=q_len, kv_len=kv_len, causal=causal,
+                             window=window)
+        else:
+            keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
+                              causal=causal, window=window)
         p = jnp.where(keep, jnp.exp(s - lse), 0.0)    # [bq, bk]
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -223,22 +290,36 @@ def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
             preferred_element_type=jnp.float32,
         )
 
+    if has_ids and causal:
+        def body(j, dq_acc):
+            visible = _ids_cmin(kid_ref, j * block_k, block_k, kv_len) <= r_max
+            return jax.lax.cond(
+                visible, lambda c: compute(j, c), lambda c: c, dq_acc
+            )
+    else:
+        body = compute
+
     num_kv = k_ref.shape[1] // block_k
-    lo, hi = _kv_bounds(
-        q_offset, q_offset + block_q, q_len=q_len, kv_len=kv_len,
-        causal=causal, window=window, block_k=block_k, num_kv=num_kv,
-    )
+    if has_ids:
+        lo, hi = 0, num_kv
+    else:
+        lo, hi = _kv_bounds(
+            q_offset, q_offset + block_q, q_len=q_len, kv_len=kv_len,
+            causal=causal, window=window, block_k=block_k, num_kv=num_kv,
+        )
     dq0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     dq = jax.lax.fori_loop(lo, hi, body, dq0)
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
-                    window, rate, has_kpm, has_seed, s_total):
+                    window, rate, has_kpm, has_seed, s_total, has_ids=False):
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (next(it) for _ in range(6))
     kpm_ref = next(it) if has_kpm else None
     seed_ref = next(it) if has_seed else None
+    qid_ref = next(it) if has_ids else None
+    kid_ref = next(it) if has_ids else None
     dk_ref, dv_ref = next(it), next(it)
 
     b = pl.program_id(0)
@@ -251,8 +332,11 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
     kpm_blk = None
     if kpm_ref is not None:
         kpm_blk = kpm_ref[0, pl.ds(k_offset, block_k)][None, :]
+    if has_ids:
+        kv_ids = kid_ref[0, pl.ds(k_offset, block_k)]
+        c_min = _ids_cmin(kid_ref, k_offset, block_k, kv_len)
 
-    def body(i, carry):
+    def compute(i, carry):
         dk_acc, dv_acc = carry
         q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
         do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
@@ -266,8 +350,14 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
         cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if kpm_blk is not None:
             s = s + kpm_blk
-        keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
-                          causal=causal, window=window)
+        if has_ids:
+            q_ids = qid_ref[0, pl.ds(i * block_q, block_q)]
+            keep = _ids_mask(rows, cols, q_ids[:, None], kv_ids[None, :],
+                             q_len=q_len, kv_len=kv_len, causal=causal,
+                             window=window)
+        else:
+            keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
+                              causal=causal, window=window)
         p = jnp.where(keep, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())),
@@ -290,11 +380,23 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
         )
         return dk_acc, dv_acc
 
+    if has_ids and causal:
+        def body(i, carry):
+            visible = c_min <= _ids_rmax(qid_ref, i * block_q, block_q, q_len)
+            return jax.lax.cond(
+                visible, lambda c: compute(i, c), lambda c: c, carry
+            )
+    else:
+        body = compute
+
     num_q = q_ref.shape[1] // block_q
-    lo, hi = _q_bounds(
-        k_offset, k_offset + block_k, q_len=q_len, kv_len=kv_len,
-        causal=causal, window=window, block_q=block_q, num_q=num_q,
-    )
+    if has_ids:
+        lo, hi = 0, num_q
+    else:
+        lo, hi = _q_bounds(
+            k_offset, k_offset + block_k, q_len=q_len, kv_len=kv_len,
+            causal=causal, window=window, block_q=block_q, num_q=num_q,
+        )
     hd = k_blk.shape[-1]
     z = jnp.zeros((block_k, hd), jnp.float32)
     dk, dv = jax.lax.fori_loop(lo, hi, body, (z, z))
@@ -353,20 +455,39 @@ def _common_inputs(kpad_bias, seed, s_pad, B, H, interpret):
     return inputs, specs, has_kpm, has_seed
 
 
+def _ids_extra(q_ids, kv_ids, t_pad, s_pad):
+    """(inputs, specs) for index-vector mode: [1, t_pad]/[1, s_pad] int32
+    global row/col id arrays, broadcast to every program."""
+    qi = jnp.pad(q_ids.astype(jnp.int32), (0, t_pad - q_ids.shape[0]))
+    ki = jnp.pad(kv_ids.astype(jnp.int32), (0, s_pad - kv_ids.shape[0]))
+    return (
+        [qi[None, :], ki[None, :]],
+        [
+            pl.BlockSpec((1, t_pad), lambda b, i: (0, 0)),
+            pl.BlockSpec((1, s_pad), lambda b, i: (0, 0)),
+        ],
+    )
+
+
 def _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
-                    dropout_rate, block_q, block_k, interpret):
+                    dropout_rate, block_q, block_k, interpret,
+                    q_ids=None, kv_ids=None):
     qt, kt, vt, (B, T, S, H, hd, hd_pad, t_pad, s_pad) = _prep(
         q, k, v, block_q, block_k
     )
     extra, extra_specs, has_kpm, has_seed = _common_inputs(
         kpad_bias, seed, s_pad, B, H, interpret
     )
+    has_ids = q_ids is not None
+    if has_ids:
+        id_in, id_specs = _ids_extra(q_ids, kv_ids, t_pad, s_pad)
+        extra, extra_specs = extra + id_in, extra_specs + id_specs
     grid = (B * H, t_pad // block_q)
     kern = functools.partial(
         _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
         q_len=T, kv_len=S, causal=causal, window=window,
         rate=dropout_rate if has_seed else 0.0,
-        has_kpm=has_kpm, has_seed=has_seed, s_total=s_pad,
+        has_kpm=has_kpm, has_seed=has_seed, s_total=s_pad, has_ids=has_ids,
     )
     out, lse = pl.pallas_call(
         kern,
@@ -382,7 +503,12 @@ def _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
             pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, t_pad, hd_pad), q.dtype),
+            # ids mode feeds the ring's fp32 online-softmax merge: per-step
+            # partials must not round-trip through bf16 before accumulating.
+            jax.ShapeDtypeStruct(
+                (B * H, t_pad, hd_pad),
+                jnp.float32 if has_ids else q.dtype,
+            ),
             jax.ShapeDtypeStruct((B * H, 1, t_pad), jnp.float32),
         ],
         interpret=interpret or FORCE_INTERPRET,
@@ -392,7 +518,8 @@ def _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
 
 
 def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
-                    window, dropout_rate, block_q, block_k, interpret):
+                    window, dropout_rate, block_q, block_k, interpret,
+                    q_ids=None, kv_ids=None):
     qt, kt, vt, (B, T, S, H, hd, hd_pad, t_pad, s_pad) = _prep(
         q, k, v, block_q, block_k
     )
@@ -409,11 +536,15 @@ def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
     extra, extra_specs, has_kpm, has_seed = _common_inputs(
         kpad_bias, seed, s_pad, B, H, interpret
     )
+    has_ids = q_ids is not None
+    if has_ids:
+        id_in, id_specs = _ids_extra(q_ids, kv_ids, t_pad, s_pad)
+        extra, extra_specs = extra + id_in, extra_specs + id_specs
     common = dict(
         scale=scale, block_q=block_q, block_k=block_k, q_len=T, kv_len=S,
         causal=causal, window=window,
         rate=dropout_rate if has_seed else 0.0,
-        has_kpm=has_kpm, has_seed=has_seed, s_total=s_pad,
+        has_kpm=has_kpm, has_seed=has_seed, s_total=s_pad, has_ids=has_ids,
     )
     res_spec_q = pl.BlockSpec((1, t_pad, hd_pad), lambda b, i: (b, 0, 0))
     row_spec = pl.BlockSpec((1, 1, t_pad), lambda b, i: (b, 0, 0))
@@ -431,7 +562,9 @@ def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
             *extra_specs,
         ],
         out_specs=pl.BlockSpec((1, block_q, hd_pad), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, t_pad, hd_pad), q.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (B * H, t_pad, hd_pad), jnp.float32 if has_ids else q.dtype
+        ),
         interpret=interpret or FORCE_INTERPRET,
     )(qt, kt, vt, gt, lse, delta, *extra)
 
@@ -452,8 +585,14 @@ def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
             pl.BlockSpec((1, block_k, hd_pad), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, s_pad, hd_pad), k.dtype),
-            jax.ShapeDtypeStruct((B * H, s_pad, hd_pad), v.dtype),
+            # ids mode: fp32 per-step gradients for the ring's rotating
+            # accumulators (see fwd out_shape note).
+            jax.ShapeDtypeStruct(
+                (B * H, s_pad, hd_pad), jnp.float32 if has_ids else k.dtype
+            ),
+            jax.ShapeDtypeStruct(
+                (B * H, s_pad, hd_pad), jnp.float32 if has_ids else v.dtype
+            ),
         ],
         interpret=interpret or FORCE_INTERPRET,
     )(qt, kt, vt, gt, lse, delta, *extra)
@@ -514,3 +653,67 @@ def _fa_bwd(scale, causal, window, dropout_rate, block_q, block_k, interpret,
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ----------------------------------------------------------------------
+# Index-vector ("ids") entry points — building blocks for ring attention
+# ----------------------------------------------------------------------
+#
+# These are NOT custom_vjp surfaces: the ring-attention executor
+# (ops/context_parallel.py) owns the differentiation, calling the forward
+# per KV ring step (merging partials with the online-softmax rule) and the
+# backward per step with the GLOBAL logsumexp — the standard blockwise
+# flash decomposition distributed over the cp ring. q_ids / kv_ids carry
+# the global sequence positions of the local blocks, which is what makes
+# causal masking correct under the zigzag re-layout (non-contiguous rows).
+# Dropout is not supported in ids mode (the ring falls back to the jnp
+# path when attention dropout is active).
+
+
+def _lse_to_rows(lse_raw, B, H, T):
+    """Kernel-layout lse [B*H, 1, t_pad] -> [B, H, T]."""
+    return lse_raw[:, 0, :T].reshape(B, H, T)
+
+
+def _rows_to_lse(lse, t_pad):
+    """[B, H, T] -> kernel layout [B*H, 1, t_pad] (padded with the masked
+    sentinel so padded rows contribute p == 0 in the backward)."""
+    B, H, T = lse.shape
+    out = lse.reshape(B * H, 1, T)
+    if t_pad != T:
+        out = jnp.pad(out, ((0, 0), (0, 0), (0, t_pad - T)),
+                      constant_values=_LSE_MASKED)
+    return out
+
+
+def flash_fwd_with_ids(q, k, v, kpad_bias, q_ids, kv_ids, *, scale, causal,
+                       block_q=256, block_k=256, interpret=False):
+    """One blockwise forward over a (q block, kv block) pair.
+
+    Returns (o [B, T, H, hd] fp32-normalized per-block output,
+    lse [B, H, T] with +_LSE_MASKED sentinel on fully-masked rows).
+    """
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    o, lse = _flash_fwd_impl(
+        q, k, v, kpad_bias, None, scale, causal, None, 0.0,
+        block_q, block_k, interpret, q_ids=q_ids, kv_ids=kv_ids,
+    )
+    B, T, H = q.shape[0], q.shape[1], q.shape[2]
+    return o, _lse_to_rows(lse, B, H, T)
+
+
+def flash_bwd_with_ids(q, k, v, o, g, lse, kpad_bias, q_ids, kv_ids, *,
+                       scale, causal, block_q=256, block_k=256,
+                       interpret=False):
+    """Blockwise backward for one (q block, kv block) pair given the GLOBAL
+    per-row logsumexp ``lse`` [B, H, T] (+_LSE_MASKED sentinel rows) and
+    the GLOBAL output ``o`` / cotangent ``g``. Returns (dq, dk, dv)."""
+    block_q = min(block_q, q.shape[1])
+    block_k = min(block_k, k.shape[1])
+    t_pad = ((q.shape[1] + block_q - 1) // block_q) * block_q
+    lse_raw = _rows_to_lse(lse, t_pad)
+    return _flash_bwd_impl(
+        q, k, v, o, g, lse_raw, kpad_bias, None, scale, causal, None, 0.0,
+        block_q, block_k, interpret, q_ids=q_ids, kv_ids=kv_ids,
+    )

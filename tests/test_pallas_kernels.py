@@ -204,9 +204,14 @@ class TestDispatch:
     dropout, per VERDICT r2 weak item 3) to the Pallas fwd+bwd kernels."""
 
     def _patched(self, monkeypatch):
+        import smdistributed_modelparallel_tpu as smp
         import smdistributed_modelparallel_tpu.ops.attention as att
         import smdistributed_modelparallel_tpu.ops.pallas_attention as pa
 
+        # Dispatch depends on global smp state: a cp>1 mesh left behind by
+        # another test file would route attention_core into the CP branch
+        # instead of the flash kernels under test.
+        smp.shutdown()
         monkeypatch.setattr(att, "_pallas_ok", lambda q, k, v: True)
         monkeypatch.setattr(pa, "FORCE_INTERPRET", True)
         calls = []
