@@ -9,13 +9,22 @@ stack with cross-attention, shared token embeddings, and a tied LM head —
 all built on ``smp.nn.DistributedTransformer``, so tensor/data/context
 parallelism and activation checkpointing apply unchanged.
 
-T5-STYLE, not HF-T5-weight-compatible: learned absolute positions instead
-of relative-position buckets, LayerNorm instead of RMSNorm (HF T5 weight
-translation remains layer-level, the reference's scope). Pipeline
-parallelism needs a single scanned stack and is rejected with the
-standard pipelineable-model error for pp > 1; encoder padding masks apply
-to encoder self-attention (cross-attention currently attends to all
-encoder positions).
+Two architecture dialects:
+
+- default: learned absolute positions + LayerNorm (the original zoo
+  family; not HF-weight-compatible);
+- ``t5_compat=True``: HF-T5-weight-compatible — RMSNorm, bucketed
+  relative-position bias shared by every layer of a stack, no absolute
+  positions, bias-free dense layers, unscaled attention scores, and the
+  tied head's ``d_model**-0.5`` rescale. ``nn/huggingface/t5.py`` builds
+  this dialect from a ``transformers.T5Config`` and translates weights in
+  both directions (beyond the reference's layer-hook-only T5 support).
+
+Pipeline parallelism decomposes as: encoder + embeddings in ``embed()``
+(tp/dp/cp-parallel, replicated over pp stages), the DECODER stack as the
+pipelined layer sequence, final norm + tied head in ``head()``. Encoder
+padding masks apply to both encoder self-attention and (via the carry's
+(self_mask, cross_mask) pair) decoder cross-attention.
 """
 
 from typing import Any, Optional
@@ -26,11 +35,39 @@ import jax.numpy as jnp
 from smdistributed_modelparallel_tpu.nn.layer_norm import DistributedLayerNorm
 from smdistributed_modelparallel_tpu.nn.transformer import (
     DistributedTransformer,
+    DistributedTransformerLayer,
 )
+from smdistributed_modelparallel_tpu.parallel.pipeline import PipelineSpec
+
+NEG = -1e9
 
 
 def _init(stddev):
     return nn.initializers.normal(stddev)
+
+
+def relative_position_bucket(rel_pos, *, bidirectional, num_buckets,
+                             max_distance):
+    """T5's log-spaced relative-position bucketing (public algorithm:
+    Raffel et al. 2020, eq. as implemented in the HF port). ``rel_pos`` is
+    ``memory_position - query_position``."""
+    ret = jnp.zeros_like(rel_pos)
+    n = num_buckets
+    if bidirectional:
+        n = n // 2
+        ret = ret + (rel_pos > 0).astype(jnp.int32) * n
+        rel = jnp.abs(rel_pos)
+    else:
+        rel = -jnp.minimum(rel_pos, 0)
+    max_exact = n // 2
+    is_small = rel < max_exact
+    log_big = max_exact + (
+        jnp.log(jnp.maximum(rel, 1).astype(jnp.float32) / max_exact)
+        / jnp.log(max_distance / max_exact)
+        * (n - max_exact)
+    ).astype(jnp.int32)
+    log_big = jnp.minimum(log_big, n - 1)
+    return ret + jnp.where(is_small, rel, log_big)
 
 
 class EncoderDecoderLM(nn.Module):
@@ -53,12 +90,18 @@ class EncoderDecoderLM(nn.Module):
     # Vocab-parallel shared embedding + tied head (DistributedEmbedding);
     # off by default, matching DistributedTransformerLMHead's default.
     distribute_embedding: bool = False
+    # HF-T5 weight compatibility (see module docstring).
+    t5_compat: bool = False
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layernorm_epsilon: float = 1e-5
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
-    def setup(self):
+    @nn.nowrap
+    def _common(self):
         D, H = self.d_model, self.n_heads
-        common = dict(
+        return dict(
             num_attention_heads=H,
             attention_head_size=self.d_kv or D // H,
             hidden_size=D,
@@ -70,9 +113,26 @@ class EncoderDecoderLM(nn.Module):
             post_layernorm=False,
             initializer_range=self.initializer_range,
             activation_checkpointing=self.activation_checkpointing,
+            layernorm_epsilon=self.layernorm_epsilon,
             deterministic=self.deterministic,
             dtype=self.dtype,
+            **(
+                dict(
+                    layernorm_type="rms",
+                    use_mlp_bias=False,
+                    use_qkv_bias=False,
+                    use_attn_dense_bias=False,
+                    scale_attention_scores=False,
+                    mask_value=NEG,
+                )
+                if self.t5_compat else {}
+            ),
         )
+
+    def setup(self):
+        D, H = self.d_model, self.n_heads
+        common = self._common()
+        rms = self.t5_compat
         if self.distribute_embedding:
             from smdistributed_modelparallel_tpu.nn.embedding import (
                 DistributedEmbedding,
@@ -89,44 +149,155 @@ class EncoderDecoderLM(nn.Module):
                 embedding_init=_init(self.initializer_range),
                 name="shared_embedding",
             )
-        self.enc_position_embedding = nn.Embed(
-            self.max_len, D, embedding_init=_init(self.initializer_range),
-            name="enc_position_embedding",
-        )
-        self.dec_position_embedding = nn.Embed(
-            self.max_len, D, embedding_init=_init(self.initializer_range),
-            name="dec_position_embedding",
-        )
+        if self.t5_compat:
+            # Bucketed relative-position bias tables: ONE per stack, shared
+            # by every layer of that stack (HF keeps the table on block 0).
+            self.enc_rel_bias = nn.Embed(
+                self.relative_attention_num_buckets, H,
+                embedding_init=_init(self.initializer_range),
+                name="enc_rel_bias",
+            )
+            self.dec_rel_bias = nn.Embed(
+                self.relative_attention_num_buckets, H,
+                embedding_init=_init(self.initializer_range),
+                name="dec_rel_bias",
+            )
+        else:
+            self.enc_position_embedding = nn.Embed(
+                self.max_len, D, embedding_init=_init(self.initializer_range),
+                name="enc_position_embedding",
+            )
+            self.dec_position_embedding = nn.Embed(
+                self.max_len, D, embedding_init=_init(self.initializer_range),
+                name="dec_position_embedding",
+            )
         self.encoder = DistributedTransformer(
             num_layers=self.enc_layers,
             causal_mask_size=None,          # bidirectional
             name="encoder", **common,
         )
-        self.encoder_ln = DistributedLayerNorm(name="encoder_ln")
+        self.encoder_ln = DistributedLayerNorm(
+            epsilon=self.layernorm_epsilon, rms=rms, use_bias=not rms,
+            name="encoder_ln",
+        )
         self.decoder = DistributedTransformer(
             num_layers=self.dec_layers,
             causal_mask_size=self.max_len,  # causal
             add_cross_attention=True,
             name="decoder", **common,
         )
-        self.decoder_ln = DistributedLayerNorm(name="decoder_ln")
+        self.decoder_ln = DistributedLayerNorm(
+            epsilon=self.layernorm_epsilon, rms=rms, use_bias=not rms,
+            name="decoder_ln",
+        )
 
-    def __call__(self, encoder_ids, decoder_ids, encoder_mask=None):
-        if encoder_mask is not None and encoder_mask.ndim == 2:
-            # Natural [B, S] padding mask -> the attention contract's
-            # [B, 1, 1, S] (a raw 2-D mask would broadcast WRONG against
-            # [B, H, T, S] scores on the jnp fallback path).
+    # -- mask / bias assembly ------------------------------------------
+
+    @nn.nowrap
+    def _pad4d(self, encoder_mask):
+        """[B, S] or [B, 1, 1, S] padding mask -> additive [B, 1, 1, S].
+
+        Boolean AND integer masks are keep-flags (HF passes int64 0/1
+        attention masks — treating those as additive would silently not
+        mask anything); floats are already additive biases."""
+        if encoder_mask is None:
+            return None
+        if encoder_mask.ndim == 2:
             encoder_mask = encoder_mask[:, None, None, :]
-        pos_e = jnp.arange(encoder_ids.shape[-1])[None, :]
-        h_e = self.shared_embedding(encoder_ids) + self.enc_position_embedding(pos_e)
-        h_e = self.encoder(h_e, attention_mask=encoder_mask)
+        if not jnp.issubdtype(encoder_mask.dtype, jnp.floating):
+            return jnp.where(encoder_mask != 0, 0.0, NEG).astype(jnp.float32)
+        return encoder_mask.astype(jnp.float32)
+
+    def _rel_bias(self, table, T, S, bidirectional):
+        """[1, H, T, S] additive bias from a bucket-embedding table."""
+        ctx = jnp.arange(T)[:, None]
+        mem = jnp.arange(S)[None, :]
+        buckets = relative_position_bucket(
+            mem - ctx, bidirectional=bidirectional,
+            num_buckets=self.relative_attention_num_buckets,
+            max_distance=self.relative_attention_max_distance,
+        )
+        bias = table(buckets)                   # [T, S, H]
+        return bias.transpose(2, 0, 1)[None].astype(jnp.float32)
+
+    # -- pipeline decomposition ----------------------------------------
+
+    def embed(self, encoder_ids, decoder_ids, encoder_mask=None):
+        """Everything before the decoder layer stack: embeddings, the FULL
+        encoder (tp/dp/cp-parallel; replicated across pp stages), and the
+        decoder carry (hidden, cross_states, (self_mask, cross_mask))."""
+        pad = self._pad4d(encoder_mask)
+        if self.t5_compat:
+            S = encoder_ids.shape[-1]
+            enc_mask = self._rel_bias(self.enc_rel_bias, S, S, True)
+            if pad is not None:
+                enc_mask = enc_mask + pad
+            h_e = self.shared_embedding(encoder_ids)
+        else:
+            enc_mask = pad
+            pos_e = jnp.arange(encoder_ids.shape[-1])[None, :]
+            h_e = (
+                self.shared_embedding(encoder_ids)
+                + self.enc_position_embedding(pos_e)
+            )
+        h_e = self.encoder(h_e, attention_mask=enc_mask)
         h_e = self.encoder_ln(h_e)
 
-        pos_d = jnp.arange(decoder_ids.shape[-1])[None, :]
-        h_d = self.shared_embedding(decoder_ids) + self.dec_position_embedding(pos_d)
-        h_d = self.decoder(h_d, cross_states=h_e)
+        if self.t5_compat:
+            T = decoder_ids.shape[-1]
+            dec_mask = self._rel_bias(self.dec_rel_bias, T, T, False)
+            h_d = self.shared_embedding(decoder_ids)
+        else:
+            dec_mask = None
+            pos_d = jnp.arange(decoder_ids.shape[-1])[None, :]
+            h_d = (
+                self.shared_embedding(decoder_ids)
+                + self.dec_position_embedding(pos_d)
+            )
+        # The decoder's mask slot carries (self_mask, cross_mask): the
+        # relative bias on self-attention and the encoder padding on
+        # cross-attention (see DistributedTransformerLayer).
+        if dec_mask is not None or pad is not None:
+            masks = (dec_mask, pad)
+        else:
+            masks = None
+        return (h_d, h_e, masks)
+
+    def head(self, carry):
+        h_d = carry[0] if isinstance(carry, tuple) else carry
         h_d = self.decoder_ln(h_d)
+        if self.t5_compat:
+            # Tied-head rescale (HF T5 with tie_word_embeddings).
+            h_d = h_d * jnp.asarray(
+                self.d_model ** -0.5, h_d.dtype
+            )
         return self.shared_embedding.attend(h_d)
+
+    def __call__(self, encoder_ids, decoder_ids, encoder_mask=None):
+        h_d, h_e, masks = self.embed(encoder_ids, decoder_ids, encoder_mask)
+        h_d = self.decoder(h_d, cross_states=h_e, attention_mask=masks)
+        return self.head(h_d)
+
+    @nn.nowrap
+    def pipeline_spec(self):
+        layer_kw = dict(self._common())
+        # Transformer-level knob; the per-layer remat is applied by the
+        # executors via carry_remat (partition_for_pipeline harvests it).
+        layer_kw.pop("activation_checkpointing", None)
+        return PipelineSpec(
+            layer_path="decoder/seq_layers/layer",
+            num_layers=self.dec_layers,
+            layer_module=DistributedTransformerLayer(
+                causal_mask_size=self.max_len,
+                add_cross_attention=True,
+                **layer_kw,
+            ),
+            carry_remat=self.activation_checkpointing,
+            layer_xs={
+                "layer_idx": jnp.arange(self.dec_layers, dtype=jnp.int32)
+            },
+            carry_is_tuple=True,
+        )
 
 
 _CONFIGS = {

@@ -30,21 +30,39 @@ class HFFamily:
     translate_from_hf: Optional[Callable]  # hf sd -> flat smp dict
     translate_to_hf: Optional[Callable]    # flat smp dict -> hf sd
     # Distributed module the family maps onto: "lmhead" (full model ->
-    # DistributedTransformerLMHead) or "transformer" (encoder stack ->
-    # DistributedTransformer; the reference's scope for ViT).
+    # DistributedTransformerLMHead), "transformer" (encoder stack ->
+    # DistributedTransformer; the reference's scope for ViT), or "encdec"
+    # (T5 -> models.encoder_decoder.EncoderDecoderLM).
     target: str = "lmhead"
+
+
+def _target_class(target):
+    from smdistributed_modelparallel_tpu.nn.transformer import (
+        DistributedTransformer,
+        DistributedTransformerLMHead,
+    )
+
+    if target == "transformer":
+        return DistributedTransformer
+    if target == "encdec":
+        from smdistributed_modelparallel_tpu.models.encoder_decoder import (
+            EncoderDecoderLM,
+        )
+
+        return EncoderDecoderLM
+    return DistributedTransformerLMHead
 
 
 def _families():
     from smdistributed_modelparallel_tpu.nn.huggingface import (
-        bert, gpt2, gptj, gptneo, gptneox, roberta, vit,
+        bert, gpt2, gptj, gptneo, gptneox, roberta, t5, vit,
     )
 
     fams = {}
     for name, mod in (
         ("gpt2", gpt2), ("gptj", gptj), ("gptneo", gptneo),
         ("gptneox", gptneox), ("bert", bert), ("roberta", roberta),
-        ("vit", vit),
+        ("vit", vit), ("t5", t5),
     ):
         fams[name] = HFFamily(
             name=name,
@@ -139,20 +157,11 @@ def translate_model(model_or_config, **overrides):
     translated state dict when a model (with weights) was given, or None
     for a bare config.
     """
-    from smdistributed_modelparallel_tpu.nn.transformer import (
-        DistributedTransformer,
-        DistributedTransformerLMHead,
-    )
-
     fam = family_for(model_or_config)
     config = getattr(model_or_config, "config", model_or_config)
     kwargs = fam.config_to_smp(config)
     kwargs.update(overrides)
-    target_cls = (
-        DistributedTransformer if fam.target == "transformer"
-        else DistributedTransformerLMHead
-    )
-    module = target_cls(**kwargs)
+    module = _target_class(fam.target)(**kwargs)
     flat = None
     if hasattr(model_or_config, "state_dict"):
         sd = model_or_config.state_dict()
@@ -178,16 +187,8 @@ def register_predefined_hooks(registry):
         logger.debug("transformers unavailable; HF hooks not registered.")
         return
 
-    from smdistributed_modelparallel_tpu.nn.transformer import (
-        DistributedTransformer,
-        DistributedTransformerLMHead,
-    )
-
     for fam in families().values():
-        target_cls = (
-            DistributedTransformer if fam.target == "transformer"
-            else DistributedTransformerLMHead
-        )
+        target_cls = _target_class(fam.target)
         for arch in fam.architectures:
             hf_cls = getattr(transformers, arch, None)
             if hf_cls is None:
@@ -208,9 +209,10 @@ def register_predefined_hooks(registry):
                 init_hook=_init_hook,
             )
 
-    # T5: layer-level only (T5Block -> DistributedTransformerLayer), the
-    # reference's scope; the relative-attention-bias block is declined by
-    # the hook returning None.
+    # T5 layer-level hook (reference-parity surface, kept alongside the
+    # full-model family above): T5Block -> DistributedTransformerLayer;
+    # the relative-attention-bias block is declined by the hook returning
+    # None, as in the reference.
     t5_block = getattr(
         getattr(getattr(transformers, "models", None), "t5", None),
         "modeling_t5", None,

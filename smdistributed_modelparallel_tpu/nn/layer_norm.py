@@ -37,6 +37,9 @@ class DistributedLayerNorm(nn.Module):
     use_scale: bool = True
     use_bias: bool = True
     sharded: bool = False
+    # RMSNorm (T5-style): no mean subtraction, normalize by the root mean
+    # square only. Callers typically pair this with use_bias=False.
+    rms: bool = False
     dtype: Optional[jnp.dtype] = None
 
     @nn.compact
@@ -46,9 +49,13 @@ class DistributedLayerNorm(nn.Module):
         # Moments in fp32 regardless of activation dtype (parity: reference
         # kernels accumulate in fp32).
         xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
-        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
+        if self.rms:
+            var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+            y = xf * jax.lax.rsqrt(var + self.epsilon)
+        else:
+            mean = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+            y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
         names = (TP_AXIS,) if self.sharded else (None,)
         if self.use_scale:
             scale = self.param(

@@ -300,6 +300,7 @@ class DistributedTransformerOutputLayer(nn.Module):
     activation: str = "gelu"
     initializer_range: float = 0.02
     fused_bias_gelu: bool = False
+    use_mlp_bias: bool = True
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -313,22 +314,28 @@ class DistributedTransformerOutputLayer(nn.Module):
         fc_kernel = self.param(
             "fc/kernel", partitioned(init, (None, TP_AXIS)), (D, F), dtype
         )
-        fc_bias = self.param(
-            "fc/bias", partitioned(nn.initializers.zeros, (TP_AXIS,)), (F,), dtype
-        )
         h = hidden @ fc_kernel.astype(hidden.dtype)
         h = shard_activation(h, BATCH_AXES, CP_AXIS, TP_AXIS)
-        # Bias+gelu fused by XLA into the matmul epilogue (parity:
-        # fused_bias_gelu, torch/nn/gelu.py).
-        h = _activation(self.activation)(h + fc_bias.astype(h.dtype))
+        if self.use_mlp_bias:
+            fc_bias = self.param(
+                "fc/bias", partitioned(nn.initializers.zeros, (TP_AXIS,)),
+                (F,), dtype,
+            )
+            # Bias+gelu fused by XLA into the matmul epilogue (parity:
+            # fused_bias_gelu, torch/nn/gelu.py).
+            h = h + fc_bias.astype(h.dtype)
+        h = _activation(self.activation)(h)
 
         proj_kernel = self.param(
             "proj/kernel", partitioned(init, (TP_AXIS, None)), (F, D), dtype
         )
-        proj_bias = self.param("proj/bias", nn.initializers.zeros, (D,), dtype)
         out = h @ proj_kernel.astype(h.dtype)
         out = shard_activation(out, *_hidden_spec(memory_opt))
-        out = out + proj_bias.astype(out.dtype)
+        if self.use_mlp_bias:
+            proj_bias = self.param(
+                "proj/bias", nn.initializers.zeros, (D,), dtype
+            )
+            out = out + proj_bias.astype(out.dtype)
         if self.hidden_dropout_prob > 0.0 and not resolve_deterministic(self.deterministic):
             out = nn.Dropout(self.hidden_dropout_prob, deterministic=False)(out)
         return out
@@ -371,6 +378,10 @@ class DistributedTransformerLayer(nn.Module):
     window_size: Optional[int] = None
     parallel_attn_output: bool = False
     causal_mask_size: Optional[int] = None
+    # T5-compat knobs (TPU extension beyond the reference's layer-level T5
+    # hooks): RMS layernorms and bias-free MLP dense layers.
+    layernorm_type: str = "layer"
+    use_mlp_bias: bool = True
     # MoE (TPU extension; reference has no MoE — SURVEY §2.6): when
     # num_experts > 0 the MLP block is a DistributedMoE routed over the
     # ep mesh axis instead of a dense DistributedTransformerOutputLayer.
@@ -382,8 +393,17 @@ class DistributedTransformerLayer(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, cross_states=None, attention_mask=None, xs=None):
+        # attention_mask may be a (self_mask, cross_mask) pair: the stack's
+        # carry protocol has one mask slot, and T5-style models need both a
+        # per-head relative-position bias on self-attention and an encoder
+        # key-padding mask on cross-attention.
+        cross_attention_mask = None
+        if isinstance(attention_mask, tuple):
+            attention_mask, cross_attention_mask = attention_mask
+        rms = self.layernorm_type == "rms"
         ln = lambda name: DistributedLayerNorm(
-            epsilon=self.layernorm_epsilon, name=name
+            epsilon=self.layernorm_epsilon, rms=rms, use_bias=not rms,
+            name=name,
         )
         attn = DistributedAttentionLayer(
             num_attention_heads=self.num_attention_heads,
@@ -432,6 +452,7 @@ class DistributedTransformerLayer(nn.Module):
                 activation=self.activation,
                 initializer_range=self.initializer_range,
                 fused_bias_gelu=self.fused_bias_gelu,
+                use_mlp_bias=self.use_mlp_bias,
                 deterministic=self.deterministic,
                 dtype=self.dtype,
                 name="output",
@@ -482,7 +503,10 @@ class DistributedTransformerLayer(nn.Module):
                 name="crossattention",
             )
             h = ln("crossattention/layernorm")(x) if self.pre_layernorm else x
-            c = cross(h, cross_states=cross_states)
+            c = cross(
+                h, cross_states=cross_states,
+                attention_mask=cross_attention_mask,
+            )
             x = (x.astype(res_dtype) + c.astype(res_dtype)).astype(hidden.dtype)
             if self.post_layernorm:
                 x = ln("crossattention/post_layernorm")(x)
@@ -555,6 +579,8 @@ class DistributedTransformer(nn.Module):
     window_size: Optional[int] = None
     parallel_attn_output: bool = False
     causal_mask_size: Optional[int] = None
+    layernorm_type: str = "layer"
+    use_mlp_bias: bool = True
     attention_layers_type: Optional[tuple] = None
     activation_checkpointing: bool = False
     num_experts: int = 0
@@ -594,6 +620,8 @@ class DistributedTransformer(nn.Module):
             window_size=self.window_size,
             parallel_attn_output=self.parallel_attn_output,
             causal_mask_size=self.causal_mask_size,
+            layernorm_type=self.layernorm_type,
+            use_mlp_bias=self.use_mlp_bias,
             num_experts=self.num_experts,
             moe_top_k=self.moe_top_k,
             moe_capacity_factor=self.moe_capacity_factor,

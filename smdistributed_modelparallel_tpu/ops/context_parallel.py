@@ -216,7 +216,10 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
     Forward: per ring step, one flash forward over the (local q block,
     rotating kv block) pair with GLOBAL ids driving the causal mask (so
     the zigzag row re-ordering is exact); partials merge with the online
-    log-space softmax rule. Backward: the flash backward decomposition
+    log-space softmax rule. The per-step wrappers re-derive the kernel
+    layouts of the loop-invariant operands (q; and o/g/delta/lse in the
+    backward) — XLA's while-loop invariant code motion hoists those out
+    of the compiled fori_loop, so they cost one pass, not n_blocks. Backward: the flash backward decomposition
     distributed over the ring — dq accumulates locally from the global
     logsumexp/delta, while dk/dv accumulators ROTATE WITH k/v so each
     block's gradient arrives home after the full cycle. Residuals are the

@@ -1,5 +1,6 @@
-"""Encoder-decoder (T5-style) model tests: forward shape, tp training,
-pp rejection (single-stack pipeline restriction)."""
+"""Encoder-decoder (T5-style) model tests: forward shape, tp/pp training
+parity, the HF-weight-compatible t5_compat dialect, and cross-attention
+encoder-padding masks."""
 
 import numpy as np
 import pytest
@@ -10,12 +11,12 @@ import optax
 
 import smdistributed_modelparallel_tpu as smp
 from smdistributed_modelparallel_tpu.models.encoder_decoder import t5_style
-from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
 
 
-def _tiny(**kw):
+
+def _tiny(dec_layers=2, **kw):
     return t5_style(
-        vocab_size=64, max_len=16, d_model=16, enc_layers=2, dec_layers=2,
+        vocab_size=64, max_len=16, d_model=16, enc_layers=2, dec_layers=dec_layers,
         n_heads=2, d_ff=32, deterministic=True, **kw,
     )
 
@@ -45,8 +46,7 @@ def test_forward_shapes_and_causality():
 def test_padding_mask_2d_normalized():
     """A natural [B, S] encoder padding mask works on the jnp path
     (normalized to [B, 1, 1, S]); masked tokens stop influencing the
-    UNMASKED positions' encodings. (Cross-attention itself is unmasked —
-    a documented limitation — so the check runs the encoder alone.)"""
+    UNMASKED positions' encodings."""
     smp.reset()
     smp.init({"microbatches": 1})
     module = _tiny()
@@ -111,18 +111,84 @@ def test_trains_under_tp():
     assert losses[-1] < losses[0]
 
 
-def test_pp_rejected_with_clear_error():
+def test_cross_attention_masked_by_encoder_padding():
+    """Mutating a MASKED encoder token changes NOTHING in the decoder
+    logits: the padding mask applies to encoder self-attention AND (via the
+    carry's (self_mask, cross_mask) pair) to decoder cross-attention."""
     smp.reset()
-    smp.init({"pipeline_parallel_degree": 2, "microbatches": 2})
-    model = smp.DistributedModel(_tiny())
+    smp.init({"microbatches": 1})
+    for t5_compat in (False, True):
+        module = _tiny(t5_compat=t5_compat)
+        rng = np.random.RandomState(3)
+        enc = jnp.asarray(rng.randint(1, 64, (2, 12)))
+        dec = jnp.asarray(rng.randint(1, 64, (2, 8)))
+        params = module.init(jax.random.key(0), enc, dec)["params"]
+        mask = jnp.ones((2, 12), bool).at[:, -3:].set(False)
+        la = module.apply({"params": params}, enc, dec, encoder_mask=mask)
+        enc2 = enc.at[:, -1].set((enc[:, -1] + 5) % 64)
+        lb = module.apply({"params": params}, enc2, dec, encoder_mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(la), np.asarray(lb), atol=1e-5,
+            err_msg=f"t5_compat={t5_compat}",
+        )
 
-    @smp.step
-    def train_step(model, enc, dec):
-        loss = jnp.mean(model(enc, dec))
-        model.backward(loss)
-        return loss
 
-    enc = jnp.zeros((2, 12), jnp.int32)
-    dec = jnp.zeros((2, 8), jnp.int32)
-    with pytest.raises(PartitionError, match="pipelineable"):
-        train_step(model, enc, dec)
+def test_t5_compat_forward_and_causality():
+    """The HF-weight-compatible dialect: RMS norms, relative-position
+    bias, no absolute positions — forward shape, decoder causality, and
+    live cross-attention."""
+    smp.reset()
+    smp.init({"microbatches": 1})
+    module = _tiny(t5_compat=True)
+    enc = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 12)))
+    dec = jnp.asarray(np.random.RandomState(1).randint(0, 64, (2, 8)))
+    params = module.init(jax.random.key(0), enc, dec)["params"]
+    assert "enc_rel_bias" in params and "dec_rel_bias" in params
+    assert "enc_position_embedding" not in params
+    logits = module.apply({"params": params}, enc, dec)
+    assert logits.shape == (2, 8, 64)
+    dec2 = dec.at[:, -1].set((dec[:, -1] + 1) % 64)
+    logits2 = module.apply({"params": params}, enc, dec2)
+    np.testing.assert_allclose(
+        np.asarray(logits[:, :-1]), np.asarray(logits2[:, :-1]), atol=1e-5
+    )
+    enc2 = enc.at[:, 0].set((enc[:, 0] + 1) % 64)
+    logits3 = module.apply({"params": params}, enc2, dec)
+    assert not np.allclose(np.asarray(logits), np.asarray(logits3))
+
+
+@pytest.mark.slow
+def test_trains_under_pp_matching_single_stage():
+    """Enc-dec pipeline decomposition (encoder in embed, decoder stack
+    pipelined): pp2 losses match the single-stage run exactly."""
+
+    def train(cfg):
+        smp.reset()
+        smp.init(cfg)
+        model = smp.DistributedModel(_tiny(dec_layers=4, t5_compat=True))
+        opt = smp.DistributedOptimizer(optax.sgd(0.1), model)
+
+        @smp.step
+        def train_step(model, enc, dec):
+            logits = model(enc, dec)
+            lg = logits[:, :-1]
+            tgt = jnp.take_along_axis(lg, dec[:, 1:, None], axis=-1)[..., 0]
+            lse = jax.scipy.special.logsumexp(lg.astype(jnp.float32), axis=-1)
+            loss = jnp.mean(lse - tgt.astype(jnp.float32))
+            model.backward(loss)
+            return loss
+
+        rng = np.random.RandomState(0)
+        enc = jnp.asarray(rng.randint(0, 64, (4, 12)))
+        dec = jnp.asarray(rng.randint(0, 64, (4, 8)))
+        losses = []
+        for _ in range(3):
+            out = train_step(model, enc, dec)
+            opt.step()
+            losses.append(float(out.reduce_mean()))
+        return losses
+
+    base = train({"microbatches": 2})
+    pp = train({"pipeline_parallel_degree": 2, "ddp": True,
+                "microbatches": 2})
+    np.testing.assert_allclose(base, pp, rtol=1e-4, atol=1e-5)
