@@ -378,10 +378,25 @@ SCHEMA = {
         "is installed only when optimizer.step() is called; disabled "
         "automatically under fp16 loss scaling. Memory note: because the "
         "step may legally run without a following optimizer.step(), the "
-        "fused program cannot donate params/opt_state, so peak memory holds "
-        "one extra params+opt_state copy vs the donated standalone update; "
-        "set False to restore the donated memory profile on tight-HBM "
-        "configs.",
+        "fused program cannot donate params/opt_state by default, so peak "
+        "memory holds one extra params+opt_state copy vs the donated "
+        "standalone update; enable fused_step_donation (steady-state "
+        "training) or set False to restore the donated memory profile on "
+        "tight-HBM configs.",
+    },
+    "fused_step_donation": {
+        "type": bool,
+        "default": False,
+        "requires": {"fused_optimizer_step": True},
+        "dependencies": ["fused_optimizer_step"],
+        "description": "TPU extension: donate the params and optimizer-state "
+        "buffers through the fused step program, removing the extra "
+        "params+opt_state copy from peak HBM. The update is installed at "
+        "step return (the input buffers are gone), so every training step "
+        "behaves as if followed by optimizer.step() — calling step() is "
+        "still fine and becomes a no-op confirmation. Do not enable if the "
+        "training loop reads PRE-update parameters after a step or "
+        "intentionally skips optimizer.step().",
     },
     "_device_count_override": {
         "type": (int, type(None)),

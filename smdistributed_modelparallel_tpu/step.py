@@ -278,12 +278,25 @@ class StepFunction:
                 else:
                     model._grads = grads
             if fused:
-                # Tokens of the exact inputs the fused update consumed:
-                # optimizer.step() installs the precomputed result only if
-                # neither grads, params, nor opt_state were replaced since.
-                model._pending_update = (
-                    grads, fused_out[0], fused_out[1], in_params, opt_state
-                )
+                if getattr(cfg, "fused_step_donation", False):
+                    # Donated inputs are gone: install the update NOW and
+                    # leave a self-consistent pending tuple so a following
+                    # optimizer.step() no-ops instead of re-applying.
+                    model.params = fused_out[0]
+                    opt._opt_state = fused_out[1]
+                    model._pending_update = (
+                        grads, fused_out[0], fused_out[1],
+                        fused_out[0], fused_out[1],
+                    )
+                else:
+                    # Tokens of the exact inputs the fused update consumed:
+                    # optimizer.step() installs the precomputed result only
+                    # if neither grads, params, nor opt_state were replaced
+                    # since.
+                    model._pending_update = (
+                        grads, fused_out[0], fused_out[1], in_params,
+                        opt_state,
+                    )
         return grads, outputs
 
     @staticmethod
@@ -581,6 +594,11 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
             state.optimizer._opt_state,
         )
 
+    donate = (
+        fused_update is not None
+        and bool(getattr(state.cfg, "fused_step_donation", False))
+    )
+
     def full_impl(params, opt_state, raw_scan, bcast_vals, rng, loss_scale):
         use_rng, next_rng = jax.random.split(rng)
         scan_leaves = [
@@ -614,7 +632,10 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
             fused_out = ()
         return grads, outs, finite, next_rng, fused_out
 
-    jitted = jax.jit(full_impl, donate_argnums=())
+    # fused_step_donation: params/opt_state buffers alias into
+    # new_params/new_opt (same shapes + pinned shardings), dropping the
+    # extra copy from peak HBM; the runner installs the update eagerly.
+    jitted = jax.jit(full_impl, donate_argnums=(0, 1) if donate else ())
     mesh = state.mesh
     holder = {}
 
