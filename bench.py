@@ -159,8 +159,12 @@ def main():
     _readback(l)
 
     # ---- framework setup ----
+    # fused_step_donation: the plain-JAX baseline donates params/opt_state
+    # through its step (donate_argnums above); the framework plays by the
+    # same rules — one launch, donated buffers.
     smp.reset()
-    smp.init({"microbatches": num_mb, "bf16": bool(on_tpu)})
+    smp.init({"microbatches": num_mb, "bf16": bool(on_tpu),
+              "fused_step_donation": True})
     model = smp.DistributedModel(gpt2_124m(max_len=seq_len, **model_kwargs))
     optimizer = smp.DistributedOptimizer(optax.adamw(1e-4), model)
 

@@ -32,7 +32,14 @@ jax.config.update("jax_default_matmul_precision", "highest")
 # ("+prefer-no-gather is not supported on the host machine ... could lead
 # to execution errors such as SIGILL") and the reloaded executable can
 # hard-abort the process mid-test — observed on the pp2xtp2 checkpoint
-# round-trip. Correctness over speed: the fast tier pays its compiles.
+# round-trip. Re-attempted in round 4 with a pinned ISA
+# (XLA_FLAGS=--xla_cpu_max_isa=AVX2): still SIGABRTs, even on a COLD run
+# (the step engine's AOT lower + jit-fallback pair re-loads a
+# just-written entry within one process). The deserialization itself is
+# broken for this jaxlib on this host; do not re-enable by default.
+# Correctness over speed: the fast tier (-m "not slow", ~280 tests,
+# ~13 min single-core) is the CI tier; the full suite (incl. the 55
+# slow e2e/pipeline tests, ~35 min) is the nightly tier.
 if os.environ.get("SMP_TEST_COMPILE_CACHE", "0") == "1":
     _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
@@ -86,7 +93,7 @@ _SLOW_TESTS = (
     "test_moe.py::TestExpertParallel::test_transformer_layer_moe_trains",
     "test_delayed_init.py::test_delayed_init_matches_eager_init_numerically",
     "test_huggingface.py::TestRoundTrip::test_vit_encoder_trains_under_smp_step",
-    "test_multiprocess.py::test_two_process_control_plane",
+    "test_multiprocess.py::test_two_process_control_plane_and_checkpoint",
 )
 
 

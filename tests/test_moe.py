@@ -234,7 +234,9 @@ class TestAuxLossPlumbing:
     """VERDICT r3 weak #2: the router load-balancing loss must reach the
     differentiated loss through the STANDARD paths (DistributedModel call,
     fill-drain and 1F1B pipeline executors), weighted by the
-    moe_aux_loss_weight config key."""
+    moe_aux_loss_weight config key. (The balance tests double as the
+    router-gradient probe: weight 0 and weight 20 runs share the init and
+    diverge only through the aux term.)"""
 
     def _one_step_grads(self, cfg_extra, weight, ids):
         smp.reset()
@@ -247,14 +249,6 @@ class TestAuxLossPlumbing:
         train_step = _lm_loss_step()
         train_step(model, ids)
         return jax.device_get(model.grads)
-
-    def test_aux_weight_reaches_router_grads(self):
-        ids = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
-        g0 = self._one_step_grads({}, 0.0, ids)
-        g1 = self._one_step_grads({}, 50.0, ids)
-        lay0 = g0["transformer"]["seq_layers"]["layer"]["output"]
-        lay1 = g1["transformer"]["seq_layers"]["layer"]["output"]
-        assert not np.allclose(lay0["router/kernel"], lay1["router/kernel"])
 
     def test_balance_improves_with_aux_under_dp(self):
         ids = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
