@@ -235,8 +235,12 @@ def _process_index():
 
 
 _SAVE_SEQ = 0
-# Commit rendezvous wait bound; small in tests via env override.
-_COMMIT_TIMEOUT_S = float(os.environ.get("SMP_CKPT_COMMIT_TIMEOUT", "600"))
+
+
+def _commit_timeout():
+    """Commit rendezvous wait bound; read per call so tests (and operators
+    mid-run) can override the env after the module imported."""
+    return float(os.environ.get("SMP_CKPT_COMMIT_TIMEOUT", "600"))
 
 
 def _write_atomic(path, text):
@@ -269,7 +273,8 @@ def _commit_checkpoint(path, ckpt_dir, tag, num_kept, seq):
             logger.info("Wrote partial checkpoint shards for '%s' (p%d).",
                         tag, me)
             return
-        deadline = time.monotonic() + _COMMIT_TIMEOUT_S
+        timeout = _commit_timeout()
+        deadline = time.monotonic() + timeout
         for p in range(1, world):
             marker = os.path.join(ckpt_dir, f".done_p{p}")
             while True:
@@ -282,8 +287,7 @@ def _commit_checkpoint(path, ckpt_dir, tag, num_kept, seq):
                 if time.monotonic() > deadline:
                     raise SMPRuntimeError(
                         f"checkpoint commit timed out waiting for process "
-                        f"{p}'s shards under {ckpt_dir} "
-                        f"(> {_COMMIT_TIMEOUT_S}s)."
+                        f"{p}'s shards under {ckpt_dir} (> {timeout}s)."
                     )
                 time.sleep(0.05)
     _finish_checkpoint(path, tag, True, num_kept)

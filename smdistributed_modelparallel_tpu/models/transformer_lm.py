@@ -195,16 +195,44 @@ class TransformerLM(nn.Module):
             x = x + self.wpe(jnp.arange(ids.shape[-1])[None, :])
         return x
 
-    def head(self, x):
+    def head(self, x, targets=None):
         x = self.ln_f(x)
-        if self.tie_weights:
-            return self.wte.attend(x)
-        return self.lm_head(x)
+        if targets is not None and self.tie_weights:
+            # Fused LM-head CE (TPU extension): per-token losses without
+            # the [.., V] logits intermediate (nn/cross_entropy.py).
+            from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+                fused_lm_head_cross_entropy,
+            )
 
-    def __call__(self, ids):
+            return fused_lm_head_cross_entropy(
+                x, self.wte.embedding, targets
+            )
+        logits = self.wte.attend(x) if self.tie_weights else self.lm_head(x)
+        if targets is None:
+            return logits
+        from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+            masked_vocab_parallel_cross_entropy,
+        )
+
+        return masked_vocab_parallel_cross_entropy(logits, targets)
+
+    def __call__(self, ids, targets=None):
+        """ids -> logits; with ``targets`` ([B, T] int, -100 = ignored) ->
+        per-token fp32 losses instead, via the fused LM-head CE (the
+        logits tensor never materializes on the TPU tied-head path).
+        Loss mode requires pp == 1 (the pipeline head protocol carries no
+        targets)."""
+        if targets is not None:
+            from smdistributed_modelparallel_tpu.backend.state import state
+
+            if state.cfg is not None and state.cfg.pipeline_parallel_degree > 1:
+                raise ValueError(
+                    "model(ids, targets=...) is not available under "
+                    "pipeline parallelism; compute the loss from logits."
+                )
         x = self.embed(ids)
         x, _ = self.layers(x, None)
-        return self.head(x)
+        return self.head(x, targets)
 
     @nn.nowrap
     def pipeline_spec(self):

@@ -21,6 +21,7 @@ from smdistributed_modelparallel_tpu.nn.layer_norm import (
 )
 from smdistributed_modelparallel_tpu.nn.cross_entropy import (
     DistributedCrossEntropy,
+    fused_lm_head_cross_entropy,
     vocab_parallel_cross_entropy,
 )
 from smdistributed_modelparallel_tpu.nn.softmax import (

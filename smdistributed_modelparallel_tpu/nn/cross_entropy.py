@@ -46,6 +46,56 @@ def vocab_parallel_cross_entropy(logits, targets, label_smoothing=0.0):
     return loss
 
 
+def masked_vocab_parallel_cross_entropy(logits, targets, ignore_index=-100):
+    """``vocab_parallel_cross_entropy`` with HF-convention ignored labels:
+    ``ignore_index`` positions contribute 0 loss and no gradient."""
+    valid = targets != ignore_index
+    per = vocab_parallel_cross_entropy(
+        logits, jnp.where(valid, targets, 0)
+    )
+    return jnp.where(valid, per, 0.0)
+
+
+def fused_lm_head_cross_entropy(hidden, embedding_table, targets,
+                                ignore_index=-100, block_n=256,
+                                block_v=1024):
+    """Tied-LM-head cross-entropy WITHOUT materializing logits.
+
+    TPU extension (no reference counterpart): computes per-token
+    ``CE(hidden @ table^T, targets)`` through the blockwise Pallas kernels
+    (``ops/pallas_ce.py``) — the [.., V] logits tensor, the single largest
+    HBM intermediate of LM training at 124M-scale, never exists. Falls
+    back to the materialized-logits ``vocab_parallel_cross_entropy`` path
+    off-TPU or under tensor parallelism (where the vocab axis is sharded
+    and the Megatron allreduce path is the right tool).
+
+    Args:
+      hidden: [..., D] final hidden states (post final-layernorm).
+      embedding_table: [V, D] tied embedding table.
+      targets: [...] int ids; ``ignore_index`` entries contribute 0 loss
+        and no gradient.
+    Returns: fp32 per-token losses shaped like ``targets``.
+    """
+    from smdistributed_modelparallel_tpu.backend.state import state
+    from smdistributed_modelparallel_tpu.ops import pallas_ce as pc
+
+    lead = hidden.shape[:-1]
+    D = hidden.shape[-1]
+    x = hidden.reshape(-1, D)
+    t = targets.reshape(-1)
+    valid = t != ignore_index
+    t_safe = jnp.where(valid, t, 0)
+    tp = state.mesh.shape.get(TP_AXIS, 1) if state.initialized else 1
+    if tp == 1 and pc.fused_ce_ok(x, embedding_table):
+        per = pc.fused_lm_head_ce(x, embedding_table, t_safe,
+                                  block_n, block_v)
+    else:
+        logits = x @ embedding_table.T.astype(x.dtype)
+        per = vocab_parallel_cross_entropy(logits, t_safe)
+    per = jnp.where(valid, per, 0.0)
+    return per.reshape(lead)
+
+
 class DistributedCrossEntropy(nn.Module):
     """Module wrapper matching the reference class surface
     (``torch/nn/cross_entropy.py:28``); reduction over all tokens."""

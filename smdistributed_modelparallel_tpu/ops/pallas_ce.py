@@ -1,0 +1,280 @@
+"""Fused LM-head cross-entropy Pallas kernels.
+
+TPU extension targeting the known single-chip MFU gap: with a tied LM
+head, ``loss = CE(hidden @ emb^T, targets)`` materializes an [N, V]
+logits tensor in HBM (GPT-2-124M at B*T=8k tokens: ~800 MB bf16, plus
+fp32 casts) that is written once and read twice per step — XLA cannot
+eliminate an explicit intermediate. These kernels tile BOTH the row and
+the vocab dimension into the Pallas grid (vocab is the inner, sequential
+grid axis, so per-row online-softmax state accumulates in revisited
+output blocks that stay VMEM-resident) and never materialize logits:
+
+- forward: per (row-block, vocab-block) grid step, one
+  ``x_blk @ W_blk^T`` MXU matmul feeding an online max/sum-exp and a
+  one-hot-free target-logit pick; outputs per-row (running max, sum-exp,
+  target logit), finalized to lse on the host side.
+- backward: the standard softmax-minus-one-hot cotangent, recomputed
+  blockwise from the saved per-row lse and contracted immediately into
+  dx (rows outer, vocab inner) and dW (vocab outer, rows inner) — +1
+  recompute matmul pass in exchange for eliminating all [N, V] HBM
+  traffic, the same trade the flash attention kernels make.
+
+VMEM per grid step is O(block_n*D + block_v*D + block_n*block_v), NOT
+O(V*D) — the full embedding table is never staged (GPT-2's table alone
+is ~5x VMEM).
+
+No reference counterpart (SURVEY §2.1 N8 covers fused softmax only);
+this is a new-capability op. Layout: x [N, D], W [V, D] (embedding-table
+layout; the tied head computes x @ W^T), targets int32 [N].
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+# Testing hook, mirroring pallas_attention.FORCE_INTERPRET.
+FORCE_INTERPRET = False
+
+
+def _fwd_kernel(x_ref, w_ref, t_ref, m_ref, l_ref, tgt_ref, *, block_v,
+                v_total):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
+        l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
+        tgt_ref[...] = jnp.zeros(tgt_ref.shape, jnp.float32)
+
+    x = x_ref[...].astype(jnp.float32)                  # [bn, D]
+    w = w_ref[...].astype(jnp.float32)                  # [bv, D]
+    tids = t_ref[...].reshape(-1, 1)                    # [bn, 1]
+    logits = jax.lax.dot_general(
+        x, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                   # [bn, bv]
+    cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    logits = jnp.where(cols < v_total, logits, NEG_INF)
+
+    m_prev = m_ref[...].reshape(-1, 1)
+    l_prev = l_ref[...].reshape(-1, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(
+        jnp.exp(logits - m_new), axis=-1, keepdims=True
+    )
+    # Target pick: at most one column of this block matches each row's
+    # target id; a masked row-sum extracts it without a gather.
+    hit = cols == tids
+    tgt_add = jnp.sum(jnp.where(hit, logits, 0.0), axis=-1)
+    m_ref[...] = m_new.reshape(m_ref.shape)
+    l_ref[...] = l_new.reshape(l_ref.shape)
+    tgt_ref[...] = tgt_ref[...] + tgt_add.reshape(tgt_ref.shape)
+
+
+def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, *, block_v,
+                   v_total):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dx_ref[...] = jnp.zeros(dx_ref.shape, dx_ref.dtype)
+
+    x = x_ref[...].astype(jnp.float32)                  # [bn, D]
+    w = w_ref[...].astype(jnp.float32)                  # [bv, D]
+    tids = t_ref[...].reshape(-1, 1)
+    lse = lse_ref[...].reshape(-1, 1)
+    g = g_ref[...].reshape(-1, 1)
+    logits = jax.lax.dot_general(
+        x, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    p = jnp.where(cols < v_total, jnp.exp(logits - lse), 0.0)
+    dlog = (p - (cols == tids).astype(jnp.float32)) * g
+    dx_ref[...] = dx_ref[...] + jax.lax.dot_general(
+        dlog, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dx_ref.dtype)
+
+
+def _bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dw_ref, *, block_n,
+                   block_v, n_total, v_total):
+    j = pl.program_id(0)                                # vocab block (outer)
+    i = pl.program_id(1)                                # row block (inner)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros(dw_ref.shape, dw_ref.dtype)
+
+    x = x_ref[...].astype(jnp.float32)                  # [bn, D]
+    w = w_ref[...].astype(jnp.float32)                  # [bv, D]
+    tids = t_ref[...].reshape(-1, 1)
+    lse = lse_ref[...].reshape(-1, 1)
+    g = g_ref[...].reshape(-1, 1)
+    rows = i * block_n + jax.lax.broadcasted_iota(
+        jnp.int32, (x.shape[0], 1), 0
+    )
+    cols = j * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_v), 1
+    )
+    logits = jax.lax.dot_general(
+        x, w, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                   # [bn, bv]
+    p = jnp.exp(logits - lse)
+    dlog = (p - (cols == tids).astype(jnp.float32)) * g
+    # Padded rows carry g=0 already (their loss cotangent is zero), but
+    # guard anyway: their lse is a filler value.
+    dlog = jnp.where(rows < n_total, dlog, 0.0)
+    dw_ref[...] = dw_ref[...] + jax.lax.dot_general(
+        dlog, x, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dw_ref.dtype)
+
+
+def _pad_to(x, n, axis, value=0):
+    if x.shape[axis] == n:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, n - x.shape[axis])
+    return jnp.pad(x, pads, constant_values=value)
+
+
+def _blocks(N, V, block_n, block_v):
+    block_n = min(block_n, max(8, N))
+    block_v = min(block_v, V)
+    n_pad = -(-N // block_n) * block_n
+    v_pad = -(-V // block_v) * block_v
+    return block_n, block_v, n_pad, v_pad
+
+
+def _fused_ce_fwd_impl(x, w, targets, block_n, block_v, interpret):
+    N, D = x.shape
+    V = w.shape[0]
+    block_n, block_v, n_pad, v_pad = _blocks(N, V, block_n, block_v)
+    xp = _pad_to(x, n_pad, 0)
+    wp = _pad_to(w, v_pad, 0)
+    tp = _pad_to(targets.astype(jnp.int32), n_pad, 0)[None, :]
+    kern = functools.partial(_fwd_kernel, block_v=block_v, v_total=V)
+    row = pl.BlockSpec((1, block_n), lambda i, j: (0, i))
+    m, l, tgt = pl.pallas_call(
+        kern,
+        grid=(n_pad // block_n, v_pad // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_v, D), lambda i, j: (j, 0)),
+            row,
+        ],
+        out_specs=[row, row, row],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        ],
+        interpret=interpret or FORCE_INTERPRET,
+    )(xp, wp, tp)
+    lse = m[0, :N] + jnp.log(jnp.maximum(l[0, :N], 1e-30))
+    return lse, tgt[0, :N]
+
+
+def _fused_ce_bwd_impl(x, w, targets, lse, g, block_n, block_v, interpret):
+    N, D = x.shape
+    V = w.shape[0]
+    block_n, block_v, n_pad, v_pad = _blocks(N, V, block_n, block_v)
+    xp = _pad_to(x, n_pad, 0)
+    wp = _pad_to(w, v_pad, 0)
+    tp = _pad_to(targets.astype(jnp.int32), n_pad, 0)[None, :]
+    # Padded rows: lse filler keeps exp() finite; g = 0 kills their grads.
+    lsep = _pad_to(lse.astype(jnp.float32), n_pad, 0, value=1.0)[None, :]
+    gp = _pad_to(g.astype(jnp.float32), n_pad, 0)[None, :]
+    interp = interpret or FORCE_INTERPRET
+    row_i = pl.BlockSpec((1, block_n), lambda i, j: (0, i))
+
+    dx = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, block_v=block_v, v_total=V),
+        grid=(n_pad // block_n, v_pad // block_v),
+        in_specs=[
+            pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_v, D), lambda i, j: (j, 0)),
+            row_i, row_i, row_i,
+        ],
+        out_specs=pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
+        # fp32 accumulator: the block is revisited across the vocab sweep;
+        # accumulating ~V/block_v partial sums in bf16 would round.
+        out_shape=jax.ShapeDtypeStruct((n_pad, D), jnp.float32),
+        interpret=interp,
+    )(xp, wp, tp, lsep, gp)
+
+    # dW grid: vocab outer, rows inner — the dW block is revisited across
+    # the inner row sweep.
+    row_j = pl.BlockSpec((1, block_n), lambda j, i: (0, i))
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, block_n=block_n, block_v=block_v,
+                          n_total=N, v_total=V),
+        grid=(v_pad // block_v, n_pad // block_n),
+        in_specs=[
+            pl.BlockSpec((block_n, D), lambda j, i: (i, 0)),
+            pl.BlockSpec((block_v, D), lambda j, i: (j, 0)),
+            row_j, row_j, row_j,
+        ],
+        out_specs=pl.BlockSpec((block_v, D), lambda j, i: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((v_pad, D), jnp.float32),
+        interpret=interp,
+    )(xp, wp, tp, lsep, gp)
+    return dx[:N].astype(x.dtype), dw[:V].astype(w.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def fused_lm_head_ce(x, w, targets, block_n=256, block_v=1024,
+                     interpret=False):
+    """Per-token CE of ``x @ w^T`` against ``targets`` without
+    materializing logits. x: [N, D]; w: [V, D]; targets: [N] int.
+    Returns fp32 [N] losses. Differentiable in x and w.
+    """
+    lse, tgt = _fused_ce_fwd_impl(x, w, targets, block_n, block_v, interpret)
+    return lse - tgt
+
+
+def _fce_fwd(x, w, targets, block_n, block_v, interpret):
+    lse, tgt = _fused_ce_fwd_impl(x, w, targets, block_n, block_v, interpret)
+    return lse - tgt, (x, w, targets, lse)
+
+
+def _fce_bwd(block_n, block_v, interpret, res, g):
+    x, w, targets, lse = res
+    dx, dw = _fused_ce_bwd_impl(
+        x, w, targets, lse, g, block_n, block_v, interpret
+    )
+    return dx, dw, None
+
+
+fused_lm_head_ce.defvjp(_fce_fwd, _fce_bwd)
+
+
+def fused_ce_ok(x, w, block_n=256, block_v=1024):
+    """Dispatch precondition: TPU backend (or interpret-mode testing) and
+    per-grid-step working set well inside VMEM; the caller guards vocab
+    sharding."""
+    if jax.default_backend() != "tpu" and not FORCE_INTERPRET:
+        return False
+    D = x.shape[-1]
+    # fp32 in-kernel copies: x_blk + w_blk + logits + dx/dw accumulator.
+    step_bytes = 4 * (block_n * D + block_v * D + block_n * block_v
+                      + max(block_n, block_v) * D)
+    return step_bytes <= 12 * 2**20
+
+
+def reference_lm_head_ce(x, w, targets):
+    """jnp reference: same math through materialized logits (the fallback
+    path and the parity oracle)."""
+    logits = (x.astype(jnp.float32) @ w.astype(jnp.float32).T)
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    lse = jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)) + m[:, 0]
+    tgt = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
+    return lse - tgt
