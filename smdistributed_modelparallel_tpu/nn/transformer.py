@@ -869,23 +869,51 @@ class DistributedTransformerLMHead(nn.Module):
         x = shard_activation(x, *_hidden_spec(memory_opt))
         return (x, None, attention_mask)
 
-    def head(self, carry):
+    def head(self, carry, targets=None):
         x, _, _ = carry if isinstance(carry, tuple) else (carry, None, None)
         if self.final_layernorm or self.pre_layernorm:
             x = self.ln_f(x)
         if not self.add_lm_head:
             return x
+        if targets is not None and self.tie_input_output_embedding:
+            # Fused LM-head CE (TPU extension): per-token losses without
+            # the [.., V] logits intermediate. The dispatcher falls back
+            # to the Megatron vocab-parallel path under tp / off-TPU.
+            from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+                fused_lm_head_cross_entropy,
+            )
+
+            return fused_lm_head_cross_entropy(
+                x, self.word_embedding.embedding, targets
+            )
         if self.tie_input_output_embedding:
             logits = self.word_embedding.attend(x)
         else:
             logits = self.lm_head(x)
-        return logits
+        if targets is None:
+            return logits
+        from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+            masked_vocab_parallel_cross_entropy,
+        )
 
-    def __call__(self, input_ids, token_type_ids=None, attention_mask=None):
+        return masked_vocab_parallel_cross_entropy(logits, targets)
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 targets=None):
+        """ids -> logits; with ``targets`` ([B, T] int, -100 = ignored) ->
+        per-token fp32 losses via the fused LM-head CE. Loss mode
+        requires pp == 1 (the pipeline head protocol carries no
+        targets)."""
+        if targets is not None:
+            if state.cfg is not None and state.cfg.pipeline_parallel_degree > 1:
+                raise SMPValidationError(
+                    "model(ids, targets=...) is not available under "
+                    "pipeline parallelism; compute the loss from logits."
+                )
         carry = self.embed(input_ids, token_type_ids, attention_mask)
         x, cross, amask = carry
         x = self.transformer(x, attention_mask=amask)
-        return self.head((x, cross, amask))
+        return self.head((x, cross, amask), targets=targets)
 
     @nn.nowrap
     def pipeline_spec(self):

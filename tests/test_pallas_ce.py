@@ -173,6 +173,70 @@ class TestModelLossMode:
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0]
 
+    def test_lmhead_loss_mode_matches_logits_path(self, interpret_kernels):
+        """DistributedTransformerLMHead (the from_hf target class) loss
+        mode: fused path (tie, tp=1, interpret) == CE from logits."""
+        smp.reset()
+        smp.init({"microbatches": 1})
+        m = smp.nn.DistributedTransformerLMHead(
+            num_layers=2, num_attention_heads=2, attention_head_size=8,
+            hidden_size=16, intermediate_size=32, vocab_size=64,
+            num_positions=16, causal_mask_size=16, pre_layernorm=True,
+            post_layernorm=False, final_layernorm=True,
+            attention_dropout_prob=0.0, hidden_dropout_prob=0.0,
+            embedding_dropout_prob=0.0, deterministic=True,
+        )
+        ids = jax.random.randint(jax.random.key(0), (2, 12), 0, 64)
+        params = m.init(jax.random.key(1), ids)["params"]
+        tgt = jnp.concatenate(
+            [ids[:, 1:], jnp.full_like(ids[:, :1], -100)], axis=1
+        )
+        per = m.apply({"params": params}, ids, targets=tgt)
+        logits = m.apply({"params": params}, ids)
+        lg = logits[:, :-1].astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(lg, axis=-1)
+        tl = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
+        np.testing.assert_allclose(
+            np.asarray(per[:, :-1]), np.asarray(lse - tl),
+            atol=2e-4, rtol=1e-4,
+        )
+
+    def test_lmhead_loss_mode_under_tp_vocab_sharded(self):
+        """With distribute_embedding the vocab axis is tp-sharded: the
+        dispatcher must take the Megatron fallback and still train."""
+        smp.reset()
+        smp.init({"tensor_parallel_degree": 2, "ddp": True,
+                  "microbatches": 2})
+        model = smp.DistributedModel(smp.nn.DistributedTransformerLMHead(
+            num_layers=2, num_attention_heads=2, attention_head_size=8,
+            hidden_size=16, intermediate_size=32, vocab_size=64,
+            num_positions=16, causal_mask_size=16, pre_layernorm=True,
+            post_layernorm=False, final_layernorm=True,
+            attention_dropout_prob=0.0, hidden_dropout_prob=0.0,
+            embedding_dropout_prob=0.0, deterministic=True,
+            distribute_embedding=True,
+        ))
+        opt = smp.DistributedOptimizer(optax.adam(1e-2), model)
+
+        @smp.step
+        def train_step(model, ids):
+            tgt = jnp.concatenate(
+                [ids[:, 1:], jnp.full_like(ids[:, :1], -100)], axis=1
+            )
+            per = model(ids, targets=tgt)
+            loss = jnp.sum(per) / (per.shape[0] * (per.shape[1] - 1))
+            model.backward(loss)
+            return loss
+
+        ids = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
+        losses = []
+        for _ in range(3):
+            out = train_step(model, ids)
+            opt.step()
+            losses.append(float(out.reduce_mean()))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
     def test_loss_mode_rejected_under_pp(self):
         from smdistributed_modelparallel_tpu.models.transformer_lm import (
             TransformerLM,
