@@ -92,6 +92,10 @@ class EncoderDecoderLM(nn.Module):
     distribute_embedding: bool = False
     # HF-T5 weight compatibility (see module docstring).
     t5_compat: bool = False
+    # T5 v1.1 (flan-T5) dialect: gated MLP (wi_0/wi_1) and an untied
+    # lm_head; classic v1.0 is non-gated with tied embeddings.
+    gated_mlp: bool = False
+    tie_embeddings: bool = True
     relative_attention_num_buckets: int = 32
     relative_attention_max_distance: int = 128
     layernorm_epsilon: float = 1e-5
@@ -116,6 +120,7 @@ class EncoderDecoderLM(nn.Module):
             layernorm_epsilon=self.layernorm_epsilon,
             deterministic=self.deterministic,
             dtype=self.dtype,
+            gated_mlp=self.gated_mlp,
             **(
                 dict(
                     layernorm_type="rms",
@@ -190,6 +195,11 @@ class EncoderDecoderLM(nn.Module):
             epsilon=self.layernorm_epsilon, rms=rms, use_bias=not rms,
             name="decoder_ln",
         )
+        if not self.tie_embeddings:
+            self.lm_head = nn.Dense(
+                self.vocab_size, use_bias=False,
+                kernel_init=_init(self.initializer_range), name="lm_head",
+            )
 
     # -- mask / bias assembly ------------------------------------------
 
@@ -266,6 +276,10 @@ class EncoderDecoderLM(nn.Module):
     def head(self, carry):
         h_d = carry[0] if isinstance(carry, tuple) else carry
         h_d = self.decoder_ln(h_d)
+        if not self.tie_embeddings:
+            # Untied head (T5 v1.1): no rescale (HF rescales only when
+            # tie_word_embeddings).
+            return self.lm_head(h_d)
         if self.t5_compat:
             # Tied-head rescale (HF T5 with tie_word_embeddings).
             h_d = h_d * jnp.asarray(

@@ -12,8 +12,9 @@ tied-head rescale) with bidirectional state-dict translation — so
 ``smp.from_hf(t5_model)`` fine-tunes from HF weights and exports back
 (BASELINE config #5's T5-3B path).
 
-Scope: the classic T5 dialect (non-gated FFN — t5-small/base/large/3B/11B)
-with tied embeddings; gated v1.1 variants are rejected with a clear error.
+Scope: both T5 dialects — classic v1.0 (non-gated relu FFN, tied
+embeddings: t5-small/base/large/3B/11B) and v1.1/flan-T5 (gated-gelu
+wi_0/wi_1 FFN, untied lm_head).
 """
 
 import numpy as np
@@ -28,24 +29,13 @@ ENC = "encoder/seq_layers/layer"
 DEC = "decoder/seq_layers/layer"
 
 
-def _check_classic_t5(config):
-    if getattr(config, "is_gated_act", False):
-        raise SMPValidationError(
-            "Gated-activation T5 variants (v1.1 'gated-gelu') are not "
-            "supported; use a classic (relu, non-gated) T5 checkpoint."
-        )
-    if not getattr(config, "tie_word_embeddings", True):
-        raise SMPValidationError(
-            "Untied-lm-head T5 variants are not supported; classic T5 ties "
-            "lm_head to the shared embedding."
-        )
-
-
 def config_to_smp(config):
-    """HF T5Config -> EncoderDecoderLM (t5_compat) kwargs."""
-    _check_classic_t5(config)
+    """HF T5Config -> EncoderDecoderLM (t5_compat) kwargs. Handles both
+    the classic v1.0 dialect and gated/untied v1.1 (flan-T5)."""
     act = getattr(config, "dense_act_fn", "relu")
     return {
+        "gated_mlp": bool(getattr(config, "is_gated_act", False)),
+        "tie_embeddings": bool(getattr(config, "tie_word_embeddings", True)),
         "vocab_size": config.vocab_size,
         "d_model": config.d_model,
         "enc_layers": config.num_layers,
@@ -85,9 +75,21 @@ def _self_attn(lay, sd, p, H, hd):
     lay["attention/dense/kernel"] = ow.T.reshape(H, hd, ow.shape[0])
 
 
-def _mlp(lay, sd, p, li):
+def _mlp(lay, sd, p, li, gated):
     lay["output/layernorm/scale"] = sd[f"{p}.layer.{li}.layer_norm.weight"]
-    lay["output/fc/kernel"] = sd[f"{p}.layer.{li}.DenseReluDense.wi.weight"].T
+    if gated:
+        # v1.1: wi_0 is the ACTIVATED branch (our "gate"), wi_1 the linear
+        # multiplier (our "fc"): out = act(gate(x)) * fc(x) @ proj.
+        lay["output/gate/kernel"] = (
+            sd[f"{p}.layer.{li}.DenseReluDense.wi_0.weight"].T
+        )
+        lay["output/fc/kernel"] = (
+            sd[f"{p}.layer.{li}.DenseReluDense.wi_1.weight"].T
+        )
+    else:
+        lay["output/fc/kernel"] = (
+            sd[f"{p}.layer.{li}.DenseReluDense.wi.weight"].T
+        )
     lay["output/proj/kernel"] = sd[f"{p}.layer.{li}.DenseReluDense.wo.weight"].T
 
 
@@ -95,11 +97,6 @@ def translate_hf_state_dict(sd, config=None):
     """HF T5 torch state dict -> flat '/'-keyed smp param dict."""
     if config is None:
         raise SMPValidationError("config required for T5 translation.")
-    _check_classic_t5(config)
-    if any(".DenseReluDense.wi_0." in k for k in sd):
-        raise SMPValidationError(
-            "Gated-FFN T5 state dict (wi_0/wi_1) is not supported."
-        )
     if "decoder.block.0.layer.0.SelfAttention.q.weight" not in sd:
         # family_for's model_type fallback can route any t5-typed model
         # here (e.g. T5EncoderModel) — fail with a clear error instead of
@@ -110,6 +107,8 @@ def translate_hf_state_dict(sd, config=None):
         )
     sd = {k: c.to_np(v) for k, v in sd.items()}
     H, hd = config.num_heads, config.d_kv
+    gated = bool(getattr(config, "is_gated_act", False))
+    tied = bool(getattr(config, "tie_word_embeddings", True))
 
     out = {
         "shared_embedding/embedding": sd["shared.weight"],
@@ -130,7 +129,7 @@ def translate_hf_state_dict(sd, config=None):
         p = f"encoder.block.{i}"
         lay = {}
         _self_attn(lay, sd, p, H, hd)
-        _mlp(lay, sd, p, 1)
+        _mlp(lay, sd, p, 1, gated)
         enc_layers.append(lay)
     for k, v in c.stack_layers(enc_layers).items():
         out[f"{ENC}/{k}"] = v
@@ -157,10 +156,12 @@ def translate_hf_state_dict(sd, config=None):
         )
         ow = sd[f"{p}.layer.1.EncDecAttention.o.weight"]
         lay["crossattention/dense/kernel"] = ow.T.reshape(H, hd, D)
-        _mlp(lay, sd, p, 2)
+        _mlp(lay, sd, p, 2, gated)
         dec_layers.append(lay)
     for k, v in c.stack_layers(dec_layers).items():
         out[f"{DEC}/{k}"] = v
+    if not tied:
+        out["lm_head/kernel"] = sd["lm_head.weight"].T
     return out
 
 
@@ -172,12 +173,16 @@ def translate_state_dict_to_hf(flat, config=None):
     D = enc_qkv.shape[1]
     inner = enc_qkv.shape[3] * enc_qkv.shape[4]
 
+    gated = f"{ENC}/output/gate/kernel" in flat
+    tied = "lm_head/kernel" not in flat
     shared = np.asarray(flat["shared_embedding/embedding"])
     out = {
         "shared.weight": shared,
         "encoder.embed_tokens.weight": shared,
         "decoder.embed_tokens.weight": shared,
-        "lm_head.weight": shared,
+        "lm_head.weight": (
+            shared if tied else np.asarray(flat["lm_head/kernel"]).T
+        ),
         "encoder.block.0.layer.0.SelfAttention"
         ".relative_attention_bias.weight":
             np.asarray(flat["enc_rel_bias/embedding"]),
@@ -204,7 +209,17 @@ def translate_state_dict_to_hf(flat, config=None):
 
     def put_mlp(p, stack_prefix, i, li):
         g = lambda key: np.asarray(flat[f"{stack_prefix}/{key}"][i])
-        out[f"{p}.layer.{li}.DenseReluDense.wi.weight"] = g("output/fc/kernel").T
+        if gated:
+            out[f"{p}.layer.{li}.DenseReluDense.wi_0.weight"] = (
+                g("output/gate/kernel").T
+            )
+            out[f"{p}.layer.{li}.DenseReluDense.wi_1.weight"] = (
+                g("output/fc/kernel").T
+            )
+        else:
+            out[f"{p}.layer.{li}.DenseReluDense.wi.weight"] = (
+                g("output/fc/kernel").T
+            )
         out[f"{p}.layer.{li}.DenseReluDense.wo.weight"] = g("output/proj/kernel").T
         out[f"{p}.layer.{li}.layer_norm.weight"] = g("output/layernorm/scale")
 

@@ -301,6 +301,9 @@ class DistributedTransformerOutputLayer(nn.Module):
     initializer_range: float = 0.02
     fused_bias_gelu: bool = False
     use_mlp_bias: bool = True
+    # Gated MLP (T5 v1.1 / flan-T5, LLaMA-style): out = act(gate(x)) *
+    # fc(x) @ proj. Both input projections are column-parallel over tp.
+    gated_mlp: bool = False
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -324,7 +327,16 @@ class DistributedTransformerOutputLayer(nn.Module):
             # Bias+gelu fused by XLA into the matmul epilogue (parity:
             # fused_bias_gelu, torch/nn/gelu.py).
             h = h + fc_bias.astype(h.dtype)
-        h = _activation(self.activation)(h)
+        if self.gated_mlp:
+            gate_kernel = self.param(
+                "gate/kernel", partitioned(init, (None, TP_AXIS)), (D, F),
+                dtype,
+            )
+            g = hidden @ gate_kernel.astype(hidden.dtype)
+            g = shard_activation(g, BATCH_AXES, CP_AXIS, TP_AXIS)
+            h = _activation(self.activation)(g) * h
+        else:
+            h = _activation(self.activation)(h)
 
         proj_kernel = self.param(
             "proj/kernel", partitioned(init, (TP_AXIS, None)), (F, D), dtype
@@ -382,6 +394,7 @@ class DistributedTransformerLayer(nn.Module):
     # hooks): RMS layernorms and bias-free MLP dense layers.
     layernorm_type: str = "layer"
     use_mlp_bias: bool = True
+    gated_mlp: bool = False
     # MoE (TPU extension; reference has no MoE — SURVEY §2.6): when
     # num_experts > 0 the MLP block is a DistributedMoE routed over the
     # ep mesh axis instead of a dense DistributedTransformerOutputLayer.
@@ -453,6 +466,7 @@ class DistributedTransformerLayer(nn.Module):
                 initializer_range=self.initializer_range,
                 fused_bias_gelu=self.fused_bias_gelu,
                 use_mlp_bias=self.use_mlp_bias,
+                gated_mlp=self.gated_mlp,
                 deterministic=self.deterministic,
                 dtype=self.dtype,
                 name="output",
@@ -581,6 +595,7 @@ class DistributedTransformer(nn.Module):
     causal_mask_size: Optional[int] = None
     layernorm_type: str = "layer"
     use_mlp_bias: bool = True
+    gated_mlp: bool = False
     attention_layers_type: Optional[tuple] = None
     activation_checkpointing: bool = False
     num_experts: int = 0
@@ -622,6 +637,7 @@ class DistributedTransformer(nn.Module):
             causal_mask_size=self.causal_mask_size,
             layernorm_type=self.layernorm_type,
             use_mlp_bias=self.use_mlp_bias,
+            gated_mlp=self.gated_mlp,
             num_experts=self.num_experts,
             moe_top_k=self.moe_top_k,
             moe_capacity_factor=self.moe_capacity_factor,

@@ -360,12 +360,46 @@ class TestT5FullModel:
         ))
         np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
 
-    def test_gated_v11_rejected(self):
-        cfg = _t5_cfg(feed_forward_proj="gated-gelu")
-        with pytest.raises(Exception, match="[Gg]ated"):
-            smp.reset()
-            smp.init({})
-            smp.from_hf(cfg)
+    def test_v11_gated_untied_parity_and_roundtrip(self):
+        """T5 v1.1 / flan-T5 dialect: gated-gelu wi_0/wi_1 FFN and an
+        untied lm_head — logits parity and exact export round trip."""
+        cfg = _t5_cfg(feed_forward_proj="gated-gelu",
+                      tie_word_embeddings=False)
+        hf = _t5_hf(cfg)
+        rng = np.random.RandomState(2)
+        enc = rng.randint(0, 64, (2, 12))
+        dec = rng.randint(0, 64, (2, 8))
+        with torch.no_grad():
+            ref = hf(
+                input_ids=torch.tensor(enc),
+                decoder_input_ids=torch.tensor(dec),
+            ).logits.numpy()
+        smp.reset()
+        smp.init({})
+        model = smp.from_hf(hf, deterministic=True)
+        ours = np.asarray(model(jnp.asarray(enc), jnp.asarray(dec)))
+        np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+        from smdistributed_modelparallel_tpu.module_manager import path_key
+        from smdistributed_modelparallel_tpu.nn.huggingface import t5 as t5mod
+
+        flat = {
+            path_key(path): np.asarray(jax.device_get(leaf))
+            for path, leaf in
+            jax.tree_util.tree_flatten_with_path(model.params)[0]
+        }
+        sd = t5mod.translate_state_dict_to_hf(flat, config=cfg)
+        fresh = transformers.T5ForConditionalGeneration(cfg).eval()
+        missing, unexpected = fresh.load_state_dict(
+            {k: torch.tensor(v) for k, v in sd.items()}, strict=False
+        )
+        assert not missing and not unexpected, (missing, unexpected)
+        with torch.no_grad():
+            again = fresh(
+                input_ids=torch.tensor(enc),
+                decoder_input_ids=torch.tensor(dec),
+            ).logits.numpy()
+        np.testing.assert_allclose(again, ref, atol=1e-5)
 
     @pytest.mark.slow
     def test_finetune_pp_tp_offload_roundtrip(self):
