@@ -243,11 +243,13 @@ def _dense_init_hook(*args, **fields):
     import flax.linen as fnn
 
     default_kinit = fnn.Dense.__dataclass_fields__["kernel_init"].default
-    if fields.get("kernel_init") not in (None, default_kinit):
-        logger.warning(
-            "nn.Dense kernel_init is replaced by DistributedLinear's "
-            "seed-consistent sharded initializer on distribution."
-        )
+    kinit = fields.get("kernel_init")
+    if kinit not in (None, default_kinit):
+        # Carry the user's initializer into the distributed layer: flax
+        # gives the param the same key either way and the partitioning
+        # wrapper only adds sharding metadata, so values are
+        # seed-consistent with the undistributed module.
+        keep["kernel_init"] = kinit
     return (), keep
 
 

@@ -37,13 +37,19 @@ class DistributedLinear(nn.Module):
     use_bias: bool = True
     dtype: Optional[jnp.dtype] = None
     kernel_init_scale: Optional[float] = None
+    # User-provided initializer (e.g. carried over from a distributed
+    # nn.Dense); seed-consistent — flax hands every tp shard the same key
+    # and the partitioned wrapper slices the result, so the values match
+    # an undistributed init of the same seed.
+    kernel_init: Optional[object] = None
 
     @nn.compact
     def __call__(self, x):
         in_features = x.shape[-1]
+        init = self.kernel_init or dense_init(self.kernel_init_scale)
         kernel = self.param(
             "kernel",
-            partitioned(dense_init(self.kernel_init_scale), (TP_AXIS, None)),
+            partitioned(init, (TP_AXIS, None)),
             (in_features, self.features),
             self.dtype or x.dtype,
         )
@@ -73,13 +79,17 @@ class ColumnParallelLinear(nn.Module):
     use_bias: bool = True
     dtype: Optional[jnp.dtype] = None
     kernel_init_scale: Optional[float] = None
+    kernel_init: Optional[object] = None
 
     @nn.compact
     def __call__(self, x):
         in_features = x.shape[-1]
         kernel = self.param(
             "kernel",
-            partitioned(dense_init(self.kernel_init_scale), (None, TP_AXIS)),
+            partitioned(
+                self.kernel_init or dense_init(self.kernel_init_scale),
+                (None, TP_AXIS),
+            ),
             (in_features, self.features),
             self.dtype or x.dtype,
         )
