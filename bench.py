@@ -162,30 +162,53 @@ def main():
     # fused_step_donation: the plain-JAX baseline donates params/opt_state
     # through its step (donate_argnums above); the framework plays by the
     # same rules — one launch, donated buffers.
-    smp.reset()
-    smp.init({"microbatches": num_mb, "bf16": bool(on_tpu),
-              "fused_step_donation": True})
-    model = smp.DistributedModel(gpt2_124m(max_len=seq_len, **model_kwargs))
-    optimizer = smp.DistributedOptimizer(optax.adamw(1e-4), model)
-
-    @smp.step
-    def train_step(model, batch_ids):
-        # Fused LM-head CE (model(ids, targets=...)): the [N, V] logits
-        # tensor never materializes on TPU — same mean-over-predicted-
-        # positions loss as the baseline's ce_loss.
-        tgt = jnp.concatenate(
-            [batch_ids[:, 1:], jnp.full_like(batch_ids[:, :1], -100)],
-            axis=1,
+    def build_framework(use_loss_mode):
+        smp.reset()
+        smp.init({"microbatches": num_mb, "bf16": bool(on_tpu),
+                  "fused_step_donation": True})
+        model = smp.DistributedModel(
+            gpt2_124m(max_len=seq_len, **model_kwargs)
         )
-        per = model(batch_ids, targets=tgt)
-        loss = jnp.sum(per) / (per.shape[0] * (per.shape[1] - 1))
-        model.backward(loss)
-        return loss
+        optimizer = smp.DistributedOptimizer(optax.adamw(1e-4), model)
 
-    for _ in range(2):
-        out = train_step(model, ids)
-        optimizer.step()
-    _readback(out.reduce_mean())
+        if use_loss_mode:
+            @smp.step
+            def train_step(model, batch_ids):
+                # Fused LM-head CE (model(ids, targets=...)): the [N, V]
+                # logits tensor never materializes on TPU — same
+                # mean-over-predicted-positions loss as the baseline.
+                tgt = jnp.concatenate(
+                    [batch_ids[:, 1:],
+                     jnp.full_like(batch_ids[:, :1], -100)],
+                    axis=1,
+                )
+                per = model(batch_ids, targets=tgt)
+                loss = jnp.sum(per) / (per.shape[0] * (per.shape[1] - 1))
+                model.backward(loss)
+                return loss
+        else:
+            @smp.step
+            def train_step(model, batch_ids):
+                loss = ce_loss(model(batch_ids), batch_ids)
+                model.backward(loss)
+                return loss
+
+        out = None
+        for _ in range(2):
+            out = train_step(model, ids)
+            optimizer.step()
+        _readback(out.reduce_mean())
+        return model, optimizer, train_step, out
+
+    try:
+        model, optimizer, train_step, out = build_framework(True)
+    except Exception as e:  # kernel/backend failure must not kill the bench
+        sys.stderr.write(
+            f"bench: fused-CE loss mode failed ({e!r}); "
+            "falling back to the logits path.\n"
+        )
+        os.environ["SMP_DISABLE_FUSED_CE"] = "1"
+        model, optimizer, train_step, out = build_framework(False)
 
     # ---- interleaved timing (A/B/A/B) ----
     # Chip clock/thermal state drifts over tens of seconds; timing all

@@ -260,7 +260,11 @@ fused_lm_head_ce.defvjp(_fce_fwd, _fce_bwd)
 def fused_ce_ok(x, w, block_n=256, block_v=1024):
     """Dispatch precondition: TPU backend (or interpret-mode testing) and
     per-grid-step working set well inside VMEM; the caller guards vocab
-    sharding."""
+    sharding. SMP_DISABLE_FUSED_CE=1 is the operator escape hatch."""
+    import os
+
+    if os.environ.get("SMP_DISABLE_FUSED_CE", "0") == "1":
+        return False
     if jax.default_backend() != "tpu" and not FORCE_INTERPRET:
         return False
     D = x.shape[-1]
