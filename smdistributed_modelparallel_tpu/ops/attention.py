@@ -157,7 +157,7 @@ def attention_core(
             q, scale, dropout_rate, dropout_rng
         )
         return flash_attention(
-            qq, k, v, kpad, seed, kernel_scale, causal, window, rate
+            qq, k, v, kpad, seed, None, kernel_scale, causal, window, rate
         )
 
     T, S = q.shape[1], k.shape[1]

@@ -210,7 +210,7 @@ def ring_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
 
 @functools.lru_cache(maxsize=32)
 def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
-                   has_kp):
+                   has_kp, dropout_rate=0.0):
     """custom_vjp ring attention built on the blockwise Pallas kernels.
 
     Forward: per ring step, one flash forward over the (local q block,
@@ -243,7 +243,7 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
     def tr(a):  # [B, H, T] weight -> broadcastable over [B, T, H, hd]
         return a.transpose(0, 2, 1)[..., None]
 
-    def fwd_impl(q, k, v, kp):
+    def fwd_impl(q, k, v, kp, seed):
         me = jax.lax.axis_index(axis_name)
         if zigzag:
             q = _zig_enter(q, me, n_blocks, axis_name)
@@ -261,6 +261,9 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
             o_i, lse_i = flash_fwd_with_ids(
                 q, k_cur, v_cur, kp_cur, rows_g, cols_g,
                 scale=scale, causal=causal, interpret=interpret,
+                seed=seed if dropout_rate > 0.0 else None,
+                dropout_rate=dropout_rate,
+                counter_len=Tl * n_blocks,
             )
             lse_i = jnp.where(lse_i > 1e29, NEG_INF, lse_i)
             m_new = jnp.maximum(m_run, lse_i)
@@ -296,10 +299,10 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
         out_nat = (
             _zig_exit(out, me, n_blocks, axis_name) if zigzag else out
         )
-        return out_nat, (q, k, v, kp, out, lse)
+        return out_nat, (q, k, v, kp, seed, out, lse)
 
     def bwd_impl(res, g):
-        q, k, v, kp, o, lse = res     # zigzag layout (as entered)
+        q, k, v, kp, seed, o, lse = res     # zigzag layout (as entered)
         me = jax.lax.axis_index(axis_name)
         if zigzag:
             g = _zig_enter(g, me, n_blocks, axis_name)
@@ -314,6 +317,9 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
             dq_i, dk_i, dv_i = flash_bwd_with_ids(
                 q, k_cur, v_cur, o, g, lse_b, kp_cur, rows_g, cols_g,
                 scale=scale, causal=causal, interpret=interpret,
+                seed=seed if dropout_rate > 0.0 else None,
+                dropout_rate=dropout_rate,
+                counter_len=Tl * n_blocks,
             )
             dq = dq + dq_i.astype(jnp.float32)
             dk = dk + dk_i.astype(jnp.float32)
@@ -340,38 +346,44 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
             dv = _zig_exit(dv, me, n_blocks, axis_name)
         grads = (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
         if has_kp:
-            return grads + (jnp.zeros_like(kp),)
-        return grads
+            grads = grads + (jnp.zeros_like(kp),)
+        return grads + (None,)      # seed (int) carries no cotangent
 
+    # seed is ALWAYS an argument (a dummy 0 when dropout is off — the
+    # static dropout_rate==0.0 keeps the kernels from ever hashing it),
+    # so only kpad's presence forks the arity.
     if has_kp:
         @jax.custom_vjp
-        def ring(q, k, v, kp):
-            return fwd_impl(q, k, v, kp)[0]
+        def ring(q, k, v, kp, seed):
+            return fwd_impl(q, k, v, kp, seed)[0]
 
-        ring.defvjp(lambda q, k, v, kp: fwd_impl(q, k, v, kp), bwd_impl)
+        ring.defvjp(lambda q, k, v, kp, s: fwd_impl(q, k, v, kp, s),
+                    bwd_impl)
     else:
         @jax.custom_vjp
-        def ring(q, k, v):
-            return fwd_impl(q, k, v, None)[0]
+        def ring(q, k, v, seed):
+            return fwd_impl(q, k, v, None, seed)[0]
 
-        ring.defvjp(lambda q, k, v: fwd_impl(q, k, v, None), bwd_impl)
+        ring.defvjp(lambda q, k, v, s: fwd_impl(q, k, v, None, s),
+                    bwd_impl)
     return ring
 
 
 def ring_attention_local_flash(q, k, v, kpad, seed, *, scale, causal,
                                n_blocks, zigzag, interpret,
-                               axis_name=CP_AXIS):
-    """Pallas-kernel ring attention body (dropout-free path; the jnp body
-    handles attention dropout so the counter-hash replay semantics stay
-    byte-identical across impls)."""
-    del seed
+                               dropout_rate=0.0, axis_name=CP_AXIS):
+    """Pallas-kernel ring attention body. Dropout hashes on GLOBAL
+    (bh, row, col) ids with the T_total stride — bit-identical to the jnp
+    ring/Ulysses bodies, so impls stay interchangeable mid-training."""
+    has_seed = seed is not None and dropout_rate > 0.0
     fn = _ring_flash_fn(
         scale, causal, n_blocks, zigzag, axis_name, interpret,
-        kpad is not None,
+        kpad is not None, dropout_rate if has_seed else 0.0,
     )
+    seed_arg = seed if has_seed else jnp.int32(0)
     if kpad is not None:
-        return fn(q, k, v, kpad)
-    return fn(q, k, v)
+        return fn(q, k, v, kpad, seed_arg)
+    return fn(q, k, v, seed_arg)
 
 
 def ulysses_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
@@ -403,15 +415,22 @@ def ulysses_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
         if kpad is not None else None
     )
     if use_flash:
-        # Dropout-free path: the Pallas flash kernel (fwd + custom_vjp bwd)
-        # over the head-sharded global sequence — no [T, T] score matrix.
+        # Pallas flash kernel (fwd + custom_vjp bwd) over the head-sharded
+        # global sequence — no [T, T] score matrix. Dropout hashes with
+        # GLOBAL head ids (head0 window of H) and the T stride, matching
+        # the jnp bodies bit for bit.
         from smdistributed_modelparallel_tpu.ops.pallas_attention import (
             flash_attention,
         )
 
+        h_local = qg.shape[2]
+        use_drop = dropout_rate > 0.0 and seed is not None
+        head0 = (me * h_local) if use_drop else None
         out = flash_attention(
-            qg, kg, vg, kp_full, None, scale, causal, None, 0.0,
-            256, 256, interpret,
+            qg, kg, vg, kp_full,
+            seed if use_drop else None, head0,
+            scale, causal, None, dropout_rate if use_drop else 0.0,
+            256, 256, interpret, H, T,
         ).astype(q.dtype)
         return jax.lax.all_to_all(
             out, axis_name, split_axis=1, concat_axis=2, tiled=True
@@ -463,16 +482,16 @@ def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
     zigzag = bool(causal) and impl == "ring" and (T // n) % 2 == 0 and n > 1
 
     # Pallas flash kernels inside the manual regions (VERDICT r3 weak #3):
-    # engaged when attention dropout is off (the jnp bodies keep dropout so
-    # its counter-hash replay stays byte-identical across impls) and the
-    # shapes fit the kernels' VMEM envelope. FORCE_INTERPRET lets the CPU
-    # test tier exercise the exact dispatch.
+    # engaged whenever the shapes fit the kernels' VMEM envelope. Dropout
+    # included: the kernels hash on GLOBAL (bh, row, col) ids with the
+    # T_total stride, so the counter-replay pattern is bit-identical to
+    # the jnp bodies (and across ring/Ulysses). FORCE_INTERPRET lets the
+    # CPU test tier exercise the exact dispatch.
     from smdistributed_modelparallel_tpu.ops import pallas_attention as _pk
 
     hd = q.shape[-1]
     flash_cfg = (
-        dropout_rate == 0.0
-        and state.cfg is not None
+        state.cfg is not None
         and getattr(state.cfg, "use_pallas_kernels", True)
     )
     on_tpu = jax.default_backend() == "tpu"
@@ -487,7 +506,8 @@ def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
         if flash_ring:
             body_fn = ring_attention_local_flash
             body_kw = dict(scale=scale, causal=causal, n_blocks=n,
-                           zigzag=zigzag, interpret=interpret)
+                           zigzag=zigzag, interpret=interpret,
+                           dropout_rate=dropout_rate)
         else:
             body_fn = ring_attention_local
             body_kw = dict(scale=scale, causal=causal, n_blocks=n,

@@ -144,12 +144,25 @@ def _ids_cmin(kid_ref, k_offset, block_k, kv_len):
     return jnp.min(jnp.where(loc < kv_len, ids, jnp.int32(2**30)))
 
 
+def _bh_remap(b, h_local, head_total, head0_ref):
+    """Flat (batch*local_head) program index -> GLOBAL batch*head id for
+    the dropout hash. Identity when heads are unsharded; under Ulysses the
+    local heads are a window [head0, head0+h_local) of the global heads."""
+    if head0_ref is None:
+        return b
+    return (
+        (b // h_local) * head_total + head0_ref[0, 0] + (b % h_local)
+    )
+
+
 def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
-                window, rate, has_kpm, has_seed, s_total, has_ids=False):
+                window, rate, has_kpm, has_seed, s_total, has_ids=False,
+                h_local=None, head_total=None, has_head0=False):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     kpm_ref = next(it) if has_kpm else None
     seed_ref = next(it) if has_seed else None
+    head0_ref = next(it) if has_head0 else None
     qid_ref = next(it) if has_ids else None
     kid_ref = next(it) if has_ids else None
     o_ref, lse_ref = next(it), next(it)
@@ -177,10 +190,12 @@ def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
             s = s + kpm_ref[0, pl.ds(j * block_k, block_k)][None, :]
         if has_ids:
             kv_ids = kid_ref[0, pl.ds(j * block_k, block_k)]
-            keep = _ids_mask(rows, cols, q_ids[:, None], kv_ids[None, :],
+            hrows, hcols = q_ids[:, None], kv_ids[None, :]
+            keep = _ids_mask(rows, cols, hrows, hcols,
                              q_len=q_len, kv_len=kv_len, causal=causal,
                              window=window)
         else:
+            hrows, hcols = rows, cols
             keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
                               causal=causal, window=window)
         s = jnp.where(keep, s, NEG_INF)
@@ -190,7 +205,9 @@ def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         if rate > 0.0:
-            dkeep = _dropout_keep(seed_ref[0, 0], b, rows, cols, s_total, rate)
+            bh = _bh_remap(b, h_local, head_total, head0_ref)
+            dkeep = _dropout_keep(seed_ref[0, 0], bh, hrows, hcols,
+                                  s_total, rate)
             p = jnp.where(dkeep, p, 0.0)
         acc_new = acc * alpha + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
@@ -236,11 +253,13 @@ def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
 # ----------------------------------------------------------------------
 
 def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
-                   window, rate, has_kpm, has_seed, s_total, has_ids=False):
+                   window, rate, has_kpm, has_seed, s_total, has_ids=False,
+                   h_local=None, head_total=None, has_head0=False):
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (next(it) for _ in range(6))
     kpm_ref = next(it) if has_kpm else None
     seed_ref = next(it) if has_seed else None
+    head0_ref = next(it) if has_head0 else None
     qid_ref = next(it) if has_ids else None
     kid_ref = next(it) if has_ids else None
     dq_ref = next(it)
@@ -270,10 +289,12 @@ def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
             s = s + kpm_ref[0, pl.ds(j * block_k, block_k)][None, :]
         if has_ids:
             kv_ids = kid_ref[0, pl.ds(j * block_k, block_k)]
-            keep = _ids_mask(rows, cols, q_ids[:, None], kv_ids[None, :],
+            hrows, hcols = q_ids[:, None], kv_ids[None, :]
+            keep = _ids_mask(rows, cols, hrows, hcols,
                              q_len=q_len, kv_len=kv_len, causal=causal,
                              window=window)
         else:
+            hrows, hcols = rows, cols
             keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
                               causal=causal, window=window)
         p = jnp.where(keep, jnp.exp(s - lse), 0.0)    # [bq, bk]
@@ -282,7 +303,9 @@ def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
             preferred_element_type=jnp.float32,
         )
         if rate > 0.0:
-            dkeep = _dropout_keep(seed_ref[0, 0], b, rows, cols, s_total, rate)
+            bh = _bh_remap(b, h_local, head_total, head0_ref)
+            dkeep = _dropout_keep(seed_ref[0, 0], bh, hrows, hcols,
+                                  s_total, rate)
             dp = jnp.where(dkeep, dp * inv_keep, 0.0)
         ds = p * (dp - delta) * scale                 # d(q.k^T)
         return dq_acc + jax.lax.dot_general(
@@ -313,11 +336,13 @@ def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
 
 
 def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
-                    window, rate, has_kpm, has_seed, s_total, has_ids=False):
+                    window, rate, has_kpm, has_seed, s_total, has_ids=False,
+                    h_local=None, head_total=None, has_head0=False):
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (next(it) for _ in range(6))
     kpm_ref = next(it) if has_kpm else None
     seed_ref = next(it) if has_seed else None
+    head0_ref = next(it) if has_head0 else None
     qid_ref = next(it) if has_ids else None
     kid_ref = next(it) if has_ids else None
     dk_ref, dv_ref = next(it), next(it)
@@ -352,10 +377,12 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
             s = s + kpm_blk
         if has_ids:
             q_ids = qid_ref[0, pl.ds(i * block_q, block_q)]
-            keep = _ids_mask(rows, cols, q_ids[:, None], kv_ids[None, :],
+            hrows, hcols = q_ids[:, None], kv_ids[None, :]
+            keep = _ids_mask(rows, cols, hrows, hcols,
                              q_len=q_len, kv_len=kv_len, causal=causal,
                              window=window)
         else:
+            hrows, hcols = rows, cols
             keep = _tile_mask(rows, cols, q_len=q_len, kv_len=kv_len,
                               causal=causal, window=window)
         p = jnp.where(keep, jnp.exp(s - lse), 0.0)
@@ -364,7 +391,9 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
             preferred_element_type=jnp.float32,
         )
         if rate > 0.0:
-            dkeep = _dropout_keep(seed_ref[0, 0], b, rows, cols, s_total, rate)
+            bh = _bh_remap(b, h_local, head_total, head0_ref)
+            dkeep = _dropout_keep(seed_ref[0, 0], bh, hrows, hcols,
+                                  s_total, rate)
             p_drop = jnp.where(dkeep, p * inv_keep, 0.0)
             dp = jnp.where(dkeep, dp * inv_keep, 0.0)
         else:
@@ -430,8 +459,9 @@ def _prep(q, k, v, block_q, block_k):
     return qt, kt, vt, (B, T, S, H, hd, hd_pad, t_pad, s_pad)
 
 
-def _common_inputs(kpad_bias, seed, s_pad, B, H, interpret):
-    """(extra_inputs, extra_specs, has_kpm, has_seed) shared by all kernels."""
+def _common_inputs(kpad_bias, seed, s_pad, B, H, interpret, head0=None):
+    """(extra_inputs, extra_specs, has_kpm, has_seed, has_head0) shared by
+    all kernels."""
     inputs, specs = [], []
     has_kpm = kpad_bias is not None
     if has_kpm:
@@ -445,14 +475,22 @@ def _common_inputs(kpad_bias, seed, s_pad, B, H, interpret):
             kpm = jnp.broadcast_to(kpm, (B, s_pad))
         inputs.append(kpm)
         specs.append(pl.BlockSpec((1, s_pad), lambda b, i: (b // H, 0)))
+
+    def scalar_spec():
+        return pl.BlockSpec(
+            (1, 1), lambda b, i: (0, 0),
+            memory_space=pltpu.SMEM if not interpret else None,
+        )
+
     has_seed = seed is not None
     if has_seed:
         inputs.append(seed.reshape(1, 1).astype(jnp.int32))
-        specs.append(pl.BlockSpec(
-            (1, 1), lambda b, i: (0, 0),
-            memory_space=pltpu.SMEM if not interpret else None,
-        ))
-    return inputs, specs, has_kpm, has_seed
+        specs.append(scalar_spec())
+    has_head0 = head0 is not None
+    if has_head0:
+        inputs.append(jnp.asarray(head0).reshape(1, 1).astype(jnp.int32))
+        specs.append(scalar_spec())
+    return inputs, specs, has_kpm, has_seed, has_head0
 
 
 def _ids_extra(q_ids, kv_ids, t_pad, s_pad):
@@ -471,12 +509,13 @@ def _ids_extra(q_ids, kv_ids, t_pad, s_pad):
 
 def _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
                     dropout_rate, block_q, block_k, interpret,
-                    q_ids=None, kv_ids=None):
+                    q_ids=None, kv_ids=None, head0=None, head_total=None,
+                    counter_len=None):
     qt, kt, vt, (B, T, S, H, hd, hd_pad, t_pad, s_pad) = _prep(
         q, k, v, block_q, block_k
     )
-    extra, extra_specs, has_kpm, has_seed = _common_inputs(
-        kpad_bias, seed, s_pad, B, H, interpret
+    extra, extra_specs, has_kpm, has_seed, has_head0 = _common_inputs(
+        kpad_bias, seed, s_pad, B, H, interpret, head0
     )
     has_ids = q_ids is not None
     if has_ids:
@@ -487,7 +526,10 @@ def _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
         _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
         q_len=T, kv_len=S, causal=causal, window=window,
         rate=dropout_rate if has_seed else 0.0,
-        has_kpm=has_kpm, has_seed=has_seed, s_total=s_pad, has_ids=has_ids,
+        has_kpm=has_kpm, has_seed=has_seed,
+        s_total=counter_len if counter_len is not None else s_pad,
+        has_ids=has_ids, h_local=H, head_total=head_total or H,
+        has_head0=has_head0,
     )
     out, lse = pl.pallas_call(
         kern,
@@ -519,7 +561,8 @@ def _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
 
 def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
                     window, dropout_rate, block_q, block_k, interpret,
-                    q_ids=None, kv_ids=None):
+                    q_ids=None, kv_ids=None, head0=None, head_total=None,
+                    counter_len=None):
     qt, kt, vt, (B, T, S, H, hd, hd_pad, t_pad, s_pad) = _prep(
         q, k, v, block_q, block_k
     )
@@ -533,8 +576,8 @@ def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
     if t_pad != T:
         delta = jnp.pad(delta, ((0, 0), (0, 0), (0, t_pad - T)))
 
-    extra, extra_specs, has_kpm, has_seed = _common_inputs(
-        kpad_bias, seed, s_pad, B, H, interpret
+    extra, extra_specs, has_kpm, has_seed, has_head0 = _common_inputs(
+        kpad_bias, seed, s_pad, B, H, interpret, head0
     )
     has_ids = q_ids is not None
     if has_ids:
@@ -544,7 +587,10 @@ def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
         scale=scale, block_q=block_q, block_k=block_k, q_len=T, kv_len=S,
         causal=causal, window=window,
         rate=dropout_rate if has_seed else 0.0,
-        has_kpm=has_kpm, has_seed=has_seed, s_total=s_pad, has_ids=has_ids,
+        has_kpm=has_kpm, has_seed=has_seed,
+        s_total=counter_len if counter_len is not None else s_pad,
+        has_ids=has_ids, h_local=H, head_total=head_total or H,
+        has_head0=has_head0,
     )
     res_spec_q = pl.BlockSpec((1, t_pad, hd_pad), lambda b, i: (b, 0, 0))
     row_spec = pl.BlockSpec((1, 1, t_pad), lambda b, i: (b, 0, 0))
@@ -607,40 +653,53 @@ def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
 # custom_vjp surface
 # ----------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
-def flash_attention(q, k, v, kpad_bias=None, seed=None, scale=None,
-                    causal=True, window=None, dropout_rate=0.0,
-                    block_q=256, block_k=256, interpret=False):
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13, 14)
+)
+def flash_attention(q, k, v, kpad_bias=None, seed=None, head0=None,
+                    scale=None, causal=True, window=None, dropout_rate=0.0,
+                    block_q=256, block_k=256, interpret=False,
+                    head_total=None, counter_len=None):
     """Flash attention over [B, T, H, hd] q and [B, S, H, hd] k/v.
 
     ``kpad_bias``: additive float [B, S] bias (0 keep / -1e30 drop for
     boolean masks). ``seed``: int32 scalar array enabling dropout at
-    ``dropout_rate``. Fully-masked rows produce an undefined (zero-ish)
-    output, matching softmax-of-all-masked degeneracy in the jnp path.
+    ``dropout_rate``. ``head0``/``head_total``/``counter_len``: GLOBAL
+    dropout-hash coordinates for head-sharded callers (Ulysses) — the
+    local heads hash as window [head0, head0+H) of ``head_total`` global
+    heads, with ``counter_len`` as the row-stride (defaults reproduce the
+    local hash, bh = flat program index, stride = padded S). Fully-masked
+    rows produce an undefined (zero-ish) output, matching
+    softmax-of-all-masked degeneracy in the jnp path.
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     block_q = min(block_q, q.shape[1])
     block_k = min(block_k, k.shape[1])
     o, _ = _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
-                           dropout_rate, block_q, block_k, interpret)
+                           dropout_rate, block_q, block_k, interpret,
+                           head0=head0, head_total=head_total,
+                           counter_len=counter_len)
     return o
 
 
-def _fa_fwd(q, k, v, kpad_bias, seed, scale, causal, window, dropout_rate,
-            block_q, block_k, interpret):
+def _fa_fwd(q, k, v, kpad_bias, seed, head0, scale, causal, window,
+            dropout_rate, block_q, block_k, interpret, head_total,
+            counter_len):
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     block_q = min(block_q, q.shape[1])
     block_k = min(block_k, k.shape[1])
     o, lse = _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
-                             dropout_rate, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse, kpad_bias, seed)
+                             dropout_rate, block_q, block_k, interpret,
+                             head0=head0, head_total=head_total,
+                             counter_len=counter_len)
+    return o, (q, k, v, o, lse, kpad_bias, seed, head0)
 
 
 def _fa_bwd(scale, causal, window, dropout_rate, block_q, block_k, interpret,
-            res, g):
-    q, k, v, o, lse, kpad_bias, seed = res
+            head_total, counter_len, res, g):
+    q, k, v, o, lse, kpad_bias, seed, head0 = res
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     block_q = min(block_q, q.shape[1])
@@ -648,8 +707,9 @@ def _fa_bwd(scale, causal, window, dropout_rate, block_q, block_k, interpret,
     dq, dk, dv = _flash_bwd_impl(
         q, k, v, o, g, lse, kpad_bias, seed, scale, causal, window,
         dropout_rate, block_q, block_k, interpret,
+        head0=head0, head_total=head_total, counter_len=counter_len,
     )
-    return dq, dk, dv, None, None
+    return dq, dk, dv, None, None, None
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -687,24 +747,30 @@ def _rows_to_lse(lse, t_pad):
 
 
 def flash_fwd_with_ids(q, k, v, kpad_bias, q_ids, kv_ids, *, scale, causal,
+                       seed=None, dropout_rate=0.0, counter_len=None,
                        block_q=256, block_k=256, interpret=False):
     """One blockwise forward over a (q block, kv block) pair.
 
-    Returns (o [B, T, H, hd] fp32-normalized per-block output,
-    lse [B, H, T] with +_LSE_MASKED sentinel on fully-masked rows).
+    Dropout hashes on the GLOBAL ids (rows/cols from q_ids/kv_ids, stride
+    ``counter_len``) so the pattern matches the jnp ring/Ulysses bodies
+    bit for bit. Returns (o [B, T, H, hd] fp32-normalized per-block
+    output, lse [B, H, T] with +_LSE_MASKED sentinel on fully-masked
+    rows).
     """
     block_q = min(block_q, q.shape[1])
     block_k = min(block_k, k.shape[1])
     o, lse = _flash_fwd_impl(
-        q, k, v, kpad_bias, None, scale, causal, None, 0.0,
+        q, k, v, kpad_bias, seed, scale, causal, None, dropout_rate,
         block_q, block_k, interpret, q_ids=q_ids, kv_ids=kv_ids,
+        counter_len=counter_len,
     )
     B, T, H = q.shape[0], q.shape[1], q.shape[2]
     return o, _lse_to_rows(lse, B, H, T)
 
 
 def flash_bwd_with_ids(q, k, v, o, g, lse, kpad_bias, q_ids, kv_ids, *,
-                       scale, causal, block_q=256, block_k=256,
+                       scale, causal, seed=None, dropout_rate=0.0,
+                       counter_len=None, block_q=256, block_k=256,
                        interpret=False):
     """Blockwise backward for one (q block, kv block) pair given the GLOBAL
     per-row logsumexp ``lse`` [B, H, T] (+_LSE_MASKED sentinel rows) and
@@ -714,6 +780,7 @@ def flash_bwd_with_ids(q, k, v, o, g, lse, kpad_bias, q_ids, kv_ids, *,
     t_pad = ((q.shape[1] + block_q - 1) // block_q) * block_q
     lse_raw = _rows_to_lse(lse, t_pad)
     return _flash_bwd_impl(
-        q, k, v, o, g, lse_raw, kpad_bias, None, scale, causal, None, 0.0,
-        block_q, block_k, interpret, q_ids=q_ids, kv_ids=kv_ids,
+        q, k, v, o, g, lse_raw, kpad_bias, seed, scale, causal, None,
+        dropout_rate, block_q, block_k, interpret, q_ids=q_ids,
+        kv_ids=kv_ids, counter_len=counter_len,
     )

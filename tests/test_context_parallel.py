@@ -408,6 +408,81 @@ class TestCpFlashPath:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-4)
 
+    def test_dropout_flash_matches_jnp_across_impls(self):
+        """Dropout inside the flash CP paths: the kernels hash on GLOBAL
+        (bh, row, col) ids with the T stride, so flash-ring, flash-Ulysses,
+        jnp-ring, and jnp-Ulysses all produce the SAME dropped pattern for
+        one (model, seed)."""
+        from smdistributed_modelparallel_tpu.ops import context_parallel as cp
+        from smdistributed_modelparallel_tpu.ops.context_parallel import (
+            cp_attention,
+        )
+
+        q, k, v = self._qkv()
+        kp = self._kpad()
+        seed = jnp.int32(77)
+        outs = {}
+        for impl in ("ring", "ulysses"):
+            for pallas in (True, False):
+                smp.shutdown()
+                smp.init({"context_parallel_degree": 4, "ddp": True,
+                          "use_pallas_kernels": pallas})
+                cp._build_cp_call.cache_clear()
+                cp._ring_flash_fn.cache_clear()
+                with jax.set_mesh(state.mesh):
+                    outs[(impl, pallas)] = np.asarray(jax.jit(
+                        lambda q, k, v, _i=impl: cp_attention(
+                            q, k, v, scale=1.0 / np.sqrt(8), causal=True,
+                            impl=_i, kpad=kp, dropout_rate=0.2, seed=seed,
+                        )
+                    )(q, k, v))
+        ref = outs[("ring", False)]
+        for key, val in outs.items():
+            np.testing.assert_allclose(val, ref, atol=3e-5, err_msg=str(key))
+        # ...and dropout actually dropped something.
+        smp.shutdown()
+        smp.init({"context_parallel_degree": 4, "ddp": True})
+        with jax.set_mesh(state.mesh):
+            nodrop = np.asarray(jax.jit(lambda q, k, v: cp_attention(
+                q, k, v, scale=1.0 / np.sqrt(8), causal=True, impl="ring",
+                kpad=kp,
+            ))(q, k, v))
+        assert not np.allclose(ref, nodrop)
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_dropout_flash_gradients_match_jnp(self, impl):
+        """Same seed -> same mask -> the flash custom-VJP/AD gradients must
+        match reverse-AD through the jnp bodies. The Ulysses case also
+        covers the head0 remap through the backward kernels."""
+        from smdistributed_modelparallel_tpu.ops import context_parallel as cp
+        from smdistributed_modelparallel_tpu.ops.context_parallel import (
+            cp_attention,
+        )
+
+        q, k, v = self._qkv()
+        seed = jnp.int32(5)
+        grads = {}
+        for pallas in (True, False):
+            smp.shutdown()
+            smp.init({"context_parallel_degree": 4, "ddp": True,
+                      "use_pallas_kernels": pallas})
+            cp._build_cp_call.cache_clear()
+            cp._ring_flash_fn.cache_clear()
+
+            def loss(q, k, v):
+                return jnp.sum(cp_attention(
+                    q, k, v, scale=1.0 / np.sqrt(8), causal=True,
+                    impl=impl, dropout_rate=0.2, seed=seed,
+                ) ** 2)
+
+            with jax.set_mesh(state.mesh):
+                grads[pallas] = jax.jit(
+                    jax.grad(loss, argnums=(0, 1, 2))
+                )(q, k, v)
+        for a, b in zip(grads[True], grads[False]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
     @pytest.mark.slow
     def test_no_score_block_materialized_at_8k(self):
         """The done-criterion probe (VERDICT r3 next-round #3): at cp4 /

@@ -40,7 +40,7 @@ def _naive(q, k, v, scale=None, causal=True, window=None, kpad=None):
 
 def _flash(q, k, v, kpad=None, seed=None, scale=None, causal=True,
            window=None, rate=0.0, bq=128, bk=128):
-    return flash_attention(q, k, v, kpad, seed, scale, causal, window,
+    return flash_attention(q, k, v, kpad, seed, None, scale, causal, window,
                            rate, bq, bk, True)
 
 
