@@ -57,8 +57,8 @@ def masked_vocab_parallel_cross_entropy(logits, targets, ignore_index=-100):
 
 
 def fused_lm_head_cross_entropy(hidden, embedding_table, targets,
-                                ignore_index=-100, block_n=256,
-                                block_v=1024):
+                                ignore_index=-100, label_smoothing=0.0,
+                                block_n=256, block_v=1024):
     """Tied-LM-head cross-entropy WITHOUT materializing logits.
 
     TPU extension (no reference counterpart): computes per-token
@@ -88,10 +88,13 @@ def fused_lm_head_cross_entropy(hidden, embedding_table, targets,
     tp = state.mesh.shape.get(TP_AXIS, 1) if state.initialized else 1
     if tp == 1 and pc.fused_ce_ok(x, embedding_table):
         per = pc.fused_lm_head_ce(x, embedding_table, t_safe,
-                                  block_n, block_v)
+                                  block_n, block_v, False,
+                                  float(label_smoothing))
     else:
         logits = x @ embedding_table.T.astype(x.dtype)
-        per = vocab_parallel_cross_entropy(logits, t_safe)
+        per = vocab_parallel_cross_entropy(
+            logits, t_safe, label_smoothing=label_smoothing
+        )
     per = jnp.where(valid, per, 0.0)
     return per.reshape(lead)
 

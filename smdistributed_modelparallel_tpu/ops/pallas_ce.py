@@ -41,8 +41,11 @@ NEG_INF = -1e30
 FORCE_INTERPRET = False
 
 
-def _fwd_kernel(x_ref, w_ref, t_ref, m_ref, l_ref, tgt_ref, *, block_v,
-                v_total):
+def _fwd_kernel(*refs, block_v, v_total, smoothing):
+    it = iter(refs)
+    x_ref, w_ref, t_ref = next(it), next(it), next(it)
+    m_ref, l_ref, tgt_ref = next(it), next(it), next(it)
+    sum_ref = next(it) if smoothing else None
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -50,6 +53,8 @@ def _fwd_kernel(x_ref, w_ref, t_ref, m_ref, l_ref, tgt_ref, *, block_v,
         m_ref[...] = jnp.full(m_ref.shape, NEG_INF, jnp.float32)
         l_ref[...] = jnp.zeros(l_ref.shape, jnp.float32)
         tgt_ref[...] = jnp.zeros(tgt_ref.shape, jnp.float32)
+        if smoothing:
+            sum_ref[...] = jnp.zeros(sum_ref.shape, jnp.float32)
 
     x = x_ref[...].astype(jnp.float32)                  # [bn, D]
     w = w_ref[...].astype(jnp.float32)                  # [bv, D]
@@ -75,10 +80,18 @@ def _fwd_kernel(x_ref, w_ref, t_ref, m_ref, l_ref, tgt_ref, *, block_v,
     m_ref[...] = m_new.reshape(m_ref.shape)
     l_ref[...] = l_new.reshape(l_ref.shape)
     tgt_ref[...] = tgt_ref[...] + tgt_add.reshape(tgt_ref.shape)
+    if smoothing:
+        # Valid-column logit row-sums feed the label-smoothing term
+        # (loss += eps * (lse - mean(logits))); padded columns hold
+        # NEG_INF and are excluded.
+        valid = cols < v_total
+        sum_ref[...] = sum_ref[...] + jnp.sum(
+            jnp.where(valid, logits, 0.0), axis=-1
+        ).reshape(sum_ref.shape)
 
 
 def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, *, block_v,
-                   v_total):
+                   v_total, smoothing):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -95,8 +108,15 @@ def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, *, block_v,
         preferred_element_type=jnp.float32,
     )
     cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-    p = jnp.where(cols < v_total, jnp.exp(logits - lse), 0.0)
-    dlog = (p - (cols == tids).astype(jnp.float32)) * g
+    valid = cols < v_total
+    p = jnp.where(valid, jnp.exp(logits - lse), 0.0)
+    target_mass = (cols == tids).astype(jnp.float32)
+    if smoothing:
+        # dloss/dlogit = p - (1-eps)*onehot - eps/V on valid columns.
+        target_mass = (1.0 - smoothing) * target_mass + jnp.where(
+            valid, smoothing / v_total, 0.0
+        )
+    dlog = (p - target_mass) * g
     dx_ref[...] = dx_ref[...] + jax.lax.dot_general(
         dlog, w, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -104,7 +124,7 @@ def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, *, block_v,
 
 
 def _bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dw_ref, *, block_n,
-                   block_v, n_total, v_total):
+                   block_v, n_total, v_total, smoothing):
     j = pl.program_id(0)                                # vocab block (outer)
     i = pl.program_id(1)                                # row block (inner)
 
@@ -128,7 +148,14 @@ def _bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dw_ref, *, block_n,
         preferred_element_type=jnp.float32,
     )                                                   # [bn, bv]
     p = jnp.exp(logits - lse)
-    dlog = (p - (cols == tids).astype(jnp.float32)) * g
+    target_mass = (cols == tids).astype(jnp.float32)
+    if smoothing:
+        # All columns of a dW program's block are valid (v_pad slicing
+        # happens host-side), but guard like the dx kernel for symmetry.
+        target_mass = (1.0 - smoothing) * target_mass + jnp.where(
+            cols < v_total, smoothing / v_total, 0.0
+        )
+    dlog = (p - target_mass) * g
     # Padded rows carry g=0 already (their loss cotangent is zero), but
     # guard anyway: their lse is a filler value.
     dlog = jnp.where(rows < n_total, dlog, 0.0)
@@ -154,16 +181,19 @@ def _blocks(N, V, block_n, block_v):
     return block_n, block_v, n_pad, v_pad
 
 
-def _fused_ce_fwd_impl(x, w, targets, block_n, block_v, interpret):
+def _fused_ce_fwd_impl(x, w, targets, block_n, block_v, interpret,
+                       smoothing=0.0):
     N, D = x.shape
     V = w.shape[0]
     block_n, block_v, n_pad, v_pad = _blocks(N, V, block_n, block_v)
     xp = _pad_to(x, n_pad, 0)
     wp = _pad_to(w, v_pad, 0)
     tp = _pad_to(targets.astype(jnp.int32), n_pad, 0)[None, :]
-    kern = functools.partial(_fwd_kernel, block_v=block_v, v_total=V)
+    kern = functools.partial(_fwd_kernel, block_v=block_v, v_total=V,
+                             smoothing=smoothing)
     row = pl.BlockSpec((1, block_n), lambda i, j: (0, i))
-    m, l, tgt = pl.pallas_call(
+    n_out = 4 if smoothing else 3
+    outs = pl.pallas_call(
         kern,
         grid=(n_pad // block_n, v_pad // block_v),
         in_specs=[
@@ -171,19 +201,21 @@ def _fused_ce_fwd_impl(x, w, targets, block_n, block_v, interpret):
             pl.BlockSpec((block_v, D), lambda i, j: (j, 0)),
             row,
         ],
-        out_specs=[row, row, row],
+        out_specs=[row] * n_out,
         out_shape=[
-            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
-            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
-            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, n_pad), jnp.float32)
+            for _ in range(n_out)
         ],
         interpret=interpret or FORCE_INTERPRET,
     )(xp, wp, tp)
+    m, l, tgt = outs[0], outs[1], outs[2]
     lse = m[0, :N] + jnp.log(jnp.maximum(l[0, :N], 1e-30))
-    return lse, tgt[0, :N]
+    logit_sum = outs[3][0, :N] if smoothing else None
+    return lse, tgt[0, :N], logit_sum
 
 
-def _fused_ce_bwd_impl(x, w, targets, lse, g, block_n, block_v, interpret):
+def _fused_ce_bwd_impl(x, w, targets, lse, g, block_n, block_v, interpret,
+                       smoothing=0.0):
     N, D = x.shape
     V = w.shape[0]
     block_n, block_v, n_pad, v_pad = _blocks(N, V, block_n, block_v)
@@ -197,7 +229,8 @@ def _fused_ce_bwd_impl(x, w, targets, lse, g, block_n, block_v, interpret):
     row_i = pl.BlockSpec((1, block_n), lambda i, j: (0, i))
 
     dx = pl.pallas_call(
-        functools.partial(_bwd_dx_kernel, block_v=block_v, v_total=V),
+        functools.partial(_bwd_dx_kernel, block_v=block_v, v_total=V,
+                          smoothing=smoothing),
         grid=(n_pad // block_n, v_pad // block_v),
         in_specs=[
             pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
@@ -216,7 +249,7 @@ def _fused_ce_bwd_impl(x, w, targets, lse, g, block_n, block_v, interpret):
     row_j = pl.BlockSpec((1, block_n), lambda j, i: (0, i))
     dw = pl.pallas_call(
         functools.partial(_bwd_dw_kernel, block_n=block_n, block_v=block_v,
-                          n_total=N, v_total=V),
+                          n_total=N, v_total=V, smoothing=smoothing),
         grid=(v_pad // block_v, n_pad // block_n),
         in_specs=[
             pl.BlockSpec((block_n, D), lambda j, i: (i, 0)),
@@ -230,26 +263,41 @@ def _fused_ce_bwd_impl(x, w, targets, lse, g, block_n, block_v, interpret):
     return dx[:N].astype(x.dtype), dw[:V].astype(w.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _assemble_loss(lse, tgt, logit_sum, V, smoothing):
+    if not smoothing:
+        return lse - tgt
+    # loss = (1-eps)*(lse - tgt) + eps*(lse - mean(logits))
+    #      = lse - (1-eps)*tgt - (eps/V)*sum(logits)
+    return lse - (1.0 - smoothing) * tgt - (smoothing / V) * logit_sum
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def fused_lm_head_ce(x, w, targets, block_n=256, block_v=1024,
-                     interpret=False):
+                     interpret=False, label_smoothing=0.0):
     """Per-token CE of ``x @ w^T`` against ``targets`` without
     materializing logits. x: [N, D]; w: [V, D]; targets: [N] int.
-    Returns fp32 [N] losses. Differentiable in x and w.
+    ``label_smoothing``: HF/T5-convention uniform smoothing
+    (eps * mean-over-vocab NLL mixed in). Returns fp32 [N] losses.
+    Differentiable in x and w.
     """
-    lse, tgt = _fused_ce_fwd_impl(x, w, targets, block_n, block_v, interpret)
-    return lse - tgt
+    lse, tgt, ls = _fused_ce_fwd_impl(
+        x, w, targets, block_n, block_v, interpret, label_smoothing
+    )
+    return _assemble_loss(lse, tgt, ls, w.shape[0], label_smoothing)
 
 
-def _fce_fwd(x, w, targets, block_n, block_v, interpret):
-    lse, tgt = _fused_ce_fwd_impl(x, w, targets, block_n, block_v, interpret)
-    return lse - tgt, (x, w, targets, lse)
+def _fce_fwd(x, w, targets, block_n, block_v, interpret, label_smoothing):
+    lse, tgt, ls = _fused_ce_fwd_impl(
+        x, w, targets, block_n, block_v, interpret, label_smoothing
+    )
+    loss = _assemble_loss(lse, tgt, ls, w.shape[0], label_smoothing)
+    return loss, (x, w, targets, lse)
 
 
-def _fce_bwd(block_n, block_v, interpret, res, g):
+def _fce_bwd(block_n, block_v, interpret, label_smoothing, res, g):
     x, w, targets, lse = res
     dx, dw = _fused_ce_bwd_impl(
-        x, w, targets, lse, g, block_n, block_v, interpret
+        x, w, targets, lse, g, block_n, block_v, interpret, label_smoothing
     )
     return dx, dw, None
 

@@ -56,6 +56,36 @@ class TestKernelParity:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-3)
 
+    def test_label_smoothing_matches_reference(self, interpret_kernels):
+        """HF/T5-convention smoothing: loss and BOTH gradients match the
+        materialized-logits formula (the vocab_parallel path's math)."""
+        x, w, t = _xwt()
+        eps = 0.1
+
+        def ref_loss(x, w):
+            logits = x.astype(jnp.float32) @ w.astype(jnp.float32).T
+            m = jax.lax.stop_gradient(
+                jnp.max(logits, axis=-1, keepdims=True)
+            )
+            lse = jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)) + m[:, 0]
+            tgt = jnp.take_along_axis(logits, t[:, None], axis=-1)[:, 0]
+            nll = lse - tgt
+            smooth = -jnp.mean(jax.nn.log_softmax(logits, axis=-1), axis=-1)
+            return (1.0 - eps) * nll + eps * smooth
+
+        out = pc.fused_lm_head_ce(x, w, t, 16, 64, True, eps)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_loss(x, w)),
+                                   atol=1e-4, rtol=1e-4)
+
+        gf = jax.grad(lambda x, w: jnp.mean(
+            pc.fused_lm_head_ce(x, w, t, 16, 64, True, eps)
+        ), argnums=(0, 1))(x, w)
+        gr = jax.grad(lambda x, w: jnp.mean(ref_loss(x, w)),
+                      argnums=(0, 1))(x, w)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-3)
+
     def test_bf16_inputs(self, interpret_kernels):
         x, w, t = _xwt()
         out = pc.fused_lm_head_ce(
