@@ -398,6 +398,31 @@ SCHEMA = {
         "training loop reads PRE-update parameters after a step or "
         "intentionally skips optimizer.step().",
     },
+    "fused_ce": {
+        "type": (bool, str),
+        "default": "auto",
+        "options": [True, False, "auto"],
+        "description": "TPU extension: LM-head cross-entropy path for "
+        "model(ids, targets=...) loss mode. True: stream vocab through "
+        "the blockwise Pallas kernel (logits never materialize; the "
+        "backward recomputes logit blocks, ~5/3 the head matmul flops) — "
+        "falls back WITH A WARNING where the kernel cannot run (off-TPU, "
+        "tp-sharded vocab, no block configuration fits VMEM). False: "
+        "always materialize logits (fastest when they fit). 'auto' "
+        "(default): use the kernel only when the per-microbatch logits "
+        "(at the activation dtype) would exceed fused_ce_auto_threshold_mb "
+        "— at that size the HBM capacity win outweighs the recompute; "
+        "below it the logits path is faster on every measured shape.",
+    },
+    "fused_ce_auto_threshold_mb": {
+        "type": int,
+        "default": 2048,
+        "lower_bound": 1,
+        "description": "TPU extension: logits-size threshold (MB, at the "
+        "activation dtype — bf16 logits count 2 bytes/element, fp32 count "
+        "4) above which fused_ce: auto switches to the no-materialize "
+        "Pallas CE kernel.",
+    },
     "_device_count_override": {
         "type": (int, type(None)),
         "default": None,

@@ -155,6 +155,8 @@ class TransformerLM(nn.Module):
     rotary_dim: Optional[int] = None
     deterministic: bool = True
     ln_eps: float = 1e-5
+    # Loss-mode (targets=...) uniform label smoothing, HF/T5 convention.
+    label_smoothing: float = 0.0
 
     @nn.nowrap
     def _layer_kwargs(self):
@@ -205,7 +207,8 @@ class TransformerLM(nn.Module):
             )
 
             return fused_lm_head_cross_entropy(
-                x, self.wte.embedding, targets
+                x, self.wte.embedding, targets,
+                label_smoothing=self.label_smoothing,
             )
         logits = self.wte.attend(x) if self.tie_weights else self.lm_head(x)
         if targets is None:
@@ -214,7 +217,9 @@ class TransformerLM(nn.Module):
             masked_vocab_parallel_cross_entropy,
         )
 
-        return masked_vocab_parallel_cross_entropy(logits, targets)
+        return masked_vocab_parallel_cross_entropy(
+            logits, targets, label_smoothing=self.label_smoothing
+        )
 
     def __call__(self, ids, targets=None):
         """ids -> logits; with ``targets`` ([B, T] int, -100 = ignored) ->

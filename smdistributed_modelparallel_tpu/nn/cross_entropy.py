@@ -41,33 +41,72 @@ def vocab_parallel_cross_entropy(logits, targets, label_smoothing=0.0):
     target_logit = jnp.sum(logits_f * one_hot, axis=-1)
     loss = lse - target_logit
     if label_smoothing > 0.0:
-        smooth = -jnp.mean(jax.nn.log_softmax(logits_f, axis=-1), axis=-1)
+        # mean over vocab of -log_softmax == lse - mean(logits): reuses the
+        # lse above instead of a second [.., V] fp32 log-softmax (and its
+        # extra allreduce pair under tp).
+        smooth = lse - jnp.mean(logits_f, axis=-1)
         loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth
     return loss
 
 
-def masked_vocab_parallel_cross_entropy(logits, targets, ignore_index=-100):
+def masked_vocab_parallel_cross_entropy(logits, targets, ignore_index=-100,
+                                        label_smoothing=0.0):
     """``vocab_parallel_cross_entropy`` with HF-convention ignored labels:
     ``ignore_index`` positions contribute 0 loss and no gradient."""
     valid = targets != ignore_index
     per = vocab_parallel_cross_entropy(
-        logits, jnp.where(valid, targets, 0)
+        logits, jnp.where(valid, targets, 0),
+        label_smoothing=label_smoothing,
     )
     return jnp.where(valid, per, 0.0)
 
 
+def _want_fused_ce(x, embedding_table):
+    """Policy half of the CE dispatch (capability half: ``pc.fused_ce_ok``).
+
+    The blockwise kernel trades ~5/3 the head matmul flops (the backward
+    recomputes logit blocks) for never materializing [N, V]. At transformer
+    widths the recompute costs more wall-clock than the saved HBM traffic
+    (measured: GPT-2 124M bench 114.5 -> 104.0 ms/step on v5e when switching
+    to the logits path), so the kernel is a memory-CAPACITY lever: ``auto``
+    engages it only when the logits (at the activation dtype) would be
+    large enough to threaten HBM (fused_ce_auto_threshold_mb, default
+    2 GB — e.g. 32k tokens x 50k vocab at bf16), where the logits path
+    would OOM or evict everything else.
+    """
+    from smdistributed_modelparallel_tpu.backend.state import state
+
+    mode = getattr(state.cfg, "fused_ce", "auto") if state.initialized else "auto"
+    if mode is True:
+        return True
+    if mode is False:
+        return False
+    thresh_mb = (
+        getattr(state.cfg, "fused_ce_auto_threshold_mb", 2048)
+        if state.initialized else 2048
+    )
+    # Estimate the materialized path's logits at the ACTIVATION dtype
+    # (fp32 activations materialize 4-byte logits plus the softmax's fp32
+    # copy — underestimating here would defeat the capacity policy).
+    itemsize = jnp.dtype(x.dtype).itemsize
+    logits_mb = x.shape[0] * embedding_table.shape[0] * itemsize / 2**20
+    return logits_mb > thresh_mb
+
+
 def fused_lm_head_cross_entropy(hidden, embedding_table, targets,
                                 ignore_index=-100, label_smoothing=0.0,
-                                block_n=256, block_v=1024):
+                                block_n=None, block_v=None):
     """Tied-LM-head cross-entropy WITHOUT materializing logits.
 
     TPU extension (no reference counterpart): computes per-token
     ``CE(hidden @ table^T, targets)`` through the blockwise Pallas kernels
     (``ops/pallas_ce.py``) — the [.., V] logits tensor, the single largest
-    HBM intermediate of LM training at 124M-scale, never exists. Falls
-    back to the materialized-logits ``vocab_parallel_cross_entropy`` path
-    off-TPU or under tensor parallelism (where the vocab axis is sharded
-    and the Megatron allreduce path is the right tool).
+    HBM intermediate of large-vocab LM training, never exists. Block sizes
+    default to ``pallas_ce.auto_blocks`` (shrunk to fit VMEM for wide D).
+    Falls back to the materialized-logits ``vocab_parallel_cross_entropy``
+    path off-TPU or under tensor parallelism (where the vocab axis is
+    sharded and the Megatron allreduce path is the right tool); a forced
+    ``fused_ce: True`` that cannot run logs a warning at trace time.
 
     Args:
       hidden: [..., D] final hidden states (post final-layernorm).
@@ -78,6 +117,7 @@ def fused_lm_head_cross_entropy(hidden, embedding_table, targets,
     """
     from smdistributed_modelparallel_tpu.backend.state import state
     from smdistributed_modelparallel_tpu.ops import pallas_ce as pc
+    from smdistributed_modelparallel_tpu.utils.logger import get_logger
 
     lead = hidden.shape[:-1]
     D = hidden.shape[-1]
@@ -86,11 +126,23 @@ def fused_lm_head_cross_entropy(hidden, embedding_table, targets,
     valid = t != ignore_index
     t_safe = jnp.where(valid, t, 0)
     tp = state.mesh.shape.get(TP_AXIS, 1) if state.initialized else 1
-    if tp == 1 and pc.fused_ce_ok(x, embedding_table):
+    want = _want_fused_ce(x, embedding_table)
+    can = tp == 1 and pc.fused_ce_ok(x, embedding_table, block_n, block_v)
+    if want and can:
+        bn, bv = pc.auto_blocks(D, block_n, block_v)
         per = pc.fused_lm_head_ce(x, embedding_table, t_safe,
-                                  block_n, block_v, False,
+                                  bn, bv, False,
                                   float(label_smoothing))
     else:
+        if want and not can and state.initialized \
+                and getattr(state.cfg, "fused_ce", "auto") is True:
+            get_logger().warning(
+                "fused_ce: True requested but the kernel cannot run here "
+                "(%s) — materializing [%d, %d] logits instead.",
+                "vocab is tp-sharded" if tp > 1 else "off-TPU or no block "
+                "configuration fits VMEM for D=%d" % D,
+                x.shape[0], embedding_table.shape[0],
+            )
         logits = x @ embedding_table.T.astype(x.dtype)
         per = vocab_parallel_cross_entropy(
             logits, t_safe, label_smoothing=label_smoothing

@@ -776,6 +776,8 @@ class DistributedTransformerLMHead(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Loss-mode (targets=...) uniform label smoothing, HF/T5 convention.
+    label_smoothing: float = 0.0
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -900,7 +902,8 @@ class DistributedTransformerLMHead(nn.Module):
             )
 
             return fused_lm_head_cross_entropy(
-                x, self.word_embedding.embedding, targets
+                x, self.word_embedding.embedding, targets,
+                label_smoothing=self.label_smoothing,
             )
         if self.tie_input_output_embedding:
             logits = self.word_embedding.attend(x)
@@ -912,7 +915,9 @@ class DistributedTransformerLMHead(nn.Module):
             masked_vocab_parallel_cross_entropy,
         )
 
-        return masked_vocab_parallel_cross_entropy(logits, targets)
+        return masked_vocab_parallel_cross_entropy(
+            logits, targets, label_smoothing=self.label_smoothing
+        )
 
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
                  targets=None):

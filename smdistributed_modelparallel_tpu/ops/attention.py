@@ -228,6 +228,10 @@ def _pallas_ok(q, k, v):
     short enough that K/V (dq pass) or Q/dO (dkv pass) fit VMEM per
     (batch, head) — the kernels pad hd/T/S to tile boundaries themselves
     (``pallas_attention._prep``)."""
+    import os
+
+    if os.environ.get("SMP_DISABLE_PALLAS_ATTN", "0") == "1":
+        return False
     on_tpu = jax.default_backend() == "tpu"
     if not on_tpu:
         return False

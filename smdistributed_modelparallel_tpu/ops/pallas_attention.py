@@ -439,6 +439,15 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
 # Host-side wrappers
 # ----------------------------------------------------------------------
 
+def _clamp_block(block, dim):
+    """Clamp a block size to a sequence dim, keeping lane alignment: the
+    result is min(block, dim rounded up to 128), so a short/ragged dim
+    yields ONE aligned block (padded by ``_prep``) instead of a raw
+    ``min`` that would hand Mosaic an unaligned (non-multiple-of-128)
+    block shape for dims like 300."""
+    return min(block, ((dim + 127) // 128) * 128)
+
+
 def _prep(q, k, v, block_q, block_k):
     B, T, H, hd = q.shape
     S = k.shape[1]
@@ -658,7 +667,7 @@ def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
 )
 def flash_attention(q, k, v, kpad_bias=None, seed=None, head0=None,
                     scale=None, causal=True, window=None, dropout_rate=0.0,
-                    block_q=256, block_k=256, interpret=False,
+                    block_q=256, block_k=512, interpret=False,
                     head_total=None, counter_len=None):
     """Flash attention over [B, T, H, hd] q and [B, S, H, hd] k/v.
 
@@ -674,8 +683,8 @@ def flash_attention(q, k, v, kpad_bias=None, seed=None, head0=None,
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
+    block_q = _clamp_block(block_q, q.shape[1])
+    block_k = _clamp_block(block_k, k.shape[1])
     o, _ = _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
                            dropout_rate, block_q, block_k, interpret,
                            head0=head0, head_total=head_total,
@@ -688,8 +697,8 @@ def _fa_fwd(q, k, v, kpad_bias, seed, head0, scale, causal, window,
             counter_len):
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
+    block_q = _clamp_block(block_q, q.shape[1])
+    block_k = _clamp_block(block_k, k.shape[1])
     o, lse = _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
                              dropout_rate, block_q, block_k, interpret,
                              head0=head0, head_total=head_total,
@@ -702,8 +711,8 @@ def _fa_bwd(scale, causal, window, dropout_rate, block_q, block_k, interpret,
     q, k, v, o, lse, kpad_bias, seed, head0 = res
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
+    block_q = _clamp_block(block_q, q.shape[1])
+    block_k = _clamp_block(block_k, k.shape[1])
     dq, dk, dv = _flash_bwd_impl(
         q, k, v, o, g, lse, kpad_bias, seed, scale, causal, window,
         dropout_rate, block_q, block_k, interpret,
@@ -757,8 +766,8 @@ def flash_fwd_with_ids(q, k, v, kpad_bias, q_ids, kv_ids, *, scale, causal,
     output, lse [B, H, T] with +_LSE_MASKED sentinel on fully-masked
     rows).
     """
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
+    block_q = _clamp_block(block_q, q.shape[1])
+    block_k = _clamp_block(block_k, k.shape[1])
     o, lse = _flash_fwd_impl(
         q, k, v, kpad_bias, seed, scale, causal, None, dropout_rate,
         block_q, block_k, interpret, q_ids=q_ids, kv_ids=kv_ids,
@@ -775,8 +784,8 @@ def flash_bwd_with_ids(q, k, v, o, g, lse, kpad_bias, q_ids, kv_ids, *,
     """Blockwise backward for one (q block, kv block) pair given the GLOBAL
     per-row logsumexp ``lse`` [B, H, T] (+_LSE_MASKED sentinel rows) and
     the GLOBAL output ``o`` / cotangent ``g``. Returns (dq, dk, dv)."""
-    block_q = min(block_q, q.shape[1])
-    block_k = min(block_k, k.shape[1])
+    block_q = _clamp_block(block_q, q.shape[1])
+    block_k = _clamp_block(block_k, k.shape[1])
     t_pad = ((q.shape[1] + block_q - 1) // block_q) * block_q
     lse_raw = _rows_to_lse(lse, t_pad)
     return _flash_bwd_impl(

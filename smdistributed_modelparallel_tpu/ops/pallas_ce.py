@@ -305,21 +305,56 @@ def _fce_bwd(block_n, block_v, interpret, label_smoothing, res, g):
 fused_lm_head_ce.defvjp(_fce_fwd, _fce_bwd)
 
 
-def fused_ce_ok(x, w, block_n=256, block_v=1024):
+def _step_bytes(D, block_n, block_v):
+    # fp32 in-kernel copies: x_blk + w_blk + logits + dx/dw accumulator.
+    return 4 * (block_n * D + block_v * D + block_n * block_v
+                + max(block_n, block_v) * D)
+
+
+_VMEM_BUDGET = 12 * 2**20
+
+# Preference order: large vocab blocks amortize the row re-reads; shrink
+# block_v first (it multiplies D in three of the four VMEM terms), then
+# block_n, so wide models (large D) still get a fitting configuration
+# instead of losing the kernel entirely.
+_BLOCK_CANDIDATES = (
+    (256, 1024), (256, 512), (128, 512), (128, 256), (64, 256), (32, 128),
+)
+
+
+def auto_blocks(D, block_n=None, block_v=None):
+    """Pick (block_n, block_v) whose working set fits the VMEM budget.
+
+    Explicit ``block_n``/``block_v`` are honored when they fit; a
+    partially-specified call pins the given dimension and picks the other
+    from the candidate list. Returns None when nothing fits
+    (pathologically wide D) — callers treat that as "kernel
+    unavailable"."""
+    if block_n is not None and block_v is not None:
+        return (
+            (block_n, block_v)
+            if _step_bytes(D, block_n, block_v) <= _VMEM_BUDGET else None
+        )
+    for bn, bv in _BLOCK_CANDIDATES:
+        bn = block_n if block_n is not None else bn
+        bv = block_v if block_v is not None else bv
+        if _step_bytes(D, bn, bv) <= _VMEM_BUDGET:
+            return bn, bv
+    return None
+
+
+def fused_ce_ok(x, w, block_n=None, block_v=None):
     """Dispatch precondition: TPU backend (or interpret-mode testing) and
-    per-grid-step working set well inside VMEM; the caller guards vocab
-    sharding. SMP_DISABLE_FUSED_CE=1 is the operator escape hatch."""
+    a block configuration whose working set fits VMEM (``auto_blocks``
+    shrinks blocks for wide D); the caller guards vocab sharding.
+    SMP_DISABLE_FUSED_CE=1 is the operator escape hatch."""
     import os
 
     if os.environ.get("SMP_DISABLE_FUSED_CE", "0") == "1":
         return False
     if jax.default_backend() != "tpu" and not FORCE_INTERPRET:
         return False
-    D = x.shape[-1]
-    # fp32 in-kernel copies: x_blk + w_blk + logits + dx/dw accumulator.
-    step_bytes = 4 * (block_n * D + block_v * D + block_n * block_v
-                      + max(block_n, block_v) * D)
-    return step_bytes <= 12 * 2**20
+    return auto_blocks(x.shape[-1], block_n, block_v) is not None
 
 
 def reference_lm_head_ce(x, w, targets):

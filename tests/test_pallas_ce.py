@@ -105,7 +105,7 @@ class TestDispatcher:
         )
 
         smp.reset()
-        smp.init({"microbatches": 1})
+        smp.init({"microbatches": 1, "fused_ce": True})
         x, w, t = _xwt(N=24, D=16, V=64)
         h = x.reshape(2, 12, 16)
         tt = t.reshape(2, 12).at[:, -3:].set(-100)
@@ -154,7 +154,7 @@ class TestModelLossMode:
         )
 
         smp.reset()
-        smp.init({"microbatches": 1})
+        smp.init({"microbatches": 1, "fused_ce": True})
         m = TransformerLM(vocab_size=64, max_len=16, d_model=16, n_layers=2,
                           n_heads=2)
         ids = jax.random.randint(jax.random.key(0), (2, 12), 0, 64)
@@ -178,7 +178,7 @@ class TestModelLossMode:
         )
 
         smp.reset()
-        smp.init({"ddp": True, "microbatches": 2})
+        smp.init({"ddp": True, "microbatches": 2, "fused_ce": True})
         model = smp.DistributedModel(TransformerLM(
             vocab_size=64, max_len=16, d_model=16, n_layers=2, n_heads=2,
         ))
@@ -207,7 +207,7 @@ class TestModelLossMode:
         """DistributedTransformerLMHead (the from_hf target class) loss
         mode: fused path (tie, tp=1, interpret) == CE from logits."""
         smp.reset()
-        smp.init({"microbatches": 1})
+        smp.init({"microbatches": 1, "fused_ce": True})
         m = smp.nn.DistributedTransformerLMHead(
             num_layers=2, num_attention_heads=2, attention_head_size=8,
             hidden_size=16, intermediate_size=32, vocab_size=64,
@@ -266,6 +266,152 @@ class TestModelLossMode:
             losses.append(float(out.reduce_mean()))
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0]
+
+    def test_label_smoothing_threads_through_model_loss_mode(
+        self, interpret_kernels
+    ):
+        """model(ids, targets=...) honors the module's label_smoothing on
+        BOTH dispatch paths (fused kernel and materialized logits)."""
+        from smdistributed_modelparallel_tpu.models.transformer_lm import (
+            TransformerLM,
+        )
+
+        eps = 0.1
+        ids = jax.random.randint(jax.random.key(0), (2, 12), 0, 64)
+        tgt = jnp.concatenate(
+            [ids[:, 1:], jnp.full_like(ids[:, :1], -100)], axis=1
+        )
+        per = {}
+        for mode in (True, False):
+            smp.reset()
+            smp.init({"microbatches": 1, "fused_ce": mode})
+            m = TransformerLM(vocab_size=64, max_len=16, d_model=16,
+                              n_layers=2, n_heads=2, label_smoothing=eps)
+            params = m.init(jax.random.key(1), ids)["params"]
+            per[mode] = m.apply({"params": params}, ids, targets=tgt)
+            logits = m.apply({"params": params}, ids)
+
+        # Both paths agree with each other and with the smoothed formula.
+        np.testing.assert_allclose(np.asarray(per[True]),
+                                   np.asarray(per[False]),
+                                   atol=2e-4, rtol=1e-4)
+        lg = logits[:, :-1].astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(lg, axis=-1)
+        tl = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
+        smooth = -jnp.mean(jax.nn.log_softmax(lg, axis=-1), axis=-1)
+        ref = (1.0 - eps) * (lse - tl) + eps * smooth
+        np.testing.assert_allclose(np.asarray(per[False][:, :-1]),
+                                   np.asarray(ref), atol=2e-4, rtol=1e-4)
+
+    def test_auto_blocks_shrink_for_wide_models(self):
+        """Wide D (Llama-class 4096+) must still get a fitting block
+        configuration instead of losing the kernel; explicit blocks that
+        don't fit are rejected."""
+        for D in (768, 1600, 4096, 8192):
+            blocks = pc.auto_blocks(D)
+            assert blocks is not None, f"no blocks fit for D={D}"
+            bn, bv = blocks
+            assert pc._step_bytes(D, bn, bv) <= pc._VMEM_BUDGET
+        assert pc.auto_blocks(4096, 256, 1024) is None  # doesn't fit
+        assert pc.auto_blocks(768, 256, 1024) == (256, 1024)
+        # Partial specification pins the given dim, picks the other.
+        bn, bv = pc.auto_blocks(768, block_n=64)
+        assert bn == 64 and pc._step_bytes(768, bn, bv) <= pc._VMEM_BUDGET
+        bn, bv = pc.auto_blocks(4096, block_v=256)
+        assert bv == 256 and pc._step_bytes(4096, bn, bv) <= pc._VMEM_BUDGET
+
+    def test_want_fused_ce_uses_activation_itemsize(self):
+        from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+            _want_fused_ce,
+        )
+
+        smp.reset()
+        smp.init({"microbatches": 1, "fused_ce_auto_threshold_mb": 6000})
+        # 64k x 32k logits: 8 GiB at fp32 (over), 4 GiB at bf16 (under).
+        x32 = jnp.zeros((1 << 16, 16), jnp.float32)
+        x16 = jnp.zeros((1 << 16, 16), jnp.bfloat16)
+        w = jnp.zeros((1 << 15, 16))
+        assert _want_fused_ce(x32, w)
+        assert not _want_fused_ce(x16, w)
+
+    def test_forced_fused_ce_warns_on_fallback(self, monkeypatch):
+        """fused_ce: True that cannot run logs a warning instead of
+        silently materializing logits. Pinned to the fallback branch via
+        the env kill-switch so the test also holds on a real TPU tier."""
+        import logging
+
+        monkeypatch.setenv("SMP_DISABLE_FUSED_CE", "1")
+
+        from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+            fused_lm_head_cross_entropy,
+        )
+        from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record)
+
+        smp.reset()
+        smp.init({"microbatches": 1, "fused_ce": True})
+        x, w, t = _xwt(N=24, D=16, V=64)
+        h = x.reshape(2, 12, 16)
+        tt = t.reshape(2, 12)
+        handler = Capture(level=logging.WARNING)
+        get_logger().addHandler(handler)
+        try:
+            fused_lm_head_cross_entropy(h, w, tt)
+        finally:
+            get_logger().removeHandler(handler)
+        assert any("fused_ce" in r.getMessage() for r in records)
+
+    def test_fused_ce_rejects_bad_mode(self):
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            ConfigError,
+        )
+
+        smp.reset()
+        with pytest.raises(ConfigError):
+            smp.init({"fused_ce": "always"})
+
+    def test_fused_ce_auto_policy(self):
+        """fused_ce: 'auto' is a capacity policy — small logits take the
+        materialized path (faster: the kernel's backward recompute costs
+        more than the saved HBM traffic at transformer widths); logits
+        above the threshold engage the kernel."""
+        from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+            _want_fused_ce,
+        )
+
+        small_x = jnp.zeros((64, 16))
+        big_x = jnp.zeros((1 << 16, 16))
+        w = jnp.zeros((1 << 15, 16))  # 64k x 32k bf16 logits = 4 GiB
+
+        smp.reset()
+        smp.init({"microbatches": 1})  # fused_ce defaults to auto
+        assert not _want_fused_ce(small_x, w)
+        assert _want_fused_ce(big_x, w)
+
+        smp.reset()
+        smp.init({"microbatches": 1, "fused_ce": False})
+        assert not _want_fused_ce(big_x, w)
+
+        smp.reset()
+        smp.init({"microbatches": 1, "fused_ce": True,
+                  "fused_ce_auto_threshold_mb": 1})
+        assert _want_fused_ce(small_x, w)
+
+    def test_fused_ce_auto_threshold_respected(self):
+        from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+            _want_fused_ce,
+        )
+
+        x = jnp.zeros((256, 16))
+        w = jnp.zeros((4096, 16))  # 2 MB bf16 logits
+        smp.reset()
+        smp.init({"microbatches": 1, "fused_ce_auto_threshold_mb": 1})
+        assert _want_fused_ce(x, w)
 
     def test_loss_mode_rejected_under_pp(self):
         from smdistributed_modelparallel_tpu.models.transformer_lm import (

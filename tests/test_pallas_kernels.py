@@ -66,6 +66,28 @@ class TestFlashAttention:
         ref = _naive(q, k, v)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    def test_ragged_seq_blocks_stay_lane_aligned(self):
+        """Block sizes larger than a ragged sequence clamp to the 128-
+        rounded dim (``_clamp_block``), never the raw dim: S=300 must pad
+        to one aligned 384 block and still match the reference (a raw
+        min() would hand Mosaic an unaligned 300-wide block shape)."""
+        from smdistributed_modelparallel_tpu.ops.pallas_attention import (
+            _clamp_block,
+        )
+
+        assert _clamp_block(512, 300) == 384
+        assert _clamp_block(512, 1024) == 512
+        assert _clamp_block(256, 200) == 256
+        B, T, H, hd = 1, 300, 2, 64
+        ks = jax.random.split(jax.random.key(7), 3)
+        q = jax.random.normal(ks[0], (B, T, H, hd))
+        k = jax.random.normal(ks[1], (B, T, H, hd))
+        v = jax.random.normal(ks[2], (B, T, H, hd))
+        out = _flash(q, k, v, bq=512, bk=512)
+        ref = _naive(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
     def test_gradients_match_naive(self):
         shape = (1, 128, 1, 32)
         ks = jax.random.split(jax.random.key(2), 3)
