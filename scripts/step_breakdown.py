@@ -138,6 +138,8 @@ def main():
     print(f"[B] fwd+bwd fp32 accum:     {dt*1e3:8.2f} ms")
 
     p, o = params0, opt0
+    p, o, l = full_step(p, o, ids)  # warmup: compile outside the clock
+    readback(l)
     t0 = time.perf_counter()
     for _ in range(10):
         p, o, l = full_step(p, o, ids)

@@ -136,12 +136,20 @@ def fused_lm_head_cross_entropy(hidden, embedding_table, targets,
     else:
         if want and not can and state.initialized \
                 and getattr(state.cfg, "fused_ce", "auto") is True:
+            if tp > 1:
+                why = "vocab is tp-sharded"
+            elif (block_n, block_v) != (None, None) \
+                    and pc.auto_blocks(D) is not None:
+                why = ("explicit block_n=%s/block_v=%s does not fit VMEM "
+                       "for D=%d (auto-selected blocks would — drop the "
+                       "override)" % (block_n, block_v, D))
+            else:
+                why = ("off-TPU or no block configuration fits VMEM "
+                       "for D=%d" % D)
             get_logger().warning(
                 "fused_ce: True requested but the kernel cannot run here "
                 "(%s) — materializing [%d, %d] logits instead.",
-                "vocab is tp-sharded" if tp > 1 else "off-TPU or no block "
-                "configuration fits VMEM for D=%d" % D,
-                x.shape[0], embedding_table.shape[0],
+                why, x.shape[0], embedding_table.shape[0],
             )
         logits = x @ embedding_table.T.astype(x.dtype)
         per = vocab_parallel_cross_entropy(

@@ -235,5 +235,10 @@ def _pallas_ok(q, k, v):
     on_tpu = jax.default_backend() == "tpu"
     if not on_tpu:
         return False
+    if not (q.dtype == k.dtype == v.dtype):
+        # Kernel MXU dots run on the operand dtype (no fp32 upcast), so
+        # mixed q/k/v dtypes would fail at trace time — jnp path handles
+        # them via its own promotion.
+        return False
     T, S, hd = q.shape[1], k.shape[1], q.shape[-1]
     return T >= 128 and S >= 128 and T <= 8192 and S <= 8192 and hd <= 256

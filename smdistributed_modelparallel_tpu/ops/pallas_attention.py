@@ -17,7 +17,11 @@ Supported feature surface (all combinations):
     attention masks; arbitrary [.., T, S] biases fall back to jnp);
   - dropout on the attention probabilities, replayed exactly in the
     backward via a counter-based hash RNG (no [T, S] mask materialized);
-  - fp32 score math always (subsumes ``attention_in_fp32``).
+  - fp32 score math always (subsumes ``attention_in_fp32``): MXU dots run
+    on the input dtype with fp32 accumulation (exact for bf16 inputs) and
+    masking/softmax/rescaling stay fp32; fp32 probability/gradient tiles
+    are rounded to the operand dtype for the second-stage dots (standard
+    flash practice — keeps every matmul at native MXU throughput).
 
 Backward: two passes — dq (grid over q blocks, kv streamed) and dk/dv
 (grid over kv blocks, q streamed) — using the forward's saved per-row
@@ -169,7 +173,12 @@ def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
 
     b = pl.program_id(0)
     i = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, hd]
+    # MXU operands stay in their input dtype (bf16 on the training path):
+    # the v5e MXU does bf16 x bf16 -> fp32-accumulate natively, while fp32
+    # matmuls decompose into multiple passes. bf16 products accumulated in
+    # fp32 are exact, so post-scaling the fp32 scores keeps score math fp32
+    # (N8 parity) at native throughput.
+    q = q_ref[0]                                      # [bq, hd]
     hd = q.shape[-1]
     q_offset = i * block_q
     if has_ids:
@@ -178,12 +187,14 @@ def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
 
     def compute(j, carry):
         acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                              # [bq, bk]
+        if scale != 1.0:
+            s = s * scale
         rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if kpm_ref is not None:
@@ -210,7 +221,7 @@ def _fwd_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
                                   s_total, rate)
             p = jnp.where(dkeep, p, 0.0)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return acc_new, m_new, l_new
@@ -266,8 +277,8 @@ def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
 
     b = pl.program_id(0)
     i = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, hd]
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]                                      # [bq, hd] input dtype
+    do = do_ref[0]
     lse = lse_ref[0, 0, :][:, None]                   # [bq, 1]
     delta = delta_ref[0, 0, :][:, None]
     q_offset = i * block_q
@@ -277,12 +288,14 @@ def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
         r_max = _ids_rmax(qid_ref, q_offset, block_q, q_len)
 
     def compute(j, dq_acc):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if scale != 1.0:
+            s = s * scale
         rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if kpm_ref is not None:
@@ -309,7 +322,7 @@ def _bwd_dq_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
             dp = jnp.where(dkeep, dp * inv_keep, 0.0)
         ds = p * (dp - delta) * scale                 # d(q.k^T)
         return dq_acc + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -349,8 +362,8 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
 
     b = pl.program_id(0)
     j = pl.program_id(1)
-    k_blk = k_ref[0].astype(jnp.float32)              # [bk, hd]
-    v_blk = v_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0]                                  # [bk, hd] input dtype
+    v_blk = v_ref[0]
     k_offset = j * block_k
     inv_keep = 1.0 / (1.0 - rate) if rate > 0.0 else 1.0
     # kpm is indexed per kv block here (the block is this program's slice).
@@ -363,14 +376,16 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
 
     def compute(i, carry):
         dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)][:, None]
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                              # [bq, bk]
+        if scale != 1.0:
+            s = s * scale
         rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         if kpm_blk is not None:
@@ -399,12 +414,12 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
         else:
             p_drop = p
         dv_acc = dv_acc + jax.lax.dot_general(
-            p_drop, do_blk, (((0,), (0,)), ((), ())),
+            p_drop.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                              # [bk, hd]
         ds = p * (dp - delta) * scale
         dk_acc = dk_acc + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return dk_acc, dv_acc
@@ -429,9 +444,9 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
     hd = k_blk.shape[-1]
     z = jnp.zeros((block_k, hd), jnp.float32)
     dk, dv = jax.lax.fori_loop(lo, hi, body, (z, z))
-    # ds carries one *scale (the dq factor); dk = ds^T.q needs the raw q,
-    # but q_blk is pre-scaled — undo the extra factor once per tile.
-    dk_ref[0] = (dk / scale).astype(dk_ref.dtype)
+    # ds carries exactly one *scale factor and q_blk is raw (unscaled), so
+    # dk = ds^T.q is already correct.
+    dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 

@@ -112,6 +112,18 @@ class TestFlashAttention:
         out = attention_core(q, k, v, causal=True, use_pallas=True)
         assert np.isfinite(np.asarray(out)).all()
 
+    def test_mixed_dtype_qkv_falls_back_to_jnp(self, monkeypatch):
+        # Kernel MXU dots run on the operand dtype, so mixed q/k/v dtypes
+        # must not dispatch to Pallas (the bwd dO.V^T dot would trace with
+        # mismatched operands). Pretend we're on TPU to exercise the gate.
+        import smdistributed_modelparallel_tpu.ops.attention as att
+
+        monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+        q = jnp.ones((1, 128, 1, 64), jnp.bfloat16)
+        v = jnp.ones((1, 128, 1, 64), jnp.float32)
+        assert not att._pallas_ok(q, q, v)
+        assert att._pallas_ok(q, q, q)
+
 
 def _rand_qkv(key, qshape, kvshape=None):
     ks = jax.random.split(key, 3)
