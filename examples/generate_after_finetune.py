@@ -1,0 +1,90 @@
+"""Train-then-sample: fine-tune a GPT-2-style LM under tp2, then generate
+continuations with the KV-cache decode path (``smp.generate``).
+
+Generation is a TPU extension beyond the reference (a training library):
+prefill + every decode step compile into ONE program (no per-token host
+round trips), and the same tensor-parallel sharding that trained the
+weights serves them.
+    python examples/generate_after_finetune.py
+"""
+
+import os
+import sys
+
+if not os.environ.get("SMP_EXAMPLE_ON_TPU"):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if not os.environ.get("SMP_EXAMPLE_ON_TPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.models.gpt2 import gpt2
+
+
+def main():
+    smp.init({"tensor_parallel_degree": 2, "microbatches": 2})
+    print(f"mesh: {dict(smp.get_mesh().shape)}")
+
+    vocab, seq = 257, 32
+    model = smp.DistributedModel(
+        gpt2(vocab_size=vocab, max_len=64, d_model=64, n_layers=2, n_heads=4)
+    )
+    optimizer = smp.DistributedOptimizer(optax.adamw(3e-3), model)
+
+    # A toy skill for the model to learn: arithmetic-sequence continuation
+    # (row i is i, i+d, i+2d, ... mod vocab).
+    rng = np.random.default_rng(0)
+
+    def batch(n=8):
+        start = rng.integers(0, vocab, size=(n, 1))
+        delta = rng.integers(1, 7, size=(n, 1))
+        return (start + delta * np.arange(seq)[None, :]) % vocab
+
+    @smp.step
+    def train_step(model, ids):
+        logits = model(ids)
+        tgt = ids[:, 1:]
+        lse = jax.scipy.special.logsumexp(
+            logits[:, :-1].astype(jnp.float32), axis=-1
+        )
+        picked = jnp.take_along_axis(
+            logits[:, :-1], tgt[:, :, None], axis=-1
+        )[..., 0].astype(jnp.float32)
+        loss = jnp.mean(lse - picked)
+        model.backward(loss)
+        return loss
+
+    for it in range(60):
+        loss = train_step(model, jnp.asarray(batch())).reduce_mean()
+        if it % 20 == 0:
+            print(f"step {it:3d}  loss {float(loss):.4f}")
+
+    # Greedy continuation of fresh arithmetic prompts.
+    prompts = jnp.asarray(batch(4)[:, :8])
+    out = np.asarray(model.generate(prompts, 8))
+    expect = batch  # noqa: F841 - see check below
+    correct = 0
+    for row in range(4):
+        d = (out[row, 1] - out[row, 0]) % vocab
+        want = (out[row, 7] + d * np.arange(1, 9)) % vocab
+        correct += int(np.array_equal(out[row, 8:], want))
+    print(f"greedy continuations correct for {correct}/4 prompts")
+    print("sampled:", np.asarray(
+        model.generate(prompts, 8, temperature=0.8, top_k=20,
+                       rng=jax.random.key(0))
+    )[0, 8:])
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,7 @@ from smdistributed_modelparallel_tpu.nn.tp_registry import (
     tp_register_with_module,
 )
 from smdistributed_modelparallel_tpu.nn.huggingface import from_hf
+from smdistributed_modelparallel_tpu.generation import generate
 from smdistributed_modelparallel_tpu.utils.data import (
     dataloader,
     prefetch_to_device,
@@ -99,6 +100,9 @@ def shutdown():
 
 def reset():
     """Testing hook: drop model/optimizer/step registrations."""
+    from smdistributed_modelparallel_tpu.generation import _COMPILED
+
+    _COMPILED.clear()
     state.reset()
 
 

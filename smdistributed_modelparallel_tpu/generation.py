@@ -1,0 +1,282 @@
+"""Autoregressive generation with KV caches — ``smp.generate``.
+
+TPU extension (no reference counterpart): the reference
+(``smdistributed.modelparallel``) is a training library; its users sample
+from fine-tuned models by exporting to HF. A complete switch-over needs
+generation in-framework: this module drives the attention layers' decode
+mode (``nn/utils.DecodeKVCache``) as one compiled program — a prefill pass
+over the prompt (full flash-attention fast path) followed by a
+``lax.scan`` of single-token decode steps, with greedy / temperature /
+top-k / top-p sampling and per-row EOS early-stop masking.
+
+Design notes (TPU-first):
+- The whole generation (prefill + all decode steps) is ONE jitted
+  program: no per-token host round trips, and XLA keeps the cache update
+  (``dynamic_update_slice`` on a scan carry) in place.
+- Under tensor parallelism nothing changes here: the decode forward runs
+  the same TP-sharded layers; GSPMD shards the [B, C, H, hd] caches over
+  the head axis exactly like the activations they buffer.
+- Generation requires ``pp == 1`` (the pipeline head protocol has no
+  decode path); tp/dp/fsdp meshes are fine.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils.exceptions import SMPValidationError
+
+# Compiled-generator cache: flax modules are frozen dataclasses (hashable
+# when their fields are), so (module, shapes, sampling config) keys a
+# ready program across repeated generate() calls.
+_COMPILED = {}
+
+
+def _top_k_filter(logits, top_k):
+    top_k = min(top_k, logits.shape[-1])  # HF convention: clamp to vocab
+    kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+    return jnp.where(logits >= kth, logits, -jnp.inf)
+
+
+def _top_p_filter(logits, top_p):
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens whose cumulative probability BEFORE them is < top_p
+    # (always keeps the most likely token).
+    keep = (cum - probs) < top_p
+    thresh = jnp.min(
+        jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= thresh, logits, -jnp.inf)
+
+
+def _make_sampler(temperature, top_k, top_p):
+    if temperature == 0.0:
+        return lambda logits, rng: jnp.argmax(logits, axis=-1)
+
+    def sample(logits, rng):
+        logits = logits / temperature
+        if top_k is not None:
+            logits = _top_k_filter(logits, top_k)
+        if top_p is not None:
+            logits = _top_p_filter(logits, top_p)
+        return jax.random.categorical(rng, logits, axis=-1)
+
+    return sample
+
+
+def _decode_clone(module, cache_len):
+    try:
+        return module.clone(
+            decode=True, decode_cache_len=cache_len, deterministic=True
+        )
+    except TypeError as e:
+        raise SMPValidationError(
+            f"{type(module).__name__} does not support KV-cache decoding "
+            "(needs decode/decode_cache_len/deterministic fields — the "
+            "TransformerLM zoo family and smp.nn DistributedTransformerLMHead "
+            "do)."
+        ) from e
+
+
+def _decode_loop(apply_step, prefill_out, max_new_tokens,
+                 sampler, eos_token_id, pad_token_id, rng):
+    """Shared sample-feed-sample loop after a prefill: returns the
+    [B, max_new_tokens] generated ids."""
+    logits, cache = prefill_out
+    B = logits.shape[0]
+    rngs = jax.random.split(rng, max_new_tokens)
+    tok = sampler(logits[:, -1].astype(jnp.float32), rngs[0])
+    done = jnp.zeros((B,), bool)
+    if eos_token_id is not None:
+        done = tok == eos_token_id
+
+    def body(carry, step_rng):
+        cache, tok, done = carry
+        logits, cache = apply_step(cache, tok[:, None])
+        nxt = sampler(logits[:, -1].astype(jnp.float32), step_rng)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, pad_token_id, nxt)
+            new_done = done | (nxt == eos_token_id)
+        else:
+            new_done = done
+        return (cache, nxt, new_done), nxt
+
+    (_, _, _), rest = jax.lax.scan(body, (cache, tok, done), rngs[1:])
+    return jnp.concatenate([tok[:, None], rest.transpose(1, 0)], axis=1)
+
+
+def _build_generator(decode_mod, max_new_tokens, sampler, eos_token_id,
+                     pad_token_id):
+    """Decoder-only generation body: (params, ids, rng) -> [B, total] ids."""
+
+    def run(params, ids, rng):
+        logits, mut = decode_mod.apply(
+            {"params": params}, ids, mutable=["cache"]
+        )
+
+        def apply_step(cache, tok):
+            logits, mut = decode_mod.apply(
+                {"params": params, "cache": cache}, tok, mutable=["cache"]
+            )
+            return logits, mut["cache"]
+
+        new_tokens = _decode_loop(
+            apply_step, (logits, mut["cache"]), max_new_tokens,
+            sampler, eos_token_id, pad_token_id, rng,
+        ).astype(ids.dtype)
+        return jnp.concatenate([ids, new_tokens], axis=1)
+
+    return run
+
+
+def _build_seq2seq_generator(decode_mod, max_new_tokens, sampler,
+                             eos_token_id, pad_token_id,
+                             decoder_start_token_id):
+    """Seq2seq generation body: encode once, KV-cached decoder steps.
+    (params, encoder_ids, encoder_mask, rng) -> [B, 1 + max_new] decoder
+    ids (start token first, HF ``generate`` convention)."""
+
+    def run(params, enc_ids, enc_mask, rng):
+        B = enc_ids.shape[0]
+        h_e, _ = decode_mod.apply(
+            {"params": params}, enc_ids, enc_mask,
+            method="encode", mutable=["cache"],
+        )
+        start = jnp.full((B, 1), decoder_start_token_id, enc_ids.dtype)
+        logits, mut = decode_mod.apply(
+            {"params": params}, start, h_e, enc_mask,
+            method="decode_step", mutable=["cache"],
+        )
+
+        def apply_step(cache, tok):
+            logits, mut = decode_mod.apply(
+                {"params": params, "cache": cache}, tok, h_e, enc_mask,
+                method="decode_step", mutable=["cache"],
+            )
+            return logits, mut["cache"]
+
+        new_tokens = _decode_loop(
+            apply_step, (logits, mut["cache"]), max_new_tokens,
+            sampler, eos_token_id, pad_token_id, rng,
+        ).astype(enc_ids.dtype)
+        return jnp.concatenate([start, new_tokens], axis=1)
+
+    return run
+
+
+def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
+             top_k=None, top_p=None, eos_token_id=None, pad_token_id=0,
+             rng=None, params=None, encoder_mask=None,
+             decoder_start_token_id=0):
+    """Generate ``max_new_tokens`` continuation tokens for each prompt.
+
+    Args:
+      model: a ``DistributedModel`` wrapping a decode-capable LM (the
+        ``TransformerLM`` zoo family, ``smp.nn.DistributedTransformerLMHead``,
+        the ``EncoderDecoderLM`` seq2seq family, or an
+        ``smp.from_hf``-translated causal/seq2seq LM), or such a flax
+        module directly (then ``params`` is required).
+      input_ids: [B, T] int prompt tokens — the ENCODER input for a
+        seq2seq model. Decoder-only prompts are taken as unpadded (same
+        true length per row); pad/trim on the host beforehand.
+      max_new_tokens: number of tokens to append.
+      temperature: 0.0 = greedy argmax (default); > 0 samples.
+      top_k / top_p: optional sampling filters (compose: k then p).
+      eos_token_id: when set, rows that emit EOS are frozen and padded
+        with ``pad_token_id`` for the remaining steps.
+      rng: ``jax.random`` key for sampling (required when temperature > 0).
+      params: parameter tree override (defaults to the model's).
+      encoder_mask: seq2seq only — [B, S] encoder padding mask (1/True =
+        keep), forwarded to cross-attention.
+      decoder_start_token_id: seq2seq only — the decoder's BOS.
+
+    Returns:
+      Decoder-only: [B, T + max_new_tokens] — prompts with continuations.
+      Seq2seq: [B, 1 + max_new_tokens] — start token + generated ids.
+    """
+    if state.cfg is not None and state.cfg.pipeline_parallel_degree > 1:
+        raise SMPValidationError(
+            "smp.generate requires pipeline_parallel_degree == 1 "
+            "(tp/dp/fsdp are supported)."
+        )
+    if max_new_tokens < 1:
+        raise SMPValidationError("max_new_tokens must be >= 1.")
+    input_ids = jnp.asarray(input_ids)
+    if hasattr(model, "module"):  # DistributedModel
+        module = model.module
+        seq2seq = hasattr(module, "encode") and hasattr(module, "decode_step")
+        if params is None:
+            if model.params is None:
+                init_args = (
+                    (input_ids, input_ids[:, :1]) if seq2seq else (input_ids,)
+                )
+                model._eager_init(init_args, {})
+            params = model.params
+    else:
+        module = model
+        seq2seq = hasattr(module, "encode") and hasattr(module, "decode_step")
+        if params is None:
+            raise SMPValidationError(
+                "generate(flax_module, ...) requires params=..."
+            )
+    if temperature > 0.0 and rng is None:
+        raise SMPValidationError("temperature > 0 requires rng=jax.random.key(...)")
+    if rng is None:
+        rng = jax.random.key(0)
+
+    B, T = input_ids.shape
+    cache_len = (1 + max_new_tokens) if seq2seq else (T + max_new_tokens)
+    limit = getattr(module, "max_len", None) or getattr(
+        module, "num_positions", None
+    )
+    if limit is not None and cache_len > limit:
+        raise SMPValidationError(
+            f"{'decoder length' if seq2seq else 'prompt'} + max_new_tokens "
+            f"({cache_len}) exceeds the model's position limit ({limit})."
+        )
+    if limit is not None and seq2seq and T > limit:
+        raise SMPValidationError(
+            f"encoder prompt length ({T}) exceeds the model's position "
+            f"limit ({limit})."
+        )
+
+    has_mask = encoder_mask is not None
+    key = None
+    try:
+        # The mesh is part of the key: sharding constraints traced into the
+        # program bind the mesh active at trace time (smp.reset + re-init
+        # with a different mesh must not reuse a stale program).
+        key = (module, B, T, max_new_tokens, float(temperature), top_k,
+               top_p, eos_token_id, pad_token_id, decoder_start_token_id,
+               has_mask, state.mesh if state.initialized else None)
+        compiled = _COMPILED.get(key)
+    except TypeError:  # unhashable module fields: compile uncached
+        key = None
+        compiled = None
+    if compiled is None:
+        decode_mod = _decode_clone(module, cache_len)
+        sampler = _make_sampler(float(temperature), top_k, top_p)
+        if seq2seq:
+            run = _build_seq2seq_generator(
+                decode_mod, max_new_tokens, sampler, eos_token_id,
+                pad_token_id, decoder_start_token_id,
+            )
+        else:
+            run = _build_generator(decode_mod, max_new_tokens, sampler,
+                                   eos_token_id, pad_token_id)
+        compiled = jax.jit(run)
+        if key is not None:
+            _COMPILED[key] = compiled
+
+    args = (
+        (params, input_ids, encoder_mask, rng) if seq2seq
+        else (params, input_ids, rng)
+    )
+    mesh = state.mesh if state.initialized else None
+    if mesh is not None:
+        with jax.set_mesh(mesh):
+            return compiled(*args)
+    return compiled(*args)

@@ -496,6 +496,13 @@ class DistributedModel:
     # train / eval mode (dropout etc. is explicit in flax; kept for parity)
     # ------------------------------------------------------------------
 
+    def generate(self, input_ids, max_new_tokens, **kwargs):
+        """Autoregressive sampling via the KV-cache decode path; see
+        ``smp.generate`` (``generation.py``)."""
+        from smdistributed_modelparallel_tpu.generation import generate
+
+        return generate(self, input_ids, max_new_tokens, **kwargs)
+
     def train(self):
         self._train = True
         return self

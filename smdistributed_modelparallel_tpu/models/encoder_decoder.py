@@ -99,6 +99,11 @@ class EncoderDecoderLM(nn.Module):
     relative_attention_num_buckets: int = 32
     relative_attention_max_distance: int = 128
     layernorm_epsilon: float = 1e-5
+    # KV-cache decoding for smp.generate: applies to the DECODER stack
+    # only (self-attn caches grow; cross-attn K/V compute once). The
+    # encoder is cache-free. See nn/utils.DecodeKVCache, generation.py.
+    decode: bool = False
+    decode_cache_len: Optional[int] = None
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -189,8 +194,16 @@ class EncoderDecoderLM(nn.Module):
             num_layers=self.dec_layers,
             causal_mask_size=self.max_len,  # causal
             add_cross_attention=True,
+            decode=self.decode,
+            decode_cache_len=self.decode_cache_len,
             name="decoder", **common,
         )
+        if self.decode:
+            # Absolute decoder position across decode steps (drives the
+            # learned position embedding / relative bias row offsets).
+            self._dec_pos = self.variable(
+                "cache", "decoder_position", lambda: jnp.zeros((), jnp.int32)
+            )
         self.decoder_ln = DistributedLayerNorm(
             epsilon=self.layernorm_epsilon, rms=rms, use_bias=not rms,
             name="decoder_ln",
@@ -237,21 +250,7 @@ class EncoderDecoderLM(nn.Module):
         encoder (tp/dp/cp-parallel; replicated across pp stages), and the
         decoder carry (hidden, cross_states, (self_mask, cross_mask))."""
         pad = self._pad4d(encoder_mask)
-        if self.t5_compat:
-            S = encoder_ids.shape[-1]
-            enc_mask = self._rel_bias(self.enc_rel_bias, S, S, True)
-            if pad is not None:
-                enc_mask = enc_mask + pad
-            h_e = self.shared_embedding(encoder_ids)
-        else:
-            enc_mask = pad
-            pos_e = jnp.arange(encoder_ids.shape[-1])[None, :]
-            h_e = (
-                self.shared_embedding(encoder_ids)
-                + self.enc_position_embedding(pos_e)
-            )
-        h_e = self.encoder(h_e, attention_mask=enc_mask)
-        h_e = self.encoder_ln(h_e)
+        h_e = self.encode(encoder_ids, encoder_mask)
 
         if self.t5_compat:
             T = decoder_ids.shape[-1]
@@ -290,6 +289,67 @@ class EncoderDecoderLM(nn.Module):
     def __call__(self, encoder_ids, decoder_ids, encoder_mask=None):
         h_d, h_e, masks = self.embed(encoder_ids, decoder_ids, encoder_mask)
         h_d = self.decoder(h_d, cross_states=h_e, attention_mask=masks)
+        return self.head(h_d)
+
+    # -- generation protocol (smp.generate seq2seq branch) --------------
+
+    def encode(self, encoder_ids, encoder_mask=None):
+        """Encoder forward only — run ONCE per generation."""
+        pad = self._pad4d(encoder_mask)
+        if self.t5_compat:
+            S = encoder_ids.shape[-1]
+            enc_mask = self._rel_bias(self.enc_rel_bias, S, S, True)
+            if pad is not None:
+                enc_mask = enc_mask + pad
+            h_e = self.shared_embedding(encoder_ids)
+        else:
+            enc_mask = pad
+            pos_e = jnp.arange(encoder_ids.shape[-1])[None, :]
+            h_e = (
+                self.shared_embedding(encoder_ids)
+                + self.enc_position_embedding(pos_e)
+            )
+        return self.encoder_ln(self.encoder(h_e, attention_mask=enc_mask))
+
+    def decode_step(self, decoder_ids, encoder_hidden, encoder_mask=None):
+        """One KV-cached decoder chunk (requires ``decode=True``): embeds
+        ``decoder_ids`` at the absolute cache position, runs the decoder
+        over the cache, returns logits for the chunk."""
+        pad = self._pad4d(encoder_mask)
+        T = decoder_ids.shape[-1]
+        start = self._dec_pos.value
+        self._dec_pos.value = start + T
+        if self.t5_compat:
+            # Relative bias rows for the chunk's absolute positions. A T=1
+            # step attends the full cache (the layer ANDs in the <=index
+            # mask); a T>1 chunk (first call, empty cache) attends itself
+            # chunk-causally — columns are the chunk's own positions.
+            ctx = start + jnp.arange(T)[:, None]
+            if T > 1:
+                mem = start + jnp.arange(T)[None, :]
+            else:
+                mem = jnp.arange(self.decode_cache_len)[None, :]
+            buckets = relative_position_bucket(
+                mem - ctx, bidirectional=False,
+                num_buckets=self.relative_attention_num_buckets,
+                max_distance=self.relative_attention_max_distance,
+            )
+            dec_mask = (
+                self.dec_rel_bias(buckets).transpose(2, 0, 1)[None]
+                .astype(jnp.float32)
+            )
+            h_d = self.shared_embedding(decoder_ids)
+        else:
+            dec_mask = None
+            pos_d = start + jnp.arange(T)[None, :]
+            h_d = (
+                self.shared_embedding(decoder_ids)
+                + self.dec_position_embedding(pos_d)
+            )
+        masks = (dec_mask, pad) if (dec_mask is not None or pad is not None) else None
+        h_d = self.decoder(
+            h_d, cross_states=encoder_hidden, attention_mask=masks
+        )
         return self.head(h_d)
 
     @nn.nowrap

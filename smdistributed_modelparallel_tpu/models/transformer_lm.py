@@ -36,6 +36,14 @@ class CausalSelfAttention(nn.Module):
     Parity: reference ``DistributedAttentionLayer``
     (``torch/nn/transformer.py:1176-1835``); TP sharding lands in M3 via
     sharding constraints on the head dimension.
+
+    ``decode=True`` enables the KV-cache path for autoregressive
+    generation (TPU extension, ``generation.py``): K/V of every chunk are
+    written into fixed-length "cache" variables of ``decode_cache_len``
+    slots; a T=1 call attends over the cache (prior positions only), a
+    T>1 call is the prefill and attends causally over its own chunk (the
+    cache is empty before it, so chunk-causal == cache semantics — and it
+    keeps the flash-kernel path for the prompt pass).
     """
 
     d_model: int
@@ -46,6 +54,8 @@ class CausalSelfAttention(nn.Module):
     rotary_dim: Optional[int] = None
     window: Optional[int] = None
     deterministic: bool = True
+    decode: bool = False
+    decode_cache_len: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, attn_bias=None):
@@ -57,11 +67,25 @@ class CausalSelfAttention(nn.Module):
         q = q.reshape(B, T, H, hd)
         k = k.reshape(B, T, H, hd)
         v = v.reshape(B, T, H, hd)
+
+        pos_offset = 0
+        cache = None
+        decode_mask = None
+        if self.decode:
+            from smdistributed_modelparallel_tpu.nn.utils import DecodeKVCache
+
+            cache = DecodeKVCache(self, (B, self.decode_cache_len, H, hd),
+                                  k.dtype)
+            pos_offset = cache.index
         if self.rotary:
             from smdistributed_modelparallel_tpu.nn.transformer import apply_rotary
 
             rd = self.rotary_dim or hd
-            q, k = apply_rotary(q, k, rd, neox_style=True)
+            # The cache stores POST-rotary K: chunk q/k rotate at their
+            # absolute positions once, on write.
+            q, k = apply_rotary(q, k, rd, neox_style=True, offset=pos_offset)
+        if cache is not None:
+            k, v, decode_mask = cache.append(k, v, window=self.window)
         from smdistributed_modelparallel_tpu.ops.attention import attention_core
 
         drop_rng = None
@@ -69,9 +93,10 @@ class CausalSelfAttention(nn.Module):
             drop_rng = self.make_rng("dropout")
         out = attention_core(
             q, k, v,
-            causal=True,
-            window=self.window,
+            causal=decode_mask is None,
+            window=self.window if decode_mask is None else None,
             bias=attn_bias,
+            mask=decode_mask,
             attention_in_fp32=self.attention_in_fp32,
             dropout_rate=self.dropout if not self.deterministic else 0.0,
             dropout_rng=drop_rng,
@@ -95,12 +120,15 @@ class TransformerLayer(nn.Module):
     parallel_block: bool = False  # GPT-J style parallel attn+mlp
     deterministic: bool = True
     ln_eps: float = 1e-5
+    decode: bool = False
+    decode_cache_len: Optional[int] = None
 
     @nn.compact
     def __call__(self, x):
         attn = CausalSelfAttention(
             self.d_model, self.n_heads, self.dropout, self.attention_in_fp32,
             self.rotary, self.rotary_dim, self.window, self.deterministic,
+            self.decode, self.decode_cache_len,
             name="attn",
         )
 
@@ -157,6 +185,9 @@ class TransformerLM(nn.Module):
     ln_eps: float = 1e-5
     # Loss-mode (targets=...) uniform label smoothing, HF/T5 convention.
     label_smoothing: float = 0.0
+    # KV-cache decoding for smp.generate (see nn/utils.DecodeKVCache).
+    decode: bool = False
+    decode_cache_len: Optional[int] = None
 
     @nn.nowrap
     def _layer_kwargs(self):
@@ -172,6 +203,8 @@ class TransformerLM(nn.Module):
             parallel_block=self.parallel_block,
             deterministic=self.deterministic,
             ln_eps=self.ln_eps,
+            decode=self.decode,
+            decode_cache_len=self.decode_cache_len,
         )
 
     def setup(self):
@@ -180,7 +213,7 @@ class TransformerLM(nn.Module):
             self.wpe = nn.Embed(self.max_len, self.d_model, name="wpe")
         ScanLayers = nn.scan(
             _ScanBody,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True, "dropout": True},
             length=self.n_layers,
         )
@@ -188,13 +221,23 @@ class TransformerLM(nn.Module):
         self.ln_f = nn.LayerNorm(epsilon=self.ln_eps, name="ln_f")
         if not self.tie_weights:
             self.lm_head = nn.Dense(self.vocab_size, use_bias=False, name="lm_head")
+        if self.decode:
+            # Top-level mirror of the per-layer cache indices: learned
+            # positions need the absolute offset before the layer stack.
+            self._pos_index = self.variable(
+                "cache", "position_index", lambda: jnp.zeros((), jnp.int32)
+            )
 
     # -- pipeline decomposition ----------------------------------------
 
     def embed(self, ids):
         x = self.wte(ids)
         if self.pos_type == "learned":
-            x = x + self.wpe(jnp.arange(ids.shape[-1])[None, :])
+            start = 0
+            if self.decode:
+                start = self._pos_index.value
+                self._pos_index.value = start + ids.shape[-1]
+            x = x + self.wpe(start + jnp.arange(ids.shape[-1])[None, :])
         return x
 
     def head(self, x, targets=None):

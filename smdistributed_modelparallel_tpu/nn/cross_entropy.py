@@ -136,16 +136,21 @@ def fused_lm_head_cross_entropy(hidden, embedding_table, targets,
     else:
         if want and not can and state.initialized \
                 and getattr(state.cfg, "fused_ce", "auto") is True:
+            import os
+
             if tp > 1:
                 why = "vocab is tp-sharded"
+            elif os.environ.get("SMP_DISABLE_FUSED_CE", "0") == "1":
+                why = "SMP_DISABLE_FUSED_CE=1 is set"
+            elif jax.default_backend() != "tpu":
+                why = "not running on a TPU backend"
             elif (block_n, block_v) != (None, None) \
                     and pc.auto_blocks(D) is not None:
                 why = ("explicit block_n=%s/block_v=%s does not fit VMEM "
                        "for D=%d (auto-selected blocks would — drop the "
                        "override)" % (block_n, block_v, D))
             else:
-                why = ("off-TPU or no block configuration fits VMEM "
-                       "for D=%d" % D)
+                why = "no block configuration fits VMEM for D=%d" % D
             get_logger().warning(
                 "fused_ce: True requested but the kernel cannot run here "
                 "(%s) — materializing [%d, %d] logits instead.",

@@ -90,11 +90,13 @@ def _init(range_, use_normal=True):
     return nn.initializers.normal(stddev=range_)
 
 
-def apply_rotary(q, k, rotary_dim, base=10000.0, neox_style=False):
+def apply_rotary(q, k, rotary_dim, base=10000.0, neox_style=False, offset=0):
     """Rotary position embedding on the first ``rotary_dim`` channels.
 
     Parity: reference ``torch/nn/transformer.py:114-183`` — interleaved
     (GPT-J) vs half-split (``gpt_neox_type_rotary``) variants.
+    ``offset`` (int or traced scalar) shifts the absolute positions —
+    decode steps rotate the current chunk at its cache position.
     """
 
     def rot(x):
@@ -103,7 +105,7 @@ def apply_rotary(q, k, rotary_dim, base=10000.0, neox_style=False):
         x_rot, x_pass = x[..., :d], x[..., d:]
         half = d // 2
         freqs = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-        t = jnp.arange(T, dtype=jnp.float32)
+        t = offset + jnp.arange(T, dtype=jnp.float32)
         angles = jnp.einsum("t,f->tf", t, freqs)
         cos = jnp.cos(angles)[None, :, None, :]
         sin = jnp.sin(angles)[None, :, None, :]
@@ -154,6 +156,11 @@ class DistributedAttentionLayer(nn.Module):
     rotary_emb_base: Optional[float] = None
     gpt_neox_type_rotary: bool = False
     window_size: Optional[int] = None
+    # KV-cache decoding for smp.generate (nn/utils.DecodeKVCache); only
+    # self-attention caches (cross-attention K/V are recomputed from the
+    # encoder states passed each step).
+    decode: bool = False
+    decode_cache_len: Optional[int] = None
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -180,10 +187,6 @@ class DistributedAttentionLayer(nn.Module):
                 dtype,
             )
             q = jnp.einsum("btd,dhk->bthk", hidden, q_kernel.astype(hidden.dtype))
-            kv = jnp.einsum(
-                "bsd,dchk->bcshk", cross_states, kv_kernel.astype(hidden.dtype)
-            )
-            k, v = kv[:, 0], kv[:, 1]
             if self.use_qkv_bias:
                 q_bias = self.param(
                     "query/bias", partitioned(nn.initializers.zeros, (TP_AXIS, None)),
@@ -195,8 +198,24 @@ class DistributedAttentionLayer(nn.Module):
                     (2, H, hd), dtype,
                 )
                 q = q + q_bias.astype(q.dtype)
-                k = k + kv_bias[0].astype(k.dtype)
-                v = v + kv_bias[1].astype(v.dtype)
+
+            def cross_kv():
+                kv = jnp.einsum(
+                    "bsd,dchk->bcshk", cross_states,
+                    kv_kernel.astype(hidden.dtype),
+                )
+                if self.use_qkv_bias:
+                    kv = kv + kv_bias[:, None].astype(kv.dtype)
+                return kv
+
+            if self.decode:
+                # Encoder K/V are the same every decode step: computed once
+                # when the cache variable is created (flax only runs the
+                # init closure when the variable is missing), then reused.
+                kv = self.variable("cache", "cross_kv", cross_kv).value
+            else:
+                kv = cross_kv()
+            k, v = kv[:, 0], kv[:, 1]
         else:
             qkv_kernel = self.param(
                 "qkv/kernel",
@@ -222,12 +241,46 @@ class DistributedAttentionLayer(nn.Module):
         k = shard_activation(k, *head_spec)
         v = shard_activation(v, *head_spec)
 
+        cache = None
+        pos_offset = 0
+        decode_mask = None
+        if self.decode and not self.cross_attention:
+            from smdistributed_modelparallel_tpu.nn.utils import DecodeKVCache
+
+            if self.causal_mask_size is None:
+                raise SMPValidationError(
+                    "decode=True requires causal self-attention "
+                    "(causal_mask_size set); BERT-family encoders do not "
+                    "decode."
+                )
+            cache = DecodeKVCache(
+                self, (B, self.decode_cache_len, H, hd), k.dtype
+            )
+            pos_offset = cache.index
+
         if self.rotary_dim is not None and not self.cross_attention:
+            # The cache stores POST-rotary K: chunk q/k rotate once at
+            # their absolute (cache-slot) positions.
             q, k = apply_rotary(
                 q, k, self.rotary_dim,
                 base=self.rotary_emb_base or 10000.0,
                 neox_style=self.gpt_neox_type_rotary,
+                offset=pos_offset,
             )
+
+        if cache is not None:
+            k, v, decode_mask = cache.append(k, v, window=self.window_size)
+            if decode_mask is not None:
+                # Combine with a caller mask (e.g. the T5 relative-position
+                # bias, additive [1, H, 1, cache_len] for this step's row).
+                if attention_mask is None:
+                    attention_mask = decode_mask
+                elif attention_mask.dtype == jnp.bool_:
+                    attention_mask = attention_mask & decode_mask
+                else:
+                    attention_mask = attention_mask + jnp.where(
+                        decode_mask, 0.0, self.mask_value
+                    ).astype(attention_mask.dtype)
 
         scale = 1.0 / np.sqrt(hd) if self.scale_attention_scores else 1.0
         extra_scale = None
@@ -246,8 +299,13 @@ class DistributedAttentionLayer(nn.Module):
         local_select = None if xs is None else xs.get("is_local")
         # Causal iff a causal-mask size is configured (reference: GPT-family
         # hooks set causal_mask_size; BERT-family leave it None and mask via
-        # attention_mask only).
-        causal = self.causal_mask_size is not None and not self.cross_attention
+        # attention_mask only). A decode step replaces causal/window with
+        # the explicit cache mask (positions <= cache index, banded).
+        causal = (
+            self.causal_mask_size is not None
+            and not self.cross_attention
+            and decode_mask is None
+        )
         dropout_rng = (
             None
             if resolve_deterministic(self.deterministic)
@@ -257,7 +315,7 @@ class DistributedAttentionLayer(nn.Module):
         ctx = attention_core(
             q, k, v,
             causal=causal,
-            window=self.window_size,
+            window=self.window_size if decode_mask is None else None,
             local_select=local_select,
             scale=scale,
             extra_scale=extra_scale,
@@ -401,6 +459,8 @@ class DistributedTransformerLayer(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    decode: bool = False
+    decode_cache_len: Optional[int] = None
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -437,6 +497,8 @@ class DistributedTransformerLayer(nn.Module):
             rotary_emb_base=self.rotary_emb_base,
             gpt_neox_type_rotary=self.gpt_neox_type_rotary,
             window_size=self.window_size,
+            decode=self.decode,
+            decode_cache_len=self.decode_cache_len,
             deterministic=self.deterministic,
             dtype=self.dtype,
             name="attention",
@@ -601,6 +663,8 @@ class DistributedTransformer(nn.Module):
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    decode: bool = False
+    decode_cache_len: Optional[int] = None
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -641,6 +705,8 @@ class DistributedTransformer(nn.Module):
             num_experts=self.num_experts,
             moe_top_k=self.moe_top_k,
             moe_capacity_factor=self.moe_capacity_factor,
+            decode=self.decode,
+            decode_cache_len=self.decode_cache_len,
             deterministic=self.deterministic,
             dtype=self.dtype,
         )
@@ -675,8 +741,9 @@ class DistributedTransformer(nn.Module):
         ScanLayers = nn.scan(
             body,
             # intermediates: per-layer sown values (MoE aux losses) stack
-            # on the layer axis when applied with mutable=["intermediates"].
-            variable_axes={"params": 0, "intermediates": 0},
+            # on the layer axis when applied with mutable=["intermediates"];
+            # cache: per-layer decode KV caches (smp.generate).
+            variable_axes={"params": 0, "intermediates": 0, "cache": 0},
             split_rngs={"params": True, "dropout": True},
             length=self.num_layers,
             in_axes=(0,),
@@ -778,6 +845,9 @@ class DistributedTransformerLMHead(nn.Module):
     moe_capacity_factor: float = 1.25
     # Loss-mode (targets=...) uniform label smoothing, HF/T5 convention.
     label_smoothing: float = 0.0
+    # KV-cache decoding for smp.generate (see nn/utils.DecodeKVCache).
+    decode: bool = False
+    decode_cache_len: Optional[int] = None
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
@@ -824,6 +894,12 @@ class DistributedTransformerLMHead(nn.Module):
                 kernel_init=_init(self.initializer_range),
                 name="lm_head",
             )
+        if self.decode:
+            # Top-level mirror of the per-layer cache indices (absolute
+            # position offset for the learned position embedding).
+            self._pos_index = self.variable(
+                "cache", "position_index", lambda: jnp.zeros((), jnp.int32)
+            )
 
     @nn.nowrap
     def _transformer_kwargs(self):
@@ -862,6 +938,8 @@ class DistributedTransformerLMHead(nn.Module):
             num_experts=self.num_experts,
             moe_top_k=self.moe_top_k,
             moe_capacity_factor=self.moe_capacity_factor,
+            decode=self.decode,
+            decode_cache_len=self.decode_cache_len,
             deterministic=self.deterministic,
             dtype=self.dtype,
         )
@@ -872,10 +950,23 @@ class DistributedTransformerLMHead(nn.Module):
         x = self.word_embedding(input_ids)
         if self.use_positional_embedding:
             if self.position_ids_from_padding is not None:
+                if self.decode:
+                    raise SMPValidationError(
+                        "decode=True is unsupported with "
+                        "position_ids_from_padding (RoBERTa-style "
+                        "pad-aware positions)."
+                    )
                 ne = (input_ids != self.position_ids_from_padding).astype(jnp.int32)
                 pos = jnp.cumsum(ne, axis=-1) * ne + self.position_ids_from_padding
             else:
-                pos = jnp.arange(input_ids.shape[-1])[None, :]
+                start = 0
+                if self.decode:
+                    # Top-level mirror of the per-layer cache indices:
+                    # learned positions need the absolute offset before
+                    # the layer stack.
+                    start = self._pos_index.value
+                    self._pos_index.value = start + input_ids.shape[-1]
+                pos = start + jnp.arange(input_ids.shape[-1])[None, :]
             x = x + self.position_embedding(pos)
         if self.num_token_types > 0 and token_type_ids is not None:
             x = x + self.token_type_embedding(token_type_ids)

@@ -160,3 +160,89 @@ def shard_sequence(x, axis=1):
 def unshard_sequence(x, axis=1):
     spec = [None] * x.ndim
     return shard_activation(x, *spec)
+
+
+# ----------------------------------------------------------------------
+# KV cache for autoregressive decoding (TPU extension, no reference
+# counterpart: the reference is a training library; generation support
+# makes the switch complete for fine-tune-then-sample users). Used by the
+# attention layers under ``decode=True`` and driven by ``smp.generate``.
+# ----------------------------------------------------------------------
+
+
+class DecodeKVCache:
+    """Fixed-length per-layer K/V cache held in flax "cache" variables.
+
+    Protocol (see ``generation.py``): the first call on a fresh cache is
+    the PREFILL — a whole-prompt chunk attends causally over itself (the
+    cache is empty before it, so chunk-causal equals cache semantics, and
+    the chunk keeps the flash-attention fast path). Every later call is a
+    T=1 DECODE step attending over the written prefix of the cache. Both
+    write their K/V into ``cache_len`` fixed slots at ``cache_index``.
+
+    The chunk-size distinction is static (Python ``T > 1``), so prefill
+    and decode compile as two separate programs — no traced branching.
+    """
+
+    def __init__(self, mod, shape, dtype):
+        B, C, H, hd = shape
+        if C is None:
+            raise ValueError(
+                "decode=True requires decode_cache_len (total generation "
+                "length) on the module."
+            )
+        # Static protocol guard state: True iff this apply CREATES the
+        # cache (the only call allowed to carry a multi-token chunk).
+        self._fresh = not mod.has_variable("cache", "cached_key")
+        self._ck = mod.variable(
+            "cache", "cached_key", lambda: jnp.zeros((B, C, H, hd), dtype)
+        )
+        self._cv = mod.variable(
+            "cache", "cached_value", lambda: jnp.zeros((B, C, H, hd), dtype)
+        )
+        self._idx = mod.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        self.cache_len = C
+
+    @property
+    def index(self):
+        """Positions filled so far (int32 scalar; 0 at prefill)."""
+        return self._idx.value
+
+    def append(self, k, v, window=None):
+        """Write chunk K/V ([B, T, H, hd]) at the current index.
+
+        Returns ``(k_attend, v_attend, mask)``: for a prefill chunk the
+        chunk itself with ``mask=None`` (caller runs plain causal
+        attention); for a decode step the full cache plus a
+        [1, 1, 1, cache_len] boolean mask selecting positions <= index
+        (banded to ``window`` when set).
+        """
+        T = k.shape[1]
+        if T > 1 and not self._fresh:
+            raise ValueError(
+                "KV-cache protocol violation: a multi-token (prefill) "
+                "chunk is only valid on a fresh cache; later calls must "
+                "decode one token at a time (the chunk would silently "
+                "ignore all previously cached positions)."
+            )
+        i = self._idx.value
+        self._ck.value = jax.lax.dynamic_update_slice(
+            self._ck.value, k, (0, i, 0, 0)
+        )
+        self._cv.value = jax.lax.dynamic_update_slice(
+            self._cv.value, v, (0, i, 0, 0)
+        )
+        self._idx.value = i + T
+        if T > 1:
+            return k, v, None
+        cols = jnp.arange(self.cache_len)
+        keep = cols <= i
+        if window is not None:
+            keep = keep & (i - cols < window)
+        return (
+            self._ck.value,
+            self._cv.value,
+            keep[None, None, None, :],
+        )

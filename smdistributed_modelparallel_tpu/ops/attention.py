@@ -121,6 +121,9 @@ def attention_core(
         and window is None
         and q.shape[1] == k.shape[1]
         and q.shape[1] % cp_size() == 0
+        # The in-region flash kernels share _pallas_ok's mixed-dtype
+        # restriction (MXU dots run on the operand dtype).
+        and q.dtype == k.dtype == v.dtype
     ):
         from smdistributed_modelparallel_tpu.backend.state import state
         from smdistributed_modelparallel_tpu.ops.context_parallel import (
