@@ -167,10 +167,210 @@ def _build_seq2seq_generator(decode_mod, max_new_tokens, sampler,
     return run
 
 
+# ----------------------------------------------------------------------
+# Beam search (greedy beams, HF-compatible scoring: length_penalty
+# normalization at EOS time, early_stopping=True semantics).
+# ----------------------------------------------------------------------
+
+_NEG = jnp.float32(-1e9)
+
+
+def _reorder_beam_cache(cache, parent_flat):
+    """Gather the growing self-attention caches along the folded [B*N]
+    beam axis. Under ``nn.scan`` the per-layer caches stack on a leading
+    layer axis — ``cached_key``/``cached_value`` are [L, B*N, C, H, hd],
+    so the gather is on axis 1. ``cross_kv`` (encoder K/V) is identical
+    across the beams of a row and index counters are scalars; both pass
+    through untouched."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
+    out = []
+    for path, leaf in flat:
+        name = getattr(path[-1], "key", None)
+        if name in ("cached_key", "cached_value"):
+            out.append(jnp.take(leaf, parent_flat, axis=1))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
+                          eos_token_id, pad_token_id, length_penalty,
+                          seq2seq, decoder_start_token_id):
+    """Compiled beam-search body. Beams fold into the batch axis (the
+    model sees [B*N, ...]); each step takes the top-2N candidates over
+    [N x vocab], routes EOS candidates into a best-N finished store
+    (scores normalized by HF's ``cur_len ** length_penalty``), continues
+    the top-N non-EOS beams, and gathers the KV caches to the surviving
+    parents. Everything — prefill, all steps, finalize — is one program.
+    """
+    N = num_beams
+
+    def select(logprobs, cache, beam_scores, seqs, fin, stopped, step):
+        B = beam_scores.shape[0]
+        V = logprobs.shape[-1]
+        fin_scores, fin_seqs, fin_len = fin
+        cand = beam_scores[:, :, None] + logprobs.reshape(B, N, V)
+        s2, i2 = jax.lax.top_k(cand.reshape(B, N * V), 2 * N)
+        tok2 = i2 % V
+        par2 = i2 // V
+        rows = jnp.arange(B)[:, None]
+        if eos_token_id is not None:
+            eos2 = tok2 == eos_token_id
+            # Finished store: merge this step's EOS candidates (parent
+            # sequence WITHOUT the eos token; only EOS ranked within the
+            # top N counts — HF drops worse-than-top-N EOS) with the kept
+            # hypotheses; keep the best N overall. Scores normalize by
+            # the GENERATED length including the eos (transformers >=
+            # 4.38: ``cur_len + 1 - decoder_prompt_len``); frozen rows
+            # (early_stopping reached) contribute nothing.
+            norm = s2 / jnp.float32(step + 1) ** length_penalty
+            in_top_n = jnp.arange(2 * N)[None, :] < N
+            cand_fin = jnp.where(
+                eos2 & in_top_n & ~stopped[:, None], norm, _NEG
+            )
+            all_scores = jnp.concatenate([fin_scores, cand_fin], axis=1)
+            all_seqs = jnp.concatenate([fin_seqs, seqs[rows, par2]], axis=1)
+            all_len = jnp.concatenate(
+                [fin_len, jnp.full((B, 2 * N), step, jnp.int32)], axis=1
+            )
+            fin_scores, fidx = jax.lax.top_k(all_scores, N)
+            fin_seqs = jnp.take_along_axis(all_seqs, fidx[:, :, None], 1)
+            fin_len = jnp.take_along_axis(all_len, fidx, 1)
+            stopped = stopped | (
+                jnp.sum(fin_scores > _NEG / 2, axis=1) >= N
+            )
+            s2 = jnp.where(eos2, _NEG, s2)
+        new_scores, pos = jax.lax.top_k(s2, N)
+        tokN = jnp.take_along_axis(tok2, pos, 1)
+        parN = jnp.take_along_axis(par2, pos, 1)
+        new_seqs = seqs[rows, parN]
+        new_seqs = jax.lax.dynamic_update_slice_in_dim(
+            new_seqs, tokN[:, :, None], step, axis=2
+        )
+        parent_flat = (rows * N + parN).reshape(-1)
+        cache = _reorder_beam_cache(cache, parent_flat)
+        return (cache, tokN.reshape(-1), new_scores, new_seqs,
+                (fin_scores, fin_seqs, fin_len), stopped)
+
+    def finish(beam_scores, seqs, fin, stopped, out_dtype):
+        """HF finalize: non-stopped rows also offer their live beams
+        (normalized by the full generated length — the last-iteration
+        max-length merge in transformers); best hypothesis wins; output
+        is hyp + eos + pad."""
+        B = beam_scores.shape[0]
+        fin_scores, fin_seqs, fin_len = fin
+        final_norm = beam_scores / (
+            jnp.float32(max_new_tokens) ** length_penalty
+        )
+        live = jnp.where(~stopped[:, None], final_norm, _NEG)
+        if eos_token_id is None:
+            live = final_norm
+        all_scores = jnp.concatenate([fin_scores, live], axis=1)
+        all_seqs = jnp.concatenate([fin_seqs, seqs], axis=1)
+        all_len = jnp.concatenate(
+            [fin_len,
+             jnp.full((B, N), max_new_tokens, jnp.int32)], axis=1
+        )
+        best = jnp.argmax(all_scores, axis=1)
+        seq = jnp.take_along_axis(all_seqs, best[:, None, None], 1)[:, 0]
+        length = jnp.take_along_axis(all_len, best[:, None], 1)[:, 0]
+        cols = jnp.arange(max_new_tokens)[None, :]
+        eos_fill = eos_token_id if eos_token_id is not None else pad_token_id
+        return jnp.where(
+            cols < length[:, None], seq,
+            jnp.where(cols == length[:, None], eos_fill, pad_token_id),
+        ).astype(out_dtype)
+
+    def loop(cache, first_logits, seqs0, apply_step, B, out_dtype):
+        logprobs = jax.nn.log_softmax(
+            first_logits[:, -1].astype(jnp.float32), axis=-1
+        ).reshape(B, N, -1)
+        # Step 0: the N beams of a row are identical clones — only beam 0
+        # may propose candidates (HF seeds beam scores [0, -inf, ...]).
+        beam_scores = jnp.full((B, N), _NEG).at[:, 0].set(0.0)
+        fin = (
+            jnp.full((B, N), _NEG),
+            jnp.zeros((B, N, max_new_tokens), jnp.int32),
+            jnp.zeros((B, N), jnp.int32),
+        )
+        stopped = jnp.zeros((B,), bool)
+        cache, tok, beam_scores, seqs, fin, stopped = select(
+            logprobs.reshape(B * N, -1), cache, beam_scores, seqs0, fin,
+            stopped, 0,
+        )
+
+        def body(carry, step):
+            cache, tok, beam_scores, seqs, fin, stopped = carry
+            logits, cache = apply_step(cache, tok[:, None])
+            logprobs = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32), axis=-1
+            )
+            return select(logprobs, cache, beam_scores, seqs, fin,
+                          stopped, step), None
+
+        (cache, tok, beam_scores, seqs, fin, stopped), _ = jax.lax.scan(
+            body,
+            (cache, tok, beam_scores, seqs, fin, stopped),
+            jnp.arange(1, max_new_tokens),
+        )
+        return finish(beam_scores, seqs, fin, stopped, out_dtype)
+
+    if seq2seq:
+        def run(params, enc_ids, enc_mask, rng):
+            B, S = enc_ids.shape
+            h_e = decode_mod.apply(
+                {"params": params}, enc_ids, enc_mask,
+                method="encode", mutable=["cache"],
+            )[0]
+            h_e = jnp.repeat(h_e, N, axis=0)
+            enc_mask_t = (
+                None if enc_mask is None else jnp.repeat(enc_mask, N, axis=0)
+            )
+            start = jnp.full((B * N, 1), decoder_start_token_id,
+                             enc_ids.dtype)
+            logits, mut = decode_mod.apply(
+                {"params": params}, start, h_e, enc_mask_t,
+                method="decode_step", mutable=["cache"],
+            )
+
+            def apply_step(cache, tok):
+                logits, mut = decode_mod.apply(
+                    {"params": params, "cache": cache}, tok, h_e,
+                    enc_mask_t, method="decode_step", mutable=["cache"],
+                )
+                return logits, mut["cache"]
+
+            seqs0 = jnp.zeros((B, N, max_new_tokens), jnp.int32)
+            gen = loop(mut["cache"], logits, seqs0, apply_step, B,
+                       enc_ids.dtype)
+            return jnp.concatenate([start[::N], gen], axis=1)
+    else:
+        def run(params, ids, rng):
+            B, T = ids.shape
+            ids_t = jnp.repeat(ids, N, axis=0)
+            logits, mut = decode_mod.apply(
+                {"params": params}, ids_t, mutable=["cache"]
+            )
+
+            def apply_step(cache, tok):
+                logits, mut = decode_mod.apply(
+                    {"params": params, "cache": cache}, tok,
+                    mutable=["cache"],
+                )
+                return logits, mut["cache"]
+
+            seqs0 = jnp.zeros((B, N, max_new_tokens), jnp.int32)
+            gen = loop(mut["cache"], logits, seqs0, apply_step, B,
+                       ids.dtype)
+            return jnp.concatenate([ids, gen], axis=1)
+
+    return run
+
+
 def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
              top_k=None, top_p=None, eos_token_id=None, pad_token_id=0,
              rng=None, params=None, encoder_mask=None,
-             decoder_start_token_id=0):
+             decoder_start_token_id=0, num_beams=1, length_penalty=1.0):
     """Generate ``max_new_tokens`` continuation tokens for each prompt.
 
     Args:
@@ -192,10 +392,16 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
       encoder_mask: seq2seq only — [B, S] encoder padding mask (1/True =
         keep), forwarded to cross-attention.
       decoder_start_token_id: seq2seq only — the decoder's BOS.
+      num_beams: > 1 switches to beam search (greedy beams; requires
+        temperature == 0). HF-compatible scoring: hypothesis scores are
+        sum-logprob / (cur_len ** length_penalty), ``early_stopping=True``
+        semantics (a row freezes once num_beams hypotheses finish).
+      length_penalty: beam-score length normalization exponent.
 
     Returns:
       Decoder-only: [B, T + max_new_tokens] — prompts with continuations.
       Seq2seq: [B, 1 + max_new_tokens] — start token + generated ids.
+      With beams, finished rows are "hypothesis + EOS + pad" padded.
     """
     if state.cfg is not None and state.cfg.pipeline_parallel_degree > 1:
         raise SMPValidationError(
@@ -224,6 +430,12 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
             )
     if temperature > 0.0 and rng is None:
         raise SMPValidationError("temperature > 0 requires rng=jax.random.key(...)")
+    if num_beams > 1 and (temperature > 0.0 or top_k is not None
+                          or top_p is not None):
+        raise SMPValidationError(
+            "beam search is greedy (num_beams > 1 requires temperature == "
+            "0 and no top_k/top_p filters)."
+        )
     if rng is None:
         rng = jax.random.key(0)
 
@@ -251,20 +463,28 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
         # with a different mesh must not reuse a stale program).
         key = (module, B, T, max_new_tokens, float(temperature), top_k,
                top_p, eos_token_id, pad_token_id, decoder_start_token_id,
-               has_mask, state.mesh if state.initialized else None)
+               has_mask, num_beams, float(length_penalty),
+               state.mesh if state.initialized else None)
         compiled = _COMPILED.get(key)
     except TypeError:  # unhashable module fields: compile uncached
         key = None
         compiled = None
     if compiled is None:
         decode_mod = _decode_clone(module, cache_len)
-        sampler = _make_sampler(float(temperature), top_k, top_p)
-        if seq2seq:
+        if num_beams > 1:
+            run = _build_beam_generator(
+                decode_mod, max_new_tokens, num_beams, eos_token_id,
+                pad_token_id, float(length_penalty), seq2seq,
+                decoder_start_token_id,
+            )
+        elif seq2seq:
+            sampler = _make_sampler(float(temperature), top_k, top_p)
             run = _build_seq2seq_generator(
                 decode_mod, max_new_tokens, sampler, eos_token_id,
                 pad_token_id, decoder_start_token_id,
             )
         else:
+            sampler = _make_sampler(float(temperature), top_k, top_p)
             run = _build_generator(decode_mod, max_new_tokens, sampler,
                                    eos_token_id, pad_token_id)
         compiled = jax.jit(run)

@@ -361,6 +361,219 @@ class TestSeq2SeqGreedyParity:
         )
 
 
+def _beam_reference(last_logits_fn, vocab, max_new, num_beams,
+                    eos, length_penalty=1.0):
+    """Independent (pure-python) beam search mirroring HF >= 4.38
+    semantics (scores normalized by the generated length including the
+    candidate token), for ONE row: ``last_logits_fn(tokens_list) ->
+    np.ndarray [V]`` runs the cache-less model on prompt+tokens and
+    returns the last position's logits. Returns the generated ids
+    (hyp + eos + pad, length max_new)."""
+    import scipy.special as sp
+
+    beams = [(0.0, [])]
+    fin = []  # (norm_score, tokens)
+    stopped = False
+    for step in range(max_new):
+        cands = []
+        for bi, (s, toks) in enumerate(beams):
+            lp = sp.log_softmax(last_logits_fn(toks).astype(np.float64))
+            for v in range(vocab):
+                cands.append((s + lp[v], bi, v))
+        cands.sort(key=lambda c: -c[0])
+        new_beams = []
+        for rank, (sc, bi, v) in enumerate(cands[: 2 * num_beams]):
+            if eos is not None and v == eos:
+                if rank < num_beams and not stopped:
+                    fin.append(
+                        (sc / (step + 1) ** length_penalty, beams[bi][1])
+                    )
+                    fin = sorted(fin, key=lambda f: -f[0])[:num_beams]
+            elif len(new_beams) < num_beams:
+                new_beams.append((sc, beams[bi][1] + [v]))
+        if eos is not None and len(fin) >= num_beams:
+            stopped = True
+        beams = new_beams
+    if not stopped:
+        for s, toks in beams:
+            fin.append((s / max_new ** length_penalty, toks))
+        fin = sorted(fin, key=lambda f: -f[0])[:num_beams]
+    toks = fin[0][1]
+    out = list(toks)
+    if eos is not None and len(out) < max_new:
+        out.append(eos)
+    out += [0] * (max_new - len(out))
+    return np.asarray(out)
+
+
+class TestBeamSearch:
+    def test_beam1_without_eos_equals_greedy(self):
+        smp.init({})
+        mod = _zoo("rotary")
+        ids = jax.random.randint(jax.random.key(30), (2, 6), 0, 97)
+        params = mod.init(jax.random.key(0), ids)["params"]
+        greedy = np.asarray(smp.generate(mod, ids, 5, params=params))
+        beam = np.asarray(
+            smp.generate(mod, ids, 5, params=params, num_beams=1)
+        )
+        np.testing.assert_array_equal(beam, greedy)
+
+    @pytest.mark.parametrize("eos_mode", ["none", "forced"])
+    def test_matches_python_reference(self, eos_mode):
+        smp.init({})
+        vocab = 23
+        mod = _zoo("learned", vocab_size=vocab, d_model=32)
+        ids = jax.random.randint(jax.random.key(31), (2, 5), 0, vocab)
+        params = mod.init(jax.random.key(0), ids)["params"]
+        # "forced": pick an id that actually appears among early beam
+        # tokens so the finished-hypothesis path is exercised.
+        if eos_mode == "none":
+            eos = None
+        else:
+            probe = np.asarray(smp.generate(mod, ids, 3, params=params))
+            eos = int(probe[0, 6])
+        got = np.asarray(
+            smp.generate(mod, ids, 6, params=params, num_beams=3,
+                         eos_token_id=eos, pad_token_id=0)
+        )
+        for row in range(2):
+            def last_logits(toks, _row=row):
+                seq = jnp.asarray(
+                    np.concatenate([np.asarray(ids[_row]), toks])
+                    .astype(np.int32)
+                )[None]
+                return np.asarray(
+                    mod.apply({"params": params}, seq)[0, -1]
+                ).astype(np.float64)
+
+            want = _beam_reference(last_logits, vocab, 6, 3, eos)
+            np.testing.assert_array_equal(got[row, 5:], want)
+
+    def test_seq2seq_beam_runs_and_improves_score(self):
+        # Beam-3 hypothesis log-prob must be >= greedy's (same model, same
+        # scoring) — the defining property of beam search.
+        smp.init({})
+        mod = TestSeq2SeqGreedyParity._enc_dec(t5_compat=True)
+        enc = jax.random.randint(jax.random.key(32), (2, 7), 0, 89)
+        params = mod.init(jax.random.key(0), enc, enc[:, :1])["params"]
+        greedy = np.asarray(
+            smp.generate(mod, enc, 5, params=params,
+                         decoder_start_token_id=3)
+        )
+        beam = np.asarray(
+            smp.generate(mod, enc, 5, params=params, num_beams=4,
+                         decoder_start_token_id=3)
+        )
+        assert beam.shape == greedy.shape
+
+        def seq_logprob(dec_rows):
+            total = np.zeros(dec_rows.shape[0])
+            for t in range(1, dec_rows.shape[1]):
+                logits = mod.apply(
+                    {"params": params}, enc,
+                    jnp.asarray(dec_rows[:, :t].astype(np.int32)),
+                )
+                lp = jax.nn.log_softmax(
+                    logits[:, -1].astype(jnp.float32), -1
+                )
+                total += np.asarray(
+                    jnp.take_along_axis(
+                        lp, jnp.asarray(dec_rows[:, t, None]), 1
+                    )[:, 0]
+                )
+            return total
+
+        assert (seq_logprob(beam) >= seq_logprob(greedy) - 1e-5).all()
+
+    def test_beam_rejects_sampling(self):
+        smp.init({})
+        mod = _zoo("learned")
+        ids = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(SMPValidationError):
+            smp.generate(mod, ids, 2, params={}, num_beams=2,
+                         temperature=0.5, rng=jax.random.key(0))
+
+
+class TestHFBeamParity:
+    def test_gpt2_matches_hf_beams(self):
+        transformers = pytest.importorskip("transformers")
+        torch = pytest.importorskip("torch")
+        from tests.test_huggingface import _hf_model, _tiny_configs
+
+        hf = _hf_model("gpt2", _tiny_configs()["gpt2"])
+        smp.init({})
+        model = smp.from_hf(hf, deterministic=True)
+        ids = jax.random.randint(jax.random.key(33), (2, 5), 0, 64)
+        with torch.no_grad():
+            t_ids = torch.tensor(np.asarray(ids))
+            want = hf.generate(
+                t_ids, attention_mask=torch.ones_like(t_ids),
+                max_new_tokens=4, num_beams=3, do_sample=False,
+                early_stopping=True, pad_token_id=0,
+            ).numpy()
+        got = np.asarray(model.generate(ids, 4, num_beams=3))
+        L = want.shape[1]
+        np.testing.assert_array_equal(got[:, :L], want)
+        assert (got[:, L:] == 0).all()
+
+    def test_gpt2_matches_hf_beams_with_eos_and_length_penalty(self):
+        # In-vocab EOS + length_penalty != 1 makes the normalization and
+        # the finished-vs-live ranking actually decide the output.
+        transformers = pytest.importorskip("transformers")
+        torch = pytest.importorskip("torch")
+        from tests.test_huggingface import _hf_model, _tiny_configs
+
+        hf = _hf_model("gpt2", _tiny_configs()["gpt2"])
+        smp.init({})
+        model = smp.from_hf(hf, deterministic=True)
+        ids = jax.random.randint(jax.random.key(35), (3, 5), 0, 64)
+        probe = np.asarray(model.generate(ids, 2))
+        eos = int(probe[0, 6])  # a token beams will actually propose
+        with torch.no_grad():
+            t_ids = torch.tensor(np.asarray(ids))
+            want = hf.generate(
+                t_ids, attention_mask=torch.ones_like(t_ids),
+                max_new_tokens=6, num_beams=3, do_sample=False,
+                early_stopping=True, pad_token_id=0, eos_token_id=eos,
+                length_penalty=2.0,
+            ).numpy()
+        got = np.asarray(
+            model.generate(ids, 6, num_beams=3, eos_token_id=eos,
+                           length_penalty=2.0)
+        )
+        L = want.shape[1]
+        np.testing.assert_array_equal(got[:, :L], want)
+        assert (got[:, L:] == 0).all()
+
+    def test_t5_matches_hf_beams(self):
+        transformers = pytest.importorskip("transformers")
+        torch = pytest.importorskip("torch")
+
+        config = transformers.T5Config(
+            d_model=32, d_ff=64, d_kv=8, num_layers=2, num_heads=4,
+            vocab_size=96, dropout_rate=0.0, decoder_start_token_id=0,
+        )
+        torch.manual_seed(0)
+        hf = transformers.T5ForConditionalGeneration(config)
+        hf.eval()
+        smp.init({})
+        model = smp.from_hf(hf, deterministic=True)
+        ids = jax.random.randint(jax.random.key(34), (2, 6), 2, 96)
+        with torch.no_grad():
+            want = hf.generate(
+                torch.tensor(np.asarray(ids)),
+                max_new_tokens=5, num_beams=3, do_sample=False,
+                early_stopping=True,
+            ).numpy()
+        got = np.asarray(
+            model.generate(ids, 5, num_beams=3, eos_token_id=1,
+                           decoder_start_token_id=0)
+        )
+        L = want.shape[1]
+        np.testing.assert_array_equal(got[:, :L], want)
+        assert (got[:, L:] == 0).all()
+
+
 class TestHFGreedyParity:
     """The strongest end-to-end check: a translated HF causal LM must
     greedily continue prompts exactly like HF's own ``generate``."""
