@@ -242,6 +242,67 @@ def main():
     peak = _chip_peak_tflops(jax.devices()[0]) if on_tpu else None
     mfu = (flops / dt / 1e12) / peak if peak else None
 
+    # Optional component breakdown (stderr; stdout stays one JSON line).
+    # SMP_BENCH_BREAKDOWN=1 localizes the MFU gap: fwd-only vs fwd+bwd vs
+    # full step isolates optimizer+update cost; the attention and LM-head
+    # microbenches bound the two dominant matmul groups. SMP_BENCH_PROFILE
+    # =<dir> additionally captures an XLA trace of the framework loop.
+    if os.environ.get("SMP_BENCH_BREAKDOWN", "0") == "1" and on_tpu:
+        def timeit(f, *a, reps=20):
+            f(*a)
+            _readback(jax.tree_util.tree_leaves(f(*a))[0])
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out_ = f(*a)
+            _readback(jax.tree_util.tree_leaves(out_)[0])
+            return (time.perf_counter() - t0) / reps * 1e3
+
+        bp = jax.tree_util.tree_map(
+            lambda p_: p_.astype(jnp.bfloat16)
+            if jnp.issubdtype(p_.dtype, jnp.floating) else p_, model.params)
+        mb = ids[: batch // num_mb]
+
+        fwd = jax.jit(lambda p_, i_: ce_loss(
+            model.module.apply({"params": p_}, i_), i_))
+        fwdbwd = jax.jit(jax.grad(lambda p_, i_: ce_loss(
+            model.module.apply({"params": p_}, i_), i_)))
+
+        from smdistributed_modelparallel_tpu.ops.attention import attention_core
+
+        # Random operands passed as ARGUMENTS: zeros (or closed-over
+        # constants) let XLA fold the matmuls away and time nothing.
+        kq = jax.random.key(7)
+        qkv = jax.random.normal(
+            kq, (batch // num_mb, seq_len, 12, 64), jnp.bfloat16)
+        attn = jax.jit(jax.grad(lambda q_: jnp.sum(
+            attention_core(q_, q_, q_, causal=True).astype(jnp.float32))))
+
+        h = jax.random.normal(
+            kq, (batch // num_mb * seq_len, d_model), jnp.bfloat16)
+        wte = jax.random.normal(kq, (vocab, d_model), jnp.bfloat16)
+        tgt = ids[: batch // num_mb].reshape(-1)
+        head_fn = jax.jit(jax.grad(lambda h_, w_: jnp.sum(
+            ce_loss((h_ @ w_.T)[None], tgt[None]))))
+
+        for name_, ms in [
+            ("fwd_only_microbatch", timeit(fwd, bp, mb)),
+            ("fwd_bwd_microbatch", timeit(fwdbwd, bp, mb)),
+            ("attention_fwdbwd_microbatch", timeit(attn, qkv)),
+            ("lmhead_ce_fwdbwd_microbatch", timeit(head_fn, h, wte)),
+        ]:
+            sys.stderr.write(json.dumps(
+                {"component": name_, "ms": round(ms, 3)}) + "\n")
+        sys.stderr.flush()
+
+    prof_dir = os.environ.get("SMP_BENCH_PROFILE")
+    if prof_dir and on_tpu:
+        with jax.profiler.trace(prof_dir):
+            for _ in range(3):
+                out = train_step(model, ids)
+                optimizer.step()
+            _readback(out.reduce_mean())
+        sys.stderr.write(f"bench: profile written to {prof_dir}\n")
+
     from smdistributed_modelparallel_tpu.ops.attention import _pallas_ok
 
     q_probe = jnp.zeros((batch // num_mb, seq_len, 12, 64), jnp.bfloat16)

@@ -137,6 +137,7 @@ class ModelParallelConfig:
             if value is not None:
                 self._check_bounds(key, spec, value, values)
                 self._check_options(key, spec, value)
+                self._check_multiple(key, spec, value)
             values[key] = value
 
         # The ZeRO-2D JSON overrides land BEFORE constraint checking so the
@@ -182,6 +183,14 @@ class ModelParallelConfig:
         options = spec.get("options")
         if options is not None and value not in options:
             raise ConfigError(f"Config '{key}'={value!r} not in allowed options {options}")
+
+    @staticmethod
+    def _check_multiple(key, spec, value):
+        mult = spec.get("multiple_of")
+        if mult is not None and isinstance(value, int) and value % mult:
+            raise ConfigError(
+                f"Config '{key}'={value} must be a multiple of {mult}"
+            )
 
     @staticmethod
     def _check_requires(key, spec, values):

@@ -414,6 +414,24 @@ SCHEMA = {
         "— at that size the HBM capacity win outweighs the recompute; "
         "below it the logits path is faster on every measured shape.",
     },
+    "pallas_attn_block_q": {
+        "type": (int, type(None)),
+        "default": None,
+        "lower_bound": 128,
+        "multiple_of": 128,
+        "description": "TPU extension: flash-attention q-tile rows "
+        "(default 256; Mosaic lane alignment requires multiples of 128). "
+        "Tune per TPU generation with the bench's breakdown mode.",
+    },
+    "pallas_attn_block_k": {
+        "type": (int, type(None)),
+        "default": None,
+        "lower_bound": 128,
+        "multiple_of": 128,
+        "description": "TPU extension: flash-attention kv-tile rows "
+        "(default 512; 256 inside context-parallel regions). Multiples "
+        "of 128 only.",
+    },
     "fused_ce_auto_threshold_mb": {
         "type": int,
         "default": 2048,

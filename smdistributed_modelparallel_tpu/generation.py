@@ -172,7 +172,10 @@ def _build_seq2seq_generator(decode_mod, max_new_tokens, sampler,
 # normalization at EOS time, early_stopping=True semantics).
 # ----------------------------------------------------------------------
 
-_NEG = jnp.float32(-1e9)
+# Plain python float: a module-level jnp array would initialize the
+# accelerator backend at import time (and hang outright if the TPU
+# tunnel is wedged).
+_NEG = -1e9
 
 
 def _reorder_beam_cache(cache, parent_flat):

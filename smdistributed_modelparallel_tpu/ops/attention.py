@@ -159,6 +159,8 @@ def attention_core(
         qq, kernel_scale, seed, rate = _fold_scale_and_seed(
             q, scale, dropout_rate, dropout_rng
         )
+        # Block sizes resolve inside the kernel entry (explicit arg ->
+        # pallas_attn_block_{q,k} config -> default).
         return flash_attention(
             qq, k, v, kpad, seed, None, kernel_scale, causal, window, rate
         )

@@ -454,6 +454,26 @@ def _bwd_dkv_kernel(*refs, scale, block_q, block_k, q_len, kv_len, causal,
 # Host-side wrappers
 # ----------------------------------------------------------------------
 
+def resolve_blocks(block_q, block_k, default_q=256, default_k=512):
+    """Block-size resolution, the ONE source of truth for every entry
+    point: an explicit argument wins, else the smp config override
+    (``pallas_attn_block_{q,k}``), else the per-path default."""
+    from smdistributed_modelparallel_tpu.backend.state import state
+
+    cfg = state.cfg
+    if block_q is None:
+        block_q = (
+            getattr(cfg, "pallas_attn_block_q", None) if cfg is not None
+            else None
+        ) or default_q
+    if block_k is None:
+        block_k = (
+            getattr(cfg, "pallas_attn_block_k", None) if cfg is not None
+            else None
+        ) or default_k
+    return block_q, block_k
+
+
 def _clamp_block(block, dim):
     """Clamp a block size to a sequence dim, keeping lane alignment: the
     result is min(block, dim rounded up to 128), so a short/ragged dim
@@ -682,7 +702,7 @@ def _flash_bwd_impl(q, k, v, o, g, lse, kpad_bias, seed, scale, causal,
 )
 def flash_attention(q, k, v, kpad_bias=None, seed=None, head0=None,
                     scale=None, causal=True, window=None, dropout_rate=0.0,
-                    block_q=256, block_k=512, interpret=False,
+                    block_q=None, block_k=None, interpret=False,
                     head_total=None, counter_len=None):
     """Flash attention over [B, T, H, hd] q and [B, S, H, hd] k/v.
 
@@ -698,6 +718,7 @@ def flash_attention(q, k, v, kpad_bias=None, seed=None, head0=None,
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    block_q, block_k = resolve_blocks(block_q, block_k)
     block_q = _clamp_block(block_q, q.shape[1])
     block_k = _clamp_block(block_k, k.shape[1])
     o, _ = _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
@@ -712,6 +733,7 @@ def _fa_fwd(q, k, v, kpad_bias, seed, head0, scale, causal, window,
             counter_len):
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    block_q, block_k = resolve_blocks(block_q, block_k)
     block_q = _clamp_block(block_q, q.shape[1])
     block_k = _clamp_block(block_k, k.shape[1])
     o, lse = _flash_fwd_impl(q, k, v, kpad_bias, seed, scale, causal, window,
@@ -726,6 +748,7 @@ def _fa_bwd(scale, causal, window, dropout_rate, block_q, block_k, interpret,
     q, k, v, o, lse, kpad_bias, seed, head0 = res
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    block_q, block_k = resolve_blocks(block_q, block_k)
     block_q = _clamp_block(block_q, q.shape[1])
     block_k = _clamp_block(block_k, k.shape[1])
     dq, dk, dv = _flash_bwd_impl(
@@ -772,7 +795,7 @@ def _rows_to_lse(lse, t_pad):
 
 def flash_fwd_with_ids(q, k, v, kpad_bias, q_ids, kv_ids, *, scale, causal,
                        seed=None, dropout_rate=0.0, counter_len=None,
-                       block_q=256, block_k=256, interpret=False):
+                       block_q=None, block_k=None, interpret=False):
     """One blockwise forward over a (q block, kv block) pair.
 
     Dropout hashes on the GLOBAL ids (rows/cols from q_ids/kv_ids, stride
@@ -781,6 +804,7 @@ def flash_fwd_with_ids(q, k, v, kpad_bias, q_ids, kv_ids, *, scale, causal,
     output, lse [B, H, T] with +_LSE_MASKED sentinel on fully-masked
     rows).
     """
+    block_q, block_k = resolve_blocks(block_q, block_k, default_k=256)
     block_q = _clamp_block(block_q, q.shape[1])
     block_k = _clamp_block(block_k, k.shape[1])
     o, lse = _flash_fwd_impl(
@@ -794,11 +818,12 @@ def flash_fwd_with_ids(q, k, v, kpad_bias, q_ids, kv_ids, *, scale, causal,
 
 def flash_bwd_with_ids(q, k, v, o, g, lse, kpad_bias, q_ids, kv_ids, *,
                        scale, causal, seed=None, dropout_rate=0.0,
-                       counter_len=None, block_q=256, block_k=256,
+                       counter_len=None, block_q=None, block_k=None,
                        interpret=False):
     """Blockwise backward for one (q block, kv block) pair given the GLOBAL
     per-row logsumexp ``lse`` [B, H, T] (+_LSE_MASKED sentinel rows) and
     the GLOBAL output ``o`` / cotangent ``g``. Returns (dq, dk, dv)."""
+    block_q, block_k = resolve_blocks(block_q, block_k, default_k=256)
     block_q = _clamp_block(block_q, q.shape[1])
     block_k = _clamp_block(block_k, k.shape[1])
     t_pad = ((q.shape[1] + block_q - 1) // block_q) * block_q

@@ -1,0 +1,41 @@
+"""Dispatch-level wiring of attention_core (CPU-checkable pieces)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.ops import attention as A
+
+
+def test_block_size_config_resolution():
+    """pallas_attn_block_{q,k}: explicit arg > config > per-path default."""
+    from smdistributed_modelparallel_tpu.ops.pallas_attention import (
+        resolve_blocks,
+    )
+
+    smp.init({})  # defaults: no block overrides
+    assert resolve_blocks(None, None) == (256, 512)
+    assert resolve_blocks(None, None, default_k=256) == (256, 256)
+    smp.init({"pallas_attn_block_q": 128, "pallas_attn_block_k": 256})
+    assert resolve_blocks(None, None) == (128, 256)
+    assert resolve_blocks(None, None, default_k=256) == (128, 256)
+    assert resolve_blocks(512, None) == (512, 256)
+
+
+def test_block_size_config_rejects_unaligned():
+    from smdistributed_modelparallel_tpu.utils.exceptions import ConfigError
+    import pytest
+
+    with pytest.raises(ConfigError, match="multiple of 128"):
+        smp.init({"pallas_attn_block_q": 300})
+
+
+def test_mixed_dtype_skips_flash(monkeypatch):
+    calls = []
+    monkeypatch.setattr(A, "_pallas_ok", lambda *a: calls.append(1) or False)
+    q = jnp.zeros((1, 128, 2, 8), jnp.bfloat16)
+    v = jnp.zeros((1, 128, 2, 8), jnp.float32)
+    out = A.attention_core(q, q, v, causal=True)
+    assert out.dtype == v.dtype  # jnp path promotion

@@ -1,0 +1,26 @@
+"""Importing the package must not initialize any accelerator backend.
+
+A module-level device-array (e.g. ``jnp.float32(...)`` as a constant)
+would eagerly initialize the platform at import — and on this image, if
+the tunneled TPU is wedged, HANG every process that merely imports the
+package (including the multiprocessing spawn children of the native-bus
+tests, which don't run conftest's cpu pin)."""
+
+import subprocess
+import sys
+
+
+def test_import_does_not_initialize_backend():
+    code = (
+        "import smdistributed_modelparallel_tpu\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge.backends_are_initialized(), "
+        "'package import initialized a JAX backend'\n"
+        "print('clean')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "clean" in out.stdout
