@@ -262,10 +262,17 @@ def main():
             if jnp.issubdtype(p_.dtype, jnp.floating) else p_, model.params)
         mb = ids[: batch // num_mb]
 
-        fwd = jax.jit(lambda p_, i_: ce_loss(
-            model.module.apply({"params": p_}, i_), i_))
-        fwdbwd = jax.jit(jax.grad(lambda p_, i_: ce_loss(
-            model.module.apply({"params": p_}, i_), i_)))
+        # Same loss path as the timed step (model loss mode, so the CE
+        # dispatch policy applies identically) — the microbench must
+        # decompose the step it is compared against.
+        def _loss(p_, i_):
+            tgt = jnp.concatenate(
+                [i_[:, 1:], jnp.full_like(i_[:, :1], -100)], axis=1)
+            per = model.module.apply({"params": p_}, i_, targets=tgt)
+            return jnp.sum(per) / (per.shape[0] * (per.shape[1] - 1))
+
+        fwd = jax.jit(_loss)
+        fwdbwd = jax.jit(jax.grad(_loss))
 
         from smdistributed_modelparallel_tpu.ops.attention import attention_core
 
@@ -282,7 +289,7 @@ def main():
         wte = jax.random.normal(kq, (vocab, d_model), jnp.bfloat16)
         tgt = ids[: batch // num_mb].reshape(-1)
         head_fn = jax.jit(jax.grad(lambda h_, w_: jnp.sum(
-            ce_loss((h_ @ w_.T)[None], tgt[None]))))
+            ce_loss((h_ @ w_.T)[None], tgt[None])), argnums=(0, 1)))
 
         for name_, ms in [
             ("fwd_only_microbatch", timeit(fwd, bp, mb)),

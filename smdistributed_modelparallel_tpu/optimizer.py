@@ -144,6 +144,7 @@ class DistributedOptimizer:
         # to the real update below. The identity check deliberately avoids
         # reading model._grads (that would force the lazy average).
         pending = getattr(self.model, "_pending_update", None)
+        self.model._dropped_updates = 0  # the loop does call optimizer.step()
         if pending is not None:
             self.model._pending_update = None
             if (

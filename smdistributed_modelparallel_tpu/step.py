@@ -263,6 +263,29 @@ class StepFunction:
         )
         opt_state = opt._opt_state if fused else ()
         if model is not None:
+            # Forgot-optimizer.step() detector (both paths): a pending
+            # fused update OR unconsumed grads with params untouched since
+            # the previous step means the last step's work is being
+            # discarded. Once is normal (an eval step in between);
+            # repeatedly means the model silently never learns. Counter is
+            # per-model (multi-model loops warn for the forgotten one) and
+            # reset by that model's optimizer.step().
+            stale = model._pending_update is not None or (
+                model._grads_store is not None
+                and model._params is getattr(model, "_params_at_step", None)
+            )
+            if stale and not getattr(cfg, "fused_step_donation", False):
+                n = getattr(model, "_dropped_updates", 0) + 1
+                model._dropped_updates = n
+                if n == 3:
+                    logger.warning(
+                        "3 training steps ran without optimizer.step(): "
+                        "parameter updates are computed and then "
+                        "discarded, so the model is NOT learning. Call "
+                        "optimizer.step() after each step (or enable "
+                        "fused_step_donation to auto-install updates)."
+                    )
+            model._params_at_step = model._params
             model._pending_update = None
         in_params = model.params
         grads, outputs, grads_finite, next_rng, fused_out = compiled(

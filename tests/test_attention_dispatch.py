@@ -32,10 +32,22 @@ def test_block_size_config_rejects_unaligned():
         smp.init({"pallas_attn_block_q": 300})
 
 
-def test_mixed_dtype_skips_flash(monkeypatch):
-    calls = []
-    monkeypatch.setattr(A, "_pallas_ok", lambda *a: calls.append(1) or False)
+def test_pallas_gate_rejects_mixed_dtypes(monkeypatch):
+    """The real _pallas_ok gate: uniform dtypes pass, mixed fail (the
+    kernel MXU dots run on the operand dtype). Backend faked to 'tpu' so
+    the dtype clause is actually reached on the CPU test host."""
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("SMP_DISABLE_PALLAS_ATTN", raising=False)
+    q = jnp.zeros((1, 128, 2, 8), jnp.bfloat16)
+    v32 = jnp.zeros((1, 128, 2, 8), jnp.float32)
+    assert A._pallas_ok(q, q, q)
+    assert not A._pallas_ok(q, q, v32)
+
+
+def test_mixed_dtype_takes_jnp_path():
+    # On a mixed-dtype call the jnp path runs (off-TPU here, but the gate
+    # test above pins the dtype clause) and promotes to the wider dtype.
     q = jnp.zeros((1, 128, 2, 8), jnp.bfloat16)
     v = jnp.zeros((1, 128, 2, 8), jnp.float32)
     out = A.attention_core(q, q, v, causal=True)
-    assert out.dtype == v.dtype  # jnp path promotion
+    assert out.dtype == v.dtype

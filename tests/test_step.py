@@ -274,3 +274,74 @@ def test_bf16_step_runs():
     l1 = float(train_step(model, x, y).reduce_mean())
     optimizer.step()
     assert l1 < l0
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_warns_when_updates_never_installed(fused):
+    """Repeated train steps without optimizer.step() must warn loudly —
+    the update/grads are computed then discarded, so the model silently
+    never learns (the failure mode is invisible otherwise). Covers both
+    the fused path (pending update dropped) and the standalone path
+    (grads overwritten with params untouched)."""
+    import logging
+
+    from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+    smp.init({"microbatches": 1, "fused_optimizer_step": fused})
+    model = smp.DistributedModel(MLP())
+    smp.DistributedOptimizer(optax.sgd(0.1), model)
+    x, y = make_data(jax.random.key(0))
+
+    @smp.step
+    def train_step(model, xb, yb):
+        loss = jnp.mean(softmax_xent(model(xb), yb))
+        model.backward(loss)
+        return loss
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    get_logger().addHandler(handler)
+    try:
+        for _ in range(5):
+            train_step(model, x, y)
+    finally:
+        get_logger().removeHandler(handler)
+    assert any("optimizer.step()" in m for m in records), records
+
+
+def test_no_warning_when_optimizer_steps():
+    import logging
+
+    from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+    smp.init({"microbatches": 1})
+    model = smp.DistributedModel(MLP())
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    x, y = make_data(jax.random.key(1))
+
+    @smp.step
+    def train_step(model, xb, yb):
+        loss = jnp.mean(softmax_xent(model(xb), yb))
+        model.backward(loss)
+        return loss
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    get_logger().addHandler(handler)
+    try:
+        for _ in range(5):
+            train_step(model, x, y)
+            optimizer.step()
+    finally:
+        get_logger().removeHandler(handler)
+    assert not any("NOT learning" in m for m in records), records
