@@ -108,18 +108,36 @@ def _decode_loop(apply_step, prefill_out, max_new_tokens,
     return jnp.concatenate([tok[:, None], rest.transpose(1, 0)], axis=1)
 
 
+def _step_masks(mask, max_new_tokens):
+    """(prefill [B,1,1,T], step [B,1,1,C]) boolean masks from a [B, T]
+    LEFT-padded prompt mask; generated columns are always kept."""
+    mask = mask.astype(bool)
+    B = mask.shape[0]
+    step = jnp.concatenate(
+        [mask, jnp.ones((B, max_new_tokens), bool)], axis=1
+    )
+    return mask[:, None, None, :], step[:, None, None, :]
+
+
 def _build_generator(decode_mod, max_new_tokens, sampler, eos_token_id,
                      pad_token_id):
-    """Decoder-only generation body: (params, ids, rng) -> [B, total] ids."""
+    """Decoder-only generation body:
+    (params, ids, mask | None, rng) -> [B, total] ids."""
 
-    def run(params, ids, rng):
+    def run(params, ids, mask, rng):
+        pre_kw, step_kw = {}, {}
+        if mask is not None:
+            pre_mask, step_mask = _step_masks(mask, max_new_tokens)
+            pre_kw = {"attention_mask": pre_mask}
+            step_kw = {"attention_mask": step_mask}
         logits, mut = decode_mod.apply(
-            {"params": params}, ids, mutable=["cache"]
+            {"params": params}, ids, mutable=["cache"], **pre_kw
         )
 
         def apply_step(cache, tok):
             logits, mut = decode_mod.apply(
-                {"params": params, "cache": cache}, tok, mutable=["cache"]
+                {"params": params, "cache": cache}, tok,
+                mutable=["cache"], **step_kw,
             )
             return logits, mut["cache"]
 
@@ -348,17 +366,23 @@ def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
                        enc_ids.dtype)
             return jnp.concatenate([start[::N], gen], axis=1)
     else:
-        def run(params, ids, rng):
+        def run(params, ids, mask, rng):
             B, T = ids.shape
             ids_t = jnp.repeat(ids, N, axis=0)
+            pre_kw, step_kw = {}, {}
+            if mask is not None:
+                mask_t = jnp.repeat(mask, N, axis=0)
+                pre_mask, step_mask = _step_masks(mask_t, max_new_tokens)
+                pre_kw = {"attention_mask": pre_mask}
+                step_kw = {"attention_mask": step_mask}
             logits, mut = decode_mod.apply(
-                {"params": params}, ids_t, mutable=["cache"]
+                {"params": params}, ids_t, mutable=["cache"], **pre_kw
             )
 
             def apply_step(cache, tok):
                 logits, mut = decode_mod.apply(
                     {"params": params, "cache": cache}, tok,
-                    mutable=["cache"],
+                    mutable=["cache"], **step_kw,
                 )
                 return logits, mut["cache"]
 
@@ -372,7 +396,7 @@ def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
 
 def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
              top_k=None, top_p=None, eos_token_id=None, pad_token_id=0,
-             rng=None, params=None, encoder_mask=None,
+             rng=None, params=None, encoder_mask=None, attention_mask=None,
              decoder_start_token_id=0, num_beams=1, length_penalty=1.0):
     """Generate ``max_new_tokens`` continuation tokens for each prompt.
 
@@ -383,8 +407,9 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
         ``smp.from_hf``-translated causal/seq2seq LM), or such a flax
         module directly (then ``params`` is required).
       input_ids: [B, T] int prompt tokens — the ENCODER input for a
-        seq2seq model. Decoder-only prompts are taken as unpadded (same
-        true length per row); pad/trim on the host beforehand.
+        seq2seq model. Decoder-only prompts of different true lengths
+        must be LEFT-padded, with ``attention_mask`` marking real tokens;
+        without a mask they are taken as unpadded.
       max_new_tokens: number of tokens to append.
       temperature: 0.0 = greedy argmax (default); > 0 samples.
       top_k / top_p: optional sampling filters (compose: k then p).
@@ -394,6 +419,9 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
       params: parameter tree override (defaults to the model's).
       encoder_mask: seq2seq only — [B, S] encoder padding mask (1/True =
         keep), forwarded to cross-attention.
+      attention_mask: decoder-only — [B, T] LEFT-padded prompt mask
+        (1/True = real token). Positions shift per row by the pad count
+        (HF convention) and padded columns never attend.
       decoder_start_token_id: seq2seq only — the decoder's BOS.
       num_beams: > 1 switches to beam search (greedy beams; requires
         temperature == 0). HF-compatible scoring: hypothesis scores are
@@ -431,6 +459,28 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
             raise SMPValidationError(
                 "generate(flax_module, ...) requires params=..."
             )
+    if attention_mask is not None:
+        if seq2seq:
+            raise SMPValidationError(
+                "seq2seq models take encoder_mask, not attention_mask."
+            )
+        import inspect
+
+        if "attention_mask" not in inspect.signature(
+            type(module).__call__
+        ).parameters:
+            raise SMPValidationError(
+                f"{type(module).__name__} does not accept attention_mask; "
+                "padded-prompt generation needs the smp.nn "
+                "DistributedTransformerLMHead family (incl. smp.from_hf "
+                "models)."
+            )
+        attention_mask = jnp.asarray(attention_mask)
+        if attention_mask.shape != input_ids.shape:
+            raise SMPValidationError(
+                f"attention_mask shape {attention_mask.shape} != prompt "
+                f"shape {input_ids.shape}."
+            )
     if temperature > 0.0 and rng is None:
         raise SMPValidationError("temperature > 0 requires rng=jax.random.key(...)")
     if num_beams > 1 and (temperature > 0.0 or top_k is not None
@@ -466,7 +516,8 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
         # with a different mesh must not reuse a stale program).
         key = (module, B, T, max_new_tokens, float(temperature), top_k,
                top_p, eos_token_id, pad_token_id, decoder_start_token_id,
-               has_mask, num_beams, float(length_penalty),
+               has_mask, attention_mask is not None, num_beams,
+               float(length_penalty),
                state.mesh if state.initialized else None)
         compiled = _COMPILED.get(key)
     except TypeError:  # unhashable module fields: compile uncached
@@ -496,7 +547,7 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
 
     args = (
         (params, input_ids, encoder_mask, rng) if seq2seq
-        else (params, input_ids, rng)
+        else (params, input_ids, attention_mask, rng)
     )
     mesh = state.mesh if state.initialized else None
     if mesh is not None:

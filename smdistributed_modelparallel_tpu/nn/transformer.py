@@ -95,8 +95,9 @@ def apply_rotary(q, k, rotary_dim, base=10000.0, neox_style=False, offset=0):
 
     Parity: reference ``torch/nn/transformer.py:114-183`` — interleaved
     (GPT-J) vs half-split (``gpt_neox_type_rotary``) variants.
-    ``offset`` (int or traced scalar) shifts the absolute positions —
-    decode steps rotate the current chunk at its cache position.
+    ``offset`` (int, traced scalar, or per-row [B] array) shifts the
+    absolute positions — decode steps rotate the current chunk at its
+    cache position; left-padded prompts shift each row by its pad count.
     """
 
     def rot(x):
@@ -105,10 +106,14 @@ def apply_rotary(q, k, rotary_dim, base=10000.0, neox_style=False, offset=0):
         x_rot, x_pass = x[..., :d], x[..., d:]
         half = d // 2
         freqs = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-        t = offset + jnp.arange(T, dtype=jnp.float32)
-        angles = jnp.einsum("t,f->tf", t, freqs)
-        cos = jnp.cos(angles)[None, :, None, :]
-        sin = jnp.sin(angles)[None, :, None, :]
+        off = jnp.asarray(offset, jnp.float32)
+        t = off[..., None] + jnp.arange(T, dtype=jnp.float32)  # [T] or [B,T]
+        angles = t[..., None] * freqs                 # [.., T, half]
+        cos = jnp.cos(angles)[..., None, :]
+        sin = jnp.sin(angles)[..., None, :]
+        if cos.ndim == 3:                             # scalar offset
+            cos = cos[None]
+            sin = sin[None]
         if neox_style:
             x1, x2 = x_rot[..., :half], x_rot[..., half:]
             rotated = jnp.concatenate(
@@ -245,7 +250,10 @@ class DistributedAttentionLayer(nn.Module):
         pos_offset = 0
         decode_mask = None
         if self.decode and not self.cross_attention:
-            from smdistributed_modelparallel_tpu.nn.utils import DecodeKVCache
+            from smdistributed_modelparallel_tpu.nn.utils import (
+                DecodeKVCache,
+                pad_row_offset,
+            )
 
             if self.causal_mask_size is None:
                 raise SMPValidationError(
@@ -256,7 +264,13 @@ class DistributedAttentionLayer(nn.Module):
             cache = DecodeKVCache(
                 self, (B, self.decode_cache_len, H, hd), k.dtype
             )
-            pos_offset = cache.index
+
+            # Left-padded prompts: each row's absolute positions shift
+            # back by its pad count (see nn/utils.pad_row_offset).
+            row_off = pad_row_offset(attention_mask)
+            pos_offset = (
+                cache.index if row_off is None else cache.index + row_off
+            )
 
         if self.rotary_dim is not None and not self.cross_attention:
             # The cache stores POST-rotary K: chunk q/k rotate once at
@@ -963,10 +977,22 @@ class DistributedTransformerLMHead(nn.Module):
                 if self.decode:
                     # Top-level mirror of the per-layer cache indices:
                     # learned positions need the absolute offset before
-                    # the layer stack.
-                    start = self._pos_index.value
-                    self._pos_index.value = start + input_ids.shape[-1]
-                pos = start + jnp.arange(input_ids.shape[-1])[None, :]
+                    # the layer stack; left-padded prompts additionally
+                    # shift each row by its pad count (see the attention
+                    # layers' pos_offset).
+                    from smdistributed_modelparallel_tpu.nn.utils import (
+                        pad_row_offset,
+                    )
+
+                    idx = self._pos_index.value
+                    self._pos_index.value = idx + input_ids.shape[-1]
+                    row_off = pad_row_offset(attention_mask)
+                    start = (
+                        idx if row_off is None else (idx + row_off)[:, None]
+                    )
+                pos = jnp.maximum(
+                    start + jnp.arange(input_ids.shape[-1])[None, :], 0
+                )
             x = x + self.position_embedding(pos)
         if self.num_token_types > 0 and token_type_ids is not None:
             x = x + self.token_type_embedding(token_type_ids)

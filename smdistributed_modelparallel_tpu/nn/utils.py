@@ -162,6 +162,41 @@ def unshard_sequence(x, axis=1):
     return shard_activation(x, *spec)
 
 
+def mask_keep_2d(mask):
+    """Boolean [B, T] keep-flags from an attention mask in any accepted
+    form ([B, T] or [B, 1, 1, T]; bool / 0-1 int / additive float), or
+    None when absent or not reducible to per-key flags."""
+    if mask is None:
+        return None
+    if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+        mask = mask[:, 0, 0, :]
+    if mask.ndim != 2:
+        return None
+    if mask.dtype == jnp.bool_:
+        return mask
+    if jnp.issubdtype(mask.dtype, jnp.integer):
+        return mask != 0
+    return mask > -1.0  # additive: 0 keep, large-negative drop
+
+
+def pad_row_offset(mask):
+    """Per-row position offset ([B] int32, <= 0) for LEFT-padded prompts,
+    or None when no mask applies.
+
+    With left padding, pad count = width - sum(keep) at prefill ([B, T]
+    prompt mask) and at decode steps ([B, 1, 1, C] mask with generated
+    columns kept) alike, so the offset derives statelessly from whatever
+    mask arrives. Rows whose keep pattern is NOT a left-pad shape
+    (0..0 1..1 monotone) get offset 0 — an arbitrary key-blocking mask
+    excludes slots from attention but must not shift positions."""
+    keep = mask_keep_2d(mask)
+    if keep is None:
+        return None
+    is_leftpad = jnp.all(keep[:, 1:] >= keep[:, :-1], axis=1)
+    off = jnp.sum(keep, axis=1).astype(jnp.int32) - keep.shape[1]
+    return jnp.where(is_leftpad, off, 0)
+
+
 # ----------------------------------------------------------------------
 # KV cache for autoregressive decoding (TPU extension, no reference
 # counterpart: the reference is a training library; generation support

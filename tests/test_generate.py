@@ -574,6 +574,107 @@ class TestHFBeamParity:
         assert (got[:, L:] == 0).all()
 
 
+class TestPaddedPrompts:
+    """Left-padded ragged prompts: the gold invariant is that a padded
+    batch row generates exactly what the unpadded prompt generates
+    alone (positions shift per row; padded columns never attend)."""
+
+    @staticmethod
+    def _head(**kw):
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("attention_head_size", 8)
+        kw.setdefault("hidden_size", 32)
+        kw.setdefault("intermediate_size", 64)
+        kw.setdefault("vocab_size", 97)
+        kw.setdefault("num_positions", 64)
+        kw.setdefault("causal_mask_size", 64)
+        kw.setdefault("attention_dropout_prob", 0.0)
+        kw.setdefault("hidden_dropout_prob", 0.0)
+        kw.setdefault("embedding_dropout_prob", 0.0)
+        kw.setdefault("deterministic", True)
+        return DistributedTransformerLMHead(**kw)
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {},  # learned positions
+            {   # NeoX rotary (per-row rotary offsets)
+                "use_positional_embedding": False,
+                "rotary_dim": 8,
+                "gpt_neox_type_rotary": True,
+                "pre_layernorm": True,
+                "post_layernorm": False,
+                "final_layernorm": True,
+            },
+        ],
+        ids=["learned_pos", "rotary"],
+    )
+    def test_padded_row_equals_unpadded(self, kw):
+        smp.init({})
+        mod = self._head(**kw)
+        full = jax.random.randint(jax.random.key(40), (2, 6), 1, 97)
+        # Row 1's true prompt is its last 4 tokens; left-pad with zeros.
+        padded = full.at[1, :2].set(0)
+        mask = jnp.asarray([[1] * 6, [0, 0, 1, 1, 1, 1]], jnp.int32)
+        params = mod.init(jax.random.key(0), padded)["params"]
+        got = np.asarray(
+            smp.generate(mod, padded, 5, params=params,
+                         attention_mask=mask)
+        )
+        single = np.asarray(
+            smp.generate(mod, full[1:2, 2:], 5, params=params)
+        )
+        np.testing.assert_array_equal(got[1, 6:], single[0, 4:])
+        # Unpadded row must match the no-mask path too.
+        plain = np.asarray(smp.generate(mod, full[0:1], 5, params=params))
+        np.testing.assert_array_equal(got[0], plain[0])
+
+    def test_beams_with_padded_prompts(self):
+        smp.init({})
+        mod = self._head()
+        full = jax.random.randint(jax.random.key(41), (2, 6), 1, 97)
+        padded = full.at[1, :2].set(0)
+        mask = jnp.asarray([[1] * 6, [0, 0, 1, 1, 1, 1]], jnp.int32)
+        params = mod.init(jax.random.key(0), padded)["params"]
+        got = np.asarray(
+            smp.generate(mod, padded, 4, params=params,
+                         attention_mask=mask, num_beams=3)
+        )
+        single = np.asarray(
+            smp.generate(mod, full[1:2, 2:], 4, params=params, num_beams=3)
+        )
+        np.testing.assert_array_equal(got[1, 6:], single[0, 4:])
+
+    def test_hf_gpt2_left_padded_parity(self):
+        transformers = pytest.importorskip("transformers")
+        torch = pytest.importorskip("torch")
+        from tests.test_huggingface import _hf_model, _tiny_configs
+
+        hf = _hf_model("gpt2", _tiny_configs()["gpt2"])
+        smp.init({})
+        model = smp.from_hf(hf, deterministic=True)
+        ids = jax.random.randint(jax.random.key(42), (2, 6), 1, 64)
+        ids = ids.at[1, :3].set(0)
+        mask = jnp.asarray([[1] * 6, [0, 0, 0, 1, 1, 1]], jnp.int32)
+        with torch.no_grad():
+            want = hf.generate(
+                torch.tensor(np.asarray(ids)),
+                attention_mask=torch.tensor(np.asarray(mask)),
+                max_new_tokens=5, do_sample=False, pad_token_id=0,
+            ).numpy()
+        got = np.asarray(model.generate(ids, 5, attention_mask=mask))
+        np.testing.assert_array_equal(got, want)
+
+    def test_zoo_family_rejects_mask(self):
+        smp.init({})
+        mod = _zoo("learned")
+        ids = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(SMPValidationError, match="attention_mask"):
+            smp.generate(mod, ids, 2, params={},
+                         attention_mask=jnp.ones((1, 4), jnp.int32))
+
+
 class TestHFGreedyParity:
     """The strongest end-to-end check: a translated HF causal LM must
     greedily continue prompts exactly like HF's own ``generate``."""
