@@ -216,7 +216,8 @@ def _reorder_beam_cache(cache, parent_flat):
 
 def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
                           eos_token_id, pad_token_id, length_penalty,
-                          seq2seq, decoder_start_token_id):
+                          seq2seq, decoder_start_token_id,
+                          num_return_sequences=1):
     """Compiled beam-search body. Beams fold into the batch axis (the
     model sees [B*N, ...]); each step takes the top-2N candidates over
     [N x vocab], routes EOS candidates into a best-N finished store
@@ -292,15 +293,17 @@ def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
             [fin_len,
              jnp.full((B, N), max_new_tokens, jnp.int32)], axis=1
         )
-        best = jnp.argmax(all_scores, axis=1)
-        seq = jnp.take_along_axis(all_seqs, best[:, None, None], 1)[:, 0]
-        length = jnp.take_along_axis(all_len, best[:, None], 1)[:, 0]
-        cols = jnp.arange(max_new_tokens)[None, :]
+        R = num_return_sequences
+        _, best = jax.lax.top_k(all_scores, R)              # [B, R]
+        seq = jnp.take_along_axis(all_seqs, best[:, :, None], 1)  # [B,R,L]
+        length = jnp.take_along_axis(all_len, best, 1)       # [B, R]
+        cols = jnp.arange(max_new_tokens)[None, None, :]
         eos_fill = eos_token_id if eos_token_id is not None else pad_token_id
-        return jnp.where(
-            cols < length[:, None], seq,
-            jnp.where(cols == length[:, None], eos_fill, pad_token_id),
+        out = jnp.where(
+            cols < length[:, :, None], seq,
+            jnp.where(cols == length[:, :, None], eos_fill, pad_token_id),
         ).astype(out_dtype)
+        return out[:, 0] if R == 1 else out
 
     def loop(cache, first_logits, seqs0, apply_step, B, out_dtype):
         logprobs = jax.nn.log_softmax(
@@ -364,6 +367,12 @@ def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
             seqs0 = jnp.zeros((B, N, max_new_tokens), jnp.int32)
             gen = loop(mut["cache"], logits, seqs0, apply_step, B,
                        enc_ids.dtype)
+            if num_return_sequences > 1:
+                s = jnp.broadcast_to(
+                    start[::N][:, None],
+                    (B, num_return_sequences, 1),
+                )
+                return jnp.concatenate([s, gen], axis=2)
             return jnp.concatenate([start[::N], gen], axis=1)
     else:
         def run(params, ids, mask, rng):
@@ -389,6 +398,11 @@ def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
             seqs0 = jnp.zeros((B, N, max_new_tokens), jnp.int32)
             gen = loop(mut["cache"], logits, seqs0, apply_step, B,
                        ids.dtype)
+            if num_return_sequences > 1:
+                idsr = jnp.broadcast_to(
+                    ids[:, None], (B, num_return_sequences, T)
+                )
+                return jnp.concatenate([idsr, gen], axis=2)
             return jnp.concatenate([ids, gen], axis=1)
 
     return run
@@ -397,7 +411,8 @@ def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
 def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
              top_k=None, top_p=None, eos_token_id=None, pad_token_id=0,
              rng=None, params=None, encoder_mask=None, attention_mask=None,
-             decoder_start_token_id=0, num_beams=1, length_penalty=1.0):
+             decoder_start_token_id=0, num_beams=1, length_penalty=1.0,
+             num_return_sequences=1):
     """Generate ``max_new_tokens`` continuation tokens for each prompt.
 
     Args:
@@ -428,11 +443,14 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
         sum-logprob / (cur_len ** length_penalty), ``early_stopping=True``
         semantics (a row freezes once num_beams hypotheses finish).
       length_penalty: beam-score length normalization exponent.
+      num_return_sequences: beams only — return the top R hypotheses per
+        row (R <= num_beams) as a [B, R, L] array instead of [B, L].
 
     Returns:
       Decoder-only: [B, T + max_new_tokens] — prompts with continuations.
       Seq2seq: [B, 1 + max_new_tokens] — start token + generated ids.
-      With beams, finished rows are "hypothesis + EOS + pad" padded.
+      With beams, finished rows are "hypothesis + EOS + pad" padded; with
+      ``num_return_sequences`` R > 1 the shape gains a rank-R axis.
     """
     if state.cfg is not None and state.cfg.pipeline_parallel_degree > 1:
         raise SMPValidationError(
@@ -459,6 +477,10 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
             raise SMPValidationError(
                 "generate(flax_module, ...) requires params=..."
             )
+    if encoder_mask is not None and not seq2seq:
+        raise SMPValidationError(
+            "decoder-only models take attention_mask, not encoder_mask."
+        )
     if attention_mask is not None:
         if seq2seq:
             raise SMPValidationError(
@@ -481,6 +503,16 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
                 f"attention_mask shape {attention_mask.shape} != prompt "
                 f"shape {input_ids.shape}."
             )
+        # Eager left-paddedness check (the mask is a concrete host array
+        # here): a right-padded mask would silently sample the first
+        # continuation from a masked pad position's logits.
+        m = np.asarray(attention_mask).astype(bool)
+        if not ((m[:, 1:] >= m[:, :-1]).all() and m[:, -1].all()):
+            raise SMPValidationError(
+                "attention_mask must be LEFT-padded (rows 0..0 1..1 with "
+                "the last column kept); right-padded prompts would "
+                "generate from a pad position."
+            )
     if temperature > 0.0 and rng is None:
         raise SMPValidationError("temperature > 0 requires rng=jax.random.key(...)")
     if num_beams > 1 and (temperature > 0.0 or top_k is not None
@@ -488,6 +520,10 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
         raise SMPValidationError(
             "beam search is greedy (num_beams > 1 requires temperature == "
             "0 and no top_k/top_p filters)."
+        )
+    if not 1 <= num_return_sequences <= num_beams:
+        raise SMPValidationError(
+            "num_return_sequences must be in [1, num_beams]."
         )
     if rng is None:
         rng = jax.random.key(0)
@@ -517,7 +553,7 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
         key = (module, B, T, max_new_tokens, float(temperature), top_k,
                top_p, eos_token_id, pad_token_id, decoder_start_token_id,
                has_mask, attention_mask is not None, num_beams,
-               float(length_penalty),
+               float(length_penalty), num_return_sequences,
                state.mesh if state.initialized else None)
         compiled = _COMPILED.get(key)
     except TypeError:  # unhashable module fields: compile uncached
@@ -529,7 +565,7 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
             run = _build_beam_generator(
                 decode_mod, max_new_tokens, num_beams, eos_token_id,
                 pad_token_id, float(length_penalty), seq2seq,
-                decoder_start_token_id,
+                decoder_start_token_id, num_return_sequences,
             )
         elif seq2seq:
             sampler = _make_sampler(float(temperature), top_k, top_p)

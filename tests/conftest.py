@@ -94,6 +94,14 @@ _SLOW_TESTS = (
     "test_delayed_init.py::test_delayed_init_matches_eager_init_numerically",
     "test_huggingface.py::TestRoundTrip::test_vit_encoder_trains_under_smp_step",
     "test_multiprocess.py::test_two_process_control_plane_and_checkpoint",
+    # Generation tier 2: HF-comparison and python-reference beam tests
+    # compile many decode programs / loop full forwards per token.
+    "test_generate.py::TestHFGreedyParity",
+    "test_generate.py::TestHFBeamParity",
+    "test_generate.py::TestBeamSearch::test_matches_python_reference",
+    "test_generate.py::TestSeq2SeqGreedyParity",
+    "test_generate.py::TestPaddedPrompts::test_hf_gpt2_left_padded_parity",
+    "test_generate.py::TestDistributedParity::test_tp4_matches_single_device",
 )
 
 

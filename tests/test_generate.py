@@ -485,6 +485,24 @@ class TestBeamSearch:
 
         assert (seq_logprob(beam) >= seq_logprob(greedy) - 1e-5).all()
 
+    def test_num_return_sequences(self):
+        smp.init({})
+        mod = _zoo("learned")
+        ids = jax.random.randint(jax.random.key(36), (2, 5), 0, 97)
+        params = mod.init(jax.random.key(0), ids)["params"]
+        one = np.asarray(
+            smp.generate(mod, ids, 4, params=params, num_beams=3)
+        )
+        three = np.asarray(
+            smp.generate(mod, ids, 4, params=params, num_beams=3,
+                         num_return_sequences=3)
+        )
+        assert three.shape == (2, 3, 9)
+        np.testing.assert_array_equal(three[:, 0], one)
+        with pytest.raises(SMPValidationError):
+            smp.generate(mod, ids, 4, params=params, num_beams=2,
+                         num_return_sequences=3)
+
     def test_beam_rejects_sampling(self):
         smp.init({})
         mod = _zoo("learned")
