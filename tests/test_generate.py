@@ -503,6 +503,23 @@ class TestBeamSearch:
             smp.generate(mod, ids, 4, params=params, num_beams=2,
                          num_return_sequences=3)
 
+    def test_seq2seq_num_return_sequences(self):
+        smp.init({})
+        mod = TestSeq2SeqGreedyParity._enc_dec(t5_compat=True)
+        enc = jax.random.randint(jax.random.key(37), (2, 7), 0, 89)
+        params = mod.init(jax.random.key(0), enc, enc[:, :1])["params"]
+        one = np.asarray(
+            smp.generate(mod, enc, 4, params=params, num_beams=3,
+                         decoder_start_token_id=3)
+        )
+        three = np.asarray(
+            smp.generate(mod, enc, 4, params=params, num_beams=3,
+                         decoder_start_token_id=3, num_return_sequences=3)
+        )
+        assert three.shape == (2, 3, 5)
+        np.testing.assert_array_equal(three[:, 0], one)
+        assert (three[:, :, 0] == 3).all()  # start token on every rank
+
     def test_beam_rejects_sampling(self):
         smp.init({})
         mod = _zoo("learned")
