@@ -108,6 +108,16 @@ def _decode_loop(apply_step, prefill_out, max_new_tokens,
     return jnp.concatenate([tok[:, None], rest.transpose(1, 0)], axis=1)
 
 
+def _half_cast(params, half):
+    """Match the training step's compute dtype: under bf16/fp16 configs
+    the decode forward runs on half-precision params, so generation
+    throughput and numerics track training (shared predicate:
+    nn/utils.half_cast)."""
+    from smdistributed_modelparallel_tpu.nn.utils import half_cast
+
+    return half_cast(params, half)
+
+
 def _step_masks(mask, max_new_tokens):
     """(prefill [B,1,1,T], step [B,1,1,C]) boolean masks from a [B, T]
     LEFT-padded prompt mask; generated columns are always kept."""
@@ -120,11 +130,12 @@ def _step_masks(mask, max_new_tokens):
 
 
 def _build_generator(decode_mod, max_new_tokens, sampler, eos_token_id,
-                     pad_token_id):
+                     pad_token_id, half=None):
     """Decoder-only generation body:
     (params, ids, mask | None, rng) -> [B, total] ids."""
 
     def run(params, ids, mask, rng):
+        params = _half_cast(params, half)
         pre_kw, step_kw = {}, {}
         if mask is not None:
             pre_mask, step_mask = _step_masks(mask, max_new_tokens)
@@ -152,12 +163,13 @@ def _build_generator(decode_mod, max_new_tokens, sampler, eos_token_id,
 
 def _build_seq2seq_generator(decode_mod, max_new_tokens, sampler,
                              eos_token_id, pad_token_id,
-                             decoder_start_token_id):
+                             decoder_start_token_id, half=None):
     """Seq2seq generation body: encode once, KV-cached decoder steps.
     (params, encoder_ids, encoder_mask, rng) -> [B, 1 + max_new] decoder
     ids (start token first, HF ``generate`` convention)."""
 
     def run(params, enc_ids, enc_mask, rng):
+        params = _half_cast(params, half)
         B = enc_ids.shape[0]
         h_e, _ = decode_mod.apply(
             {"params": params}, enc_ids, enc_mask,
@@ -217,7 +229,7 @@ def _reorder_beam_cache(cache, parent_flat):
 def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
                           eos_token_id, pad_token_id, length_penalty,
                           seq2seq, decoder_start_token_id,
-                          num_return_sequences=1):
+                          num_return_sequences=1, half=None):
     """Compiled beam-search body. Beams fold into the batch axis (the
     model sees [B*N, ...]); each step takes the top-2N candidates over
     [N x vocab], routes EOS candidates into a best-N finished store
@@ -341,6 +353,7 @@ def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
 
     if seq2seq:
         def run(params, enc_ids, enc_mask, rng):
+            params = _half_cast(params, half)
             B, S = enc_ids.shape
             h_e = decode_mod.apply(
                 {"params": params}, enc_ids, enc_mask,
@@ -376,6 +389,7 @@ def _build_beam_generator(decode_mod, max_new_tokens, num_beams,
             return jnp.concatenate([start[::N], gen], axis=1)
     else:
         def run(params, ids, mask, rng):
+            params = _half_cast(params, half)
             B, T = ids.shape
             ids_t = jnp.repeat(ids, N, axis=0)
             pre_kw, step_kw = {}, {}
@@ -545,6 +559,7 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
         )
 
     has_mask = encoder_mask is not None
+    half = state.cfg.half_dtype if state.cfg is not None else None
     key = None
     try:
         # The mesh is part of the key: sharding constraints traced into the
@@ -553,7 +568,7 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
         key = (module, B, T, max_new_tokens, float(temperature), top_k,
                top_p, eos_token_id, pad_token_id, decoder_start_token_id,
                has_mask, attention_mask is not None, num_beams,
-               float(length_penalty), num_return_sequences,
+               float(length_penalty), num_return_sequences, str(half),
                state.mesh if state.initialized else None)
         compiled = _COMPILED.get(key)
     except TypeError:  # unhashable module fields: compile uncached
@@ -565,18 +580,18 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
             run = _build_beam_generator(
                 decode_mod, max_new_tokens, num_beams, eos_token_id,
                 pad_token_id, float(length_penalty), seq2seq,
-                decoder_start_token_id, num_return_sequences,
+                decoder_start_token_id, num_return_sequences, half,
             )
         elif seq2seq:
             sampler = _make_sampler(float(temperature), top_k, top_p)
             run = _build_seq2seq_generator(
                 decode_mod, max_new_tokens, sampler, eos_token_id,
-                pad_token_id, decoder_start_token_id,
+                pad_token_id, decoder_start_token_id, half,
             )
         else:
             sampler = _make_sampler(float(temperature), top_k, top_p)
             run = _build_generator(decode_mod, max_new_tokens, sampler,
-                                   eos_token_id, pad_token_id)
+                                   eos_token_id, pad_token_id, half)
         compiled = jax.jit(run)
         if key is not None:
             _COMPILED[key] = compiled

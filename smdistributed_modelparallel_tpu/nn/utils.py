@@ -179,6 +179,19 @@ def mask_keep_2d(mask):
     return mask > -1.0  # additive: 0 keep, large-negative drop
 
 
+def half_cast(params, half):
+    """Cast floating leaves to the half dtype (None = no-op). The ONE
+    definition of the training/generation compute-dtype cast — step.py,
+    pipeline_1f1b.py, and generation.py all share this predicate."""
+    if half is None:
+        return params
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(half)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p,
+        params,
+    )
+
+
 def pad_row_offset(mask):
     """Per-row position offset ([B] int32, <= 0) for LEFT-padded prompts,
     or None when no mask applies.

@@ -153,13 +153,9 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     )
 
     def cast_half(tree):
-        if half is None:
-            return tree
-        return jax.tree_util.tree_map(
-            lambda p: p.astype(half)
-            if jnp.issubdtype(p.dtype, jnp.floating) else p,
-            tree,
-        )
+        from smdistributed_modelparallel_tpu.nn.utils import half_cast
+
+        return half_cast(tree, half)
 
     layer_params = _get_subtree(params, spec.layer_path)
     staged_params, staged_xs, active_rows = staged_layer_views(

@@ -43,6 +43,7 @@ from smdistributed_modelparallel_tpu.model import DistributedModel
 from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
 from smdistributed_modelparallel_tpu.utils.exceptions import StepUsageError
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.nn.utils import half_cast as half_cast_util
 
 logger = get_logger()
 
@@ -381,13 +382,7 @@ class StepFunction:
             # loop-invariant, and differentiating w.r.t. the half params is
             # numerically identical (the astype VJP is an exact bf16->fp32
             # upcast of the cotangent, applied below at accumulation).
-            run_params = params
-            if half is not None:
-                run_params = jax.tree_util.tree_map(
-                    lambda p: p.astype(half)
-                    if jnp.issubdtype(p.dtype, jnp.floating) else p,
-                    params,
-                )
+            run_params = half_cast_util(params, half)
             if has_backward:
                 def scaled_fwd(run_params, mb_leaves, bcast_leaves, key):
                     loss, out = mb_forward(run_params, mb_leaves, bcast_leaves, key)
@@ -492,13 +487,7 @@ class StepFunction:
             def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
                 keys = jax.random.split(rng, num_mb)
                 stacked_inputs = capture_inputs(scan_leaves, bcast_leaves, keys)
-                run_p = params
-                if half is not None:
-                    run_p = jax.tree_util.tree_map(
-                        lambda x: x.astype(half)
-                        if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                        params,
-                    )
+                run_p = half_cast_util(params, half)
 
                 def mb_loss_fn(out, mb_index, key):
                     mb_leaves = [
@@ -540,13 +529,7 @@ class StepFunction:
             stacked_inputs = capture_inputs(scan_leaves, bcast_leaves, keys)
 
             def forward_all(p):
-                run_p = p
-                if half is not None:
-                    run_p = jax.tree_util.tree_map(
-                        lambda x: x.astype(half)
-                        if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                        p,
-                    )
+                run_p = half_cast_util(p, half)
                 outs, pipe_aux = pipeline_forward(model, run_p, stacked_inputs, rng)
 
                 def post_body(_, xs):

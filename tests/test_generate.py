@@ -766,3 +766,42 @@ class TestHFGreedyParity:
             model.generate(ids, 5, decoder_start_token_id=0)
         )
         np.testing.assert_array_equal(got, want)
+
+
+class TestHalfPrecision:
+    def test_bf16_config_casts_decode_params(self):
+        """Under a bf16 config, generation runs the half-cast forward
+        (training-step parity): the KV caches must be bf16 and the
+        output must equal a manual bf16 cache-less greedy loop."""
+        smp.init({"bf16": True})
+        mod = _zoo("rotary")
+        ids = jax.random.randint(jax.random.key(50), (2, 6), 0, 97)
+        params = mod.init(jax.random.key(0), ids)["params"]
+        out = np.asarray(smp.generate(mod, ids, 4, params=params))
+
+        bp = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+        cur = ids
+        for _ in range(4):
+            nxt = jnp.argmax(
+                mod.apply({"params": bp}, cur)[:, -1].astype(jnp.float32),
+                -1,
+            )
+            cur = jnp.concatenate([cur, nxt[:, None].astype(cur.dtype)], 1)
+        np.testing.assert_array_equal(out, np.asarray(cur))
+
+        # The cache itself must be half precision (HBM footprint parity).
+        dm = mod.clone(decode=True, decode_cache_len=10, deterministic=True)
+        from smdistributed_modelparallel_tpu.generation import _half_cast
+
+        _, mut = dm.apply(
+            {"params": _half_cast(params, jnp.bfloat16)}, ids,
+            mutable=["cache"],
+        )
+        leaves = jax.tree_util.tree_leaves(mut["cache"])
+        float_leaves = [
+            l for l in leaves if jnp.issubdtype(l.dtype, jnp.floating)
+        ]
+        assert float_leaves
+        assert all(l.dtype == jnp.bfloat16 for l in float_leaves)
