@@ -62,6 +62,84 @@ def _readback(x):
     return float(np.asarray(x.ravel()[0] if hasattr(x, "ravel") else x))
 
 
+def _wait_for_devices(probe_every=None, window=None, probe_timeout=150):
+    """Bounded probe-retry for the flaky tunneled TPU backend.
+
+    The tunnel has twice wedged exactly during the driver's bench window
+    (BENCH_r03/BENCH_r04: rc=3 after a single 180 s probe). Instead of
+    forfeiting the round's only hardware evidence to a transient wedge,
+    poll ``jax.devices()`` in short-lived SUBPROCESSES (a wedged in-process
+    probe blocks the C++ backend forever and cannot be retried) every
+    ~2 min for up to ~20 min, then give up with the retry log on stderr.
+
+    Env overrides: SMP_BENCH_PROBE_EVERY / SMP_BENCH_PROBE_WINDOW (seconds).
+    """
+    import subprocess
+
+    if probe_every is None:
+        probe_every = int(os.environ.get("SMP_BENCH_PROBE_EVERY", 120))
+    if window is None:
+        window = int(os.environ.get("SMP_BENCH_PROBE_WINDOW", 1200))
+    # A wedged probe hangs until its subprocess timeout; cap it by the
+    # window so short windows (tests, impatient drivers) expire promptly.
+    probe_timeout = min(probe_timeout, max(window, 5))
+    deadline = time.time() + window
+    attempt = 0
+    fast_fails = 0
+    while True:
+        attempt += 1
+        t0 = time.time()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert len(jax.devices()) > 0"],
+                timeout=probe_timeout,
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+            )
+            ok = r.returncode == 0
+            err = r.stderr.decode(errors="replace").strip().splitlines()
+            why = f"rc={r.returncode}" + (
+                ": " + " | ".join(err[-3:]) if not ok and err else "")
+        except subprocess.TimeoutExpired:
+            ok, why = False, f"probe hung >{probe_timeout}s (wedged tunnel?)"
+        elapsed = time.time() - t0
+        if not ok and not why.startswith("probe hung") and elapsed < 20:
+            # A fast nonzero exit is a deterministic failure (import error,
+            # broken backend config), not the transient wedge this loop
+            # exists for — burning the window on it would only hide the
+            # traceback. A SLOW nonzero exit (e.g. jax's own backend-init
+            # wait raising after tens of seconds) still counts as
+            # transient and keeps retrying. Two fast fails in a row:
+            # report and bail like the old in-process path did (rc=4).
+            fast_fails += 1
+            if fast_fails >= 2:
+                sys.stderr.write(
+                    f"bench: device probe failed deterministically "
+                    f"({why}) — not retrying (rc=4).\n")
+                sys.stderr.flush()
+                os._exit(4)
+        else:
+            fast_fails = 0
+        if ok:
+            if attempt > 1:
+                sys.stderr.write(
+                    f"bench: device probe succeeded on attempt {attempt} "
+                    f"after {time.time() - deadline + window:.0f}s.\n")
+            return
+        remaining = deadline - time.time()
+        sys.stderr.write(
+            f"bench: device probe attempt {attempt} failed ({why}); "
+            f"{max(remaining, 0):.0f}s left in retry window.\n")
+        sys.stderr.flush()
+        if remaining <= 0:
+            sys.stderr.write(
+                f"bench: no accelerator after {attempt} probes over "
+                f"{window}s — giving up (rc=3).\n")
+            sys.stderr.flush()
+            os._exit(3)
+        time.sleep(max(0.0, probe_every - (time.time() - t0)))
+
+
 def _devices_or_die(timeout_s=180):
     """jax.devices() with a watchdog: the tunneled TPU backend can wedge so
     hard that devices() never returns — fail with a diagnostic instead of
@@ -98,7 +176,8 @@ def _devices_or_die(timeout_s=180):
 
 
 def main():
-    _devices_or_die()
+    _wait_for_devices()   # bounded retry window (subprocess probes)
+    _devices_or_die()     # in-process backstop: probe ok but main wedges
     import jax
     import jax.numpy as jnp
     import optax

@@ -20,6 +20,8 @@ Design notes (TPU-first):
   decode path); tp/dp/fsdp meshes are fine.
 """
 
+import collections
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,8 +31,13 @@ from smdistributed_modelparallel_tpu.utils.exceptions import SMPValidationError
 
 # Compiled-generator cache: flax modules are frozen dataclasses (hashable
 # when their fields are), so (module, shapes, sampling config) keys a
-# ready program across repeated generate() calls.
-_COMPILED = {}
+# ready program across repeated generate() calls. LRU-bounded: serving
+# ragged prompt shapes would otherwise leak one compiled program per
+# (B, T, max_new_tokens, ...) combination for the process lifetime —
+# callers with more than _COMPILED_CAP live shapes should pad prompts to
+# a fixed set of bucket shapes.
+_COMPILED_CAP = 32
+_COMPILED = collections.OrderedDict()
 
 
 def _top_k_filter(logits, top_k):
@@ -527,8 +534,27 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
                 "the last column kept); right-padded prompts would "
                 "generate from a pad position."
             )
+    if temperature < 0.0:
+        raise SMPValidationError(
+            "temperature must be >= 0 (0 = greedy); a negative value "
+            "would sample from the probability-inverted distribution."
+        )
     if temperature > 0.0 and rng is None:
         raise SMPValidationError("temperature > 0 requires rng=jax.random.key(...)")
+    if temperature == 0.0 and num_beams == 1 and (
+        top_k is not None or top_p is not None
+    ):
+        # HF warns here; we refuse — a user passing top_p=0.9 without a
+        # temperature would silently get greedy output.
+        raise SMPValidationError(
+            "top_k/top_p have no effect with temperature == 0 (greedy "
+            "argmax); pass temperature > 0 to sample (e.g. temperature"
+            "=1.0), or drop the filters."
+        )
+    if top_k is not None and top_k < 1:
+        raise SMPValidationError("top_k must be >= 1.")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise SMPValidationError("top_p must be in (0, 1].")
     if num_beams > 1 and (temperature > 0.0 or top_k is not None
                           or top_p is not None):
         raise SMPValidationError(
@@ -571,6 +597,8 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
                float(length_penalty), num_return_sequences, str(half),
                state.mesh if state.initialized else None)
         compiled = _COMPILED.get(key)
+        if compiled is not None:
+            _COMPILED.move_to_end(key)
     except TypeError:  # unhashable module fields: compile uncached
         key = None
         compiled = None
@@ -595,6 +623,8 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
         compiled = jax.jit(run)
         if key is not None:
             _COMPILED[key] = compiled
+            while len(_COMPILED) > _COMPILED_CAP:
+                _COMPILED.popitem(last=False)
 
     args = (
         (params, input_ids, encoder_mask, rng) if seq2seq

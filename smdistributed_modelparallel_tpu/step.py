@@ -263,6 +263,7 @@ class StepFunction:
             state.loss_scaler.loss_scale if state.loss_scaler else 1.0
         )
         opt_state = opt._opt_state if fused else ()
+        has_backward = getattr(self, "_has_backward", True)
         if model is not None:
             # Forgot-optimizer.step() detector (both paths): a pending
             # fused update OR unconsumed grads with params untouched since
@@ -270,12 +271,16 @@ class StepFunction:
             # discarded. Once is normal (an eval step in between);
             # repeatedly means the model silently never learns. Counter is
             # per-model (multi-model loops warn for the forgotten one) and
-            # reset by that model's optimizer.step().
+            # reset by that model's optimizer.step(). Eval-only steps (no
+            # backward) neither produce nor consume updates — a train step
+            # followed by N eval steps before optimizer.step() is a normal
+            # loop shape, so they don't count.
             stale = model._pending_update is not None or (
                 model._grads_store is not None
                 and model._params is getattr(model, "_params_at_step", None)
             )
-            if stale and not getattr(cfg, "fused_step_donation", False):
+            if (stale and has_backward
+                    and not getattr(cfg, "fused_step_donation", False)):
                 n = getattr(model, "_dropped_updates", 0) + 1
                 model._dropped_updates = n
                 if n == 3:
@@ -286,14 +291,19 @@ class StepFunction:
                         "optimizer.step() after each step (or enable "
                         "fused_step_donation to auto-install updates)."
                     )
-            model._params_at_step = model._params
-            model._pending_update = None
+            # An eval-only step must not clobber the pending train-step
+            # state either: the fused update tuple and the fp16
+            # grads-finite flag belong to the preceding train step and
+            # are consumed by the upcoming optimizer.step().
+            if has_backward:
+                model._params_at_step = model._params
+                model._pending_update = None
         in_params = model.params
         grads, outputs, grads_finite, next_rng, fused_out = compiled(
             in_params, opt_state, scan_vals, bcast_vals, rng, loss_scale
         )
         state.step_rng = next_rng
-        if model is not None:
+        if model is not None and has_backward:
             model._grads_finite = grads_finite
             if grads is not None:
                 raw_div = getattr(compiled, "raw_divisor", None)

@@ -254,6 +254,34 @@ class TestSamplingBehavior:
         with pytest.raises(SMPValidationError):
             smp.generate(mod, ids, 2, params=params, temperature=1.0)
 
+    def test_greedy_with_filters_refused(self):
+        # top_k/top_p are silently inert under temperature == 0 — refuse
+        # rather than hand back greedy output the user didn't ask for.
+        smp.init({})
+        mod = _zoo("learned")
+        ids = jnp.zeros((1, 4), jnp.int32)
+        params = mod.init(jax.random.key(0), ids)["params"]
+        with pytest.raises(SMPValidationError, match="no effect"):
+            smp.generate(mod, ids, 2, params=params, top_p=0.9)
+        with pytest.raises(SMPValidationError, match="no effect"):
+            smp.generate(mod, ids, 2, params=params, top_k=5)
+
+    def test_filter_ranges_validated(self):
+        smp.init({})
+        mod = _zoo("learned")
+        ids = jnp.zeros((1, 4), jnp.int32)
+        params = mod.init(jax.random.key(0), ids)["params"]
+        rng = jax.random.key(0)
+        with pytest.raises(SMPValidationError, match="temperature"):
+            smp.generate(mod, ids, 2, params=params, temperature=-0.5,
+                         top_p=0.9)
+        with pytest.raises(SMPValidationError, match="top_k"):
+            smp.generate(mod, ids, 2, params=params, temperature=1.0,
+                         top_k=0, rng=rng)
+        with pytest.raises(SMPValidationError, match="top_p"):
+            smp.generate(mod, ids, 2, params=params, temperature=1.0,
+                         top_p=0.0, rng=rng)
+
     def test_position_limit_enforced(self):
         smp.init({})
         mod = _zoo("learned", max_len=16)

@@ -345,3 +345,83 @@ def test_no_warning_when_optimizer_steps():
     finally:
         get_logger().removeHandler(handler)
     assert not any("NOT learning" in m for m in records), records
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_eval_step_preserves_pending_train_state(fused):
+    # An eval-only step between a train step and optimizer.step() must
+    # not clobber the train step's pending state: the fused update tuple
+    # and the grads-finite overflow flag are consumed by the upcoming
+    # optimizer.step().
+    smp.init({"microbatches": 1, "fused_optimizer_step": fused})
+    model = smp.DistributedModel(MLP())
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    x, y = make_data(jax.random.key(1))
+
+    @smp.step
+    def train_step(model, xb, yb):
+        loss = jnp.mean(softmax_xent(model(xb), yb))
+        model.backward(loss)
+        return loss
+
+    @smp.step
+    def eval_step(model, xb, yb):
+        return jnp.mean(softmax_xent(model(xb), yb))
+
+    train_step(model, x, y)
+    pending = model._pending_update
+    finite = model._grads_finite
+    grads = model._grads
+    if fused:
+        assert pending is not None
+    eval_step(model, x, y)
+    assert model._pending_update is pending
+    assert model._grads_finite is finite
+    assert model._grads is grads
+    before = np.asarray(jax.tree_util.tree_leaves(model.params)[0])
+    optimizer.step()
+    after = np.asarray(jax.tree_util.tree_leaves(model.params)[0])
+    assert not np.allclose(before, after)
+
+
+def test_no_warning_for_eval_steps_between_updates():
+    # A train step followed by several forward-only eval steps before
+    # optimizer.step() is a normal eval-loop shape: the unconsumed grads
+    # belong to the train step, and the eval steps must not each count
+    # toward the forgot-optimizer.step() detector.
+    import logging
+
+    from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+    smp.init({"microbatches": 1})
+    model = smp.DistributedModel(MLP())
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    x, y = make_data(jax.random.key(1))
+
+    @smp.step
+    def train_step(model, xb, yb):
+        loss = jnp.mean(softmax_xent(model(xb), yb))
+        model.backward(loss)
+        return loss
+
+    @smp.step
+    def eval_step(model, xb, yb):
+        return jnp.mean(softmax_xent(model(xb), yb))
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    get_logger().addHandler(handler)
+    try:
+        for _ in range(3):
+            train_step(model, x, y)
+            for _ in range(4):
+                eval_step(model, x, y)
+            optimizer.step()
+    finally:
+        get_logger().removeHandler(handler)
+    assert not any("NOT learning" in m for m in records), records
