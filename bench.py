@@ -85,7 +85,7 @@ def _wait_for_devices(probe_every=None, window=None, probe_timeout=150):
     probe_timeout = min(probe_timeout, max(window, 5))
     deadline = time.time() + window
     attempt = 0
-    fast_fails = 0
+    first_fast_fail = None
     while True:
         attempt += 1
         t0 = time.time()
@@ -102,42 +102,46 @@ def _wait_for_devices(probe_every=None, window=None, probe_timeout=150):
                 ": " + " | ".join(err[-3:]) if not ok and err else "")
         except subprocess.TimeoutExpired:
             ok, why = False, f"probe hung >{probe_timeout}s (wedged tunnel?)"
-        elapsed = time.time() - t0
-        if not ok and not why.startswith("probe hung") and elapsed < 20:
-            # A fast nonzero exit is a deterministic failure (import error,
-            # broken backend config), not the transient wedge this loop
-            # exists for — burning the window on it would only hide the
-            # traceback. A SLOW nonzero exit (e.g. jax's own backend-init
-            # wait raising after tens of seconds) still counts as
-            # transient and keeps retrying. Two fast fails in a row:
-            # report and bail like the old in-process path did (rc=4).
-            fast_fails += 1
-            if fast_fails >= 2:
-                sys.stderr.write(
-                    f"bench: device probe failed deterministically "
-                    f"({why}) — not retrying (rc=4).\n")
-                sys.stderr.flush()
-                os._exit(4)
-        else:
-            fast_fails = 0
         if ok:
             if attempt > 1:
                 sys.stderr.write(
                     f"bench: device probe succeeded on attempt {attempt} "
                     f"after {time.time() - deadline + window:.0f}s.\n")
             return
+        elapsed = time.time() - t0
+        fast_fail = not why.startswith("probe hung") and elapsed < 20
         remaining = deadline - time.time()
         sys.stderr.write(
             f"bench: device probe attempt {attempt} failed ({why}); "
             f"{max(remaining, 0):.0f}s left in retry window.\n")
         sys.stderr.flush()
+        # Fast nonzero exits could be deterministic (import error, broken
+        # config) OR a transient outage that raises instead of hangs
+        # (connection refused while the tunnel restarts). Retry them on a
+        # short interval; give up rc=4 only once they have persisted
+        # CONSECUTIVELY for 5 min — long enough for a tunnel restart, far
+        # short of burning the whole window on a missing module. Any hang
+        # or slow failure in between resets the fast-fail clock.
+        if fast_fail:
+            if first_fast_fail is None:
+                first_fast_fail = t0
+            if time.time() - first_fast_fail >= min(window, 300):
+                sys.stderr.write(
+                    f"bench: device probe failed fast for 5+ min "
+                    f"({why}) — deterministic failure, not retrying "
+                    "(rc=4).\n")
+                sys.stderr.flush()
+                os._exit(4)
+        else:
+            first_fast_fail = None
         if remaining <= 0:
             sys.stderr.write(
                 f"bench: no accelerator after {attempt} probes over "
                 f"{window}s — giving up (rc=3).\n")
             sys.stderr.flush()
             os._exit(3)
-        time.sleep(max(0.0, probe_every - (time.time() - t0)))
+        interval = 30 if fast_fail else probe_every
+        time.sleep(max(0.0, min(interval - elapsed, remaining)))
 
 
 def _devices_or_die(timeout_s=180):

@@ -1,0 +1,80 @@
+"""Regression guard for the dryrun's per-config compiled metrics.
+
+The driver records ``MULTICHIP_METRIC`` lines in MULTICHIP_r{N}.json;
+``__graft_entry__._compare_to_baseline`` annotates each line with percent
+deltas against the committed ``scripts/multichip_baseline.json`` snapshot
+and flags >10% regressions, so a refactor that inflates compiled
+flops/bytes/temp is visible in the round artifact.
+"""
+
+import json
+
+import __graft_entry__ as ge
+
+
+def test_within_tolerance_annotates_deltas():
+    baseline = {"cfg": {"flops": 100.0, "bytes_accessed": 200.0,
+                        "temp_size_in_bytes": 50}}
+    rec = ge._compare_to_baseline(
+        "cfg",
+        {"config": "cfg", "flops": 105.0, "bytes_accessed": 190.0,
+         "temp_size_in_bytes": 50},
+        baseline,
+    )
+    assert rec["vs_prev"] == {"flops_pct": 5.0, "bytes_accessed_pct": -5.0,
+                              "temp_size_in_bytes_pct": 0.0}
+    assert "regression" not in rec
+
+
+def test_regression_flagged_over_10pct(capsys):
+    baseline = {"cfg": {"flops": 100.0, "bytes_accessed": 200.0,
+                        "temp_size_in_bytes": 50}}
+    rec = ge._compare_to_baseline(
+        "cfg",
+        {"config": "cfg", "flops": 131.0, "bytes_accessed": 200.0,
+         "temp_size_in_bytes": 50},
+        baseline,
+    )
+    assert rec["regression"] is True
+    assert rec["vs_prev"]["flops_pct"] == 31.0
+    assert "MULTICHIP REGRESSION" in capsys.readouterr().err
+
+
+def test_unknown_config_and_missing_keys_pass_through():
+    rec = {"config": "new_cfg", "flops": 7.0}
+    assert ge._compare_to_baseline("new_cfg", dict(rec), {}) == rec
+    assert ge._compare_to_baseline("new_cfg", dict(rec), None) == rec
+    # A config present with empty metrics (e.g. the generate probe, which
+    # has no compile report) must not divide by zero or flag.
+    out = ge._compare_to_baseline(
+        "cfg", {"config": "cfg", "flops": 7.0}, {"cfg": {}}
+    )
+    assert "regression" not in out
+
+
+def test_zero_baseline_to_nonzero_flags(capsys):
+    baseline = {"cfg": {"flops": 100.0, "temp_size_in_bytes": 0}}
+    rec = ge._compare_to_baseline(
+        "cfg", {"config": "cfg", "flops": 100.0, "temp_size_in_bytes": 5e6},
+        baseline,
+    )
+    assert rec["regression"] is True
+    assert rec["vs_prev"]["temp_size_in_bytes_pct"] is None
+    assert "0 -> 5000000" in capsys.readouterr().err
+    # zero -> zero is clean
+    rec = ge._compare_to_baseline(
+        "cfg", {"config": "cfg", "flops": 100.0, "temp_size_in_bytes": 0},
+        baseline,
+    )
+    assert "regression" not in rec
+
+
+def test_committed_baseline_covers_all_step_configs():
+    with open(ge._BASELINE_PATH) as f:
+        snap = json.load(f)
+    for cfg in ("pp2xtp2xrdp2", "cp2xep2xrdp2", "pp4xtp2_gpt2xl_proportions",
+                "tp8_gptj_proportions_act_ckpt",
+                "dp8_bert_style_shard_optimizer_state",
+                "pp2xtp2_t5_style_offload"):
+        assert cfg in snap, f"baseline snapshot missing {cfg}"
+        assert snap[cfg].get("flops"), f"baseline {cfg} has no flops"
