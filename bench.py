@@ -125,9 +125,10 @@ def _wait_for_devices(probe_every=None, window=None, probe_timeout=150):
         if fast_fail:
             if first_fast_fail is None:
                 first_fast_fail = t0
-            if time.time() - first_fast_fail >= min(window, 300):
+            threshold = min(window, 300)
+            if time.time() - first_fast_fail >= threshold:
                 sys.stderr.write(
-                    f"bench: device probe failed fast for 5+ min "
+                    f"bench: device probe failed fast for {threshold}s+ "
                     f"({why}) — deterministic failure, not retrying "
                     "(rc=4).\n")
                 sys.stderr.flush()

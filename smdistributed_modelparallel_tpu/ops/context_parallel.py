@@ -39,8 +39,33 @@ from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.backend.topology import CP_AXIS
 from smdistributed_modelparallel_tpu.ops.pallas_attention import _dropout_keep
 from smdistributed_modelparallel_tpu.utils.exceptions import SMPValidationError
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
 
 NEG_INF = -1e30
+
+logger = get_logger()
+
+# Largest per-kernel-call sequence extent: the flash kernels hold full K/V
+# (forward, dq pass) and full Q (dk/dv pass) blocks in VMEM, so one call's
+# q/kv lengths must stay inside the proven <=8k envelope. Longer per-shard
+# blocks are CHUNKED at this size and merged with the same online-softmax
+# rule the ring already uses (fwd) / additive accumulation (bwd).
+_RING_CHUNK = 8192
+
+# One warning per distinct shape when the Pallas path is unavailable and
+# dispatch falls back to the score-materializing jnp body.
+_FALLBACK_WARNED = set()
+
+
+def _ring_chunks(Tl, chunk, min_len=128):
+    """Smallest split count s with Tl % s == 0 and min_len <= Tl//s <=
+    chunk, or None if no such split exists (then dispatch falls back)."""
+    if Tl <= chunk:
+        return 1 if Tl >= min_len else None
+    for s in range(-(-Tl // chunk), Tl + 1):
+        if Tl % s == 0 and Tl // s <= chunk:
+            return s if Tl // s >= min_len else None
+    return None
 
 
 def cp_size():
@@ -210,7 +235,7 @@ def ring_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
 
 @functools.lru_cache(maxsize=32)
 def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
-                   has_kp, dropout_rate=0.0):
+                   has_kp, dropout_rate=0.0, n_sub=1):
     """custom_vjp ring attention built on the blockwise Pallas kernels.
 
     Forward: per ring step, one flash forward over the (local q block,
@@ -254,35 +279,45 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
         B, Tl, H, hd = q.shape
         rows_g = rows_for(me, Tl)
 
+        C = Tl // n_sub
+
         def step(i, carry):
             u, m_run, z, k_cur, v_cur, kp_cur = carry
             src = (me - i) % n_blocks
-            cols_g = rows_for(src, Tl)
-            o_i, lse_i = flash_fwd_with_ids(
-                q, k_cur, v_cur, kp_cur, rows_g, cols_g,
-                scale=scale, causal=causal, interpret=interpret,
-                seed=seed if dropout_rate > 0.0 else None,
-                dropout_rate=dropout_rate,
-                counter_len=Tl * n_blocks,
-            )
-            lse_i = jnp.where(lse_i > 1e29, NEG_INF, lse_i)
-            m_new = jnp.maximum(m_run, lse_i)
-            m_safe = jnp.maximum(m_new, -1e29)
-            alpha = jnp.where(
-                m_run > NEG_INF / 2, jnp.exp(m_run - m_safe), 0.0
-            )
-            w_i = jnp.where(
-                lse_i > NEG_INF / 2, jnp.exp(lse_i - m_safe), 0.0
-            )
-            u = u * tr(alpha) + o_i.astype(jnp.float32) * tr(w_i)
-            z = z * alpha + w_i
+            cols_full = rows_for(src, Tl)
+            # KV-chunked flash: each sub-call fits the kernels' VMEM
+            # envelope; partials merge with the same online-softmax rule
+            # used across ring steps (n_sub == 1 is the unchunked case).
+            for sub in range(n_sub):
+                sl = slice(sub * C, (sub + 1) * C)
+                o_i, lse_i = flash_fwd_with_ids(
+                    q, k_cur[:, sl], v_cur[:, sl],
+                    kp_cur[:, sl] if kp_cur is not None else None,
+                    rows_g, cols_full[sl],
+                    scale=scale, causal=causal, interpret=interpret,
+                    seed=seed if dropout_rate > 0.0 else None,
+                    dropout_rate=dropout_rate,
+                    counter_len=Tl * n_blocks,
+                )
+                lse_i = jnp.where(lse_i > 1e29, NEG_INF, lse_i)
+                m_new = jnp.maximum(m_run, lse_i)
+                m_safe = jnp.maximum(m_new, -1e29)
+                alpha = jnp.where(
+                    m_run > NEG_INF / 2, jnp.exp(m_run - m_safe), 0.0
+                )
+                w_i = jnp.where(
+                    lse_i > NEG_INF / 2, jnp.exp(lse_i - m_safe), 0.0
+                )
+                u = u * tr(alpha) + o_i.astype(jnp.float32) * tr(w_i)
+                z = z * alpha + w_i
+                m_run = m_new
             k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
             kp_nxt = (
                 jax.lax.ppermute(kp_cur, axis_name, perm)
                 if kp_cur is not None else None
             )
-            return u, m_new, z, k_nxt, v_nxt, kp_nxt
+            return u, m_run, z, k_nxt, v_nxt, kp_nxt
 
         u0 = jnp.zeros((B, Tl, H, hd), jnp.float32)
         m0 = jnp.full((B, H, Tl), NEG_INF, jnp.float32)
@@ -310,20 +345,33 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
         rows_g = rows_for(me, Tl)
         lse_b = jnp.where(lse <= NEG_INF / 2, _LSE_MASKED, lse)
 
+        C = Tl // n_sub
+
         def step(i, carry):
             dq, k_cur, v_cur, kp_cur, dk, dv = carry
             src = (me - i) % n_blocks
-            cols_g = rows_for(src, Tl)
-            dq_i, dk_i, dv_i = flash_bwd_with_ids(
-                q, k_cur, v_cur, o, g, lse_b, kp_cur, rows_g, cols_g,
-                scale=scale, causal=causal, interpret=interpret,
-                seed=seed if dropout_rate > 0.0 else None,
-                dropout_rate=dropout_rate,
-                counter_len=Tl * n_blocks,
-            )
-            dq = dq + dq_i.astype(jnp.float32)
-            dk = dk + dk_i.astype(jnp.float32)
-            dv = dv + dv_i.astype(jnp.float32)
+            cols_full = rows_for(src, Tl)
+            # (q-chunk x kv-chunk) flash calls: with the GLOBAL lse/delta
+            # fixed, each pair's dq/dk/dv contribution is additive, so
+            # chunking both sides keeps every call inside the kernels'
+            # full-Q (dk/dv pass) and full-KV (dq pass) VMEM envelopes.
+            for qs in range(n_sub):
+                qsl = slice(qs * C, (qs + 1) * C)
+                for ks in range(n_sub):
+                    ksl = slice(ks * C, (ks + 1) * C)
+                    dq_i, dk_i, dv_i = flash_bwd_with_ids(
+                        q[:, qsl], k_cur[:, ksl], v_cur[:, ksl],
+                        o[:, qsl], g[:, qsl], lse_b[:, :, qsl],
+                        kp_cur[:, ksl] if kp_cur is not None else None,
+                        rows_g[qsl], cols_full[ksl],
+                        scale=scale, causal=causal, interpret=interpret,
+                        seed=seed if dropout_rate > 0.0 else None,
+                        dropout_rate=dropout_rate,
+                        counter_len=Tl * n_blocks,
+                    )
+                    dq = dq.at[:, qsl].add(dq_i.astype(jnp.float32))
+                    dk = dk.at[:, ksl].add(dk_i.astype(jnp.float32))
+                    dv = dv.at[:, ksl].add(dv_i.astype(jnp.float32))
             # dk/dv ride the ring with k/v: after the full cycle each
             # block's accumulated gradient sits on its owning device.
             k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -371,14 +419,17 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
 
 def ring_attention_local_flash(q, k, v, kpad, seed, *, scale, causal,
                                n_blocks, zigzag, interpret,
-                               dropout_rate=0.0, axis_name=CP_AXIS):
+                               dropout_rate=0.0, n_sub=1,
+                               axis_name=CP_AXIS):
     """Pallas-kernel ring attention body. Dropout hashes on GLOBAL
     (bh, row, col) ids with the T_total stride — bit-identical to the jnp
-    ring/Ulysses bodies, so impls stay interchangeable mid-training."""
+    ring/Ulysses bodies, so impls stay interchangeable mid-training.
+    ``n_sub`` > 1 chunks each ring step's local block so per-shard lengths
+    beyond the kernels' VMEM envelope stay in-kernel."""
     has_seed = seed is not None and dropout_rate > 0.0
     fn = _ring_flash_fn(
         scale, causal, n_blocks, zigzag, axis_name, interpret,
-        kpad is not None, dropout_rate if has_seed else 0.0,
+        kpad is not None, dropout_rate if has_seed else 0.0, n_sub,
     )
     seed_arg = seed if has_seed else jnp.int32(0)
     if kpad is not None:
@@ -496,18 +547,44 @@ def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
     )
     on_tpu = jax.default_backend() == "tpu"
     interpret = not on_tpu
+    n_sub = None
     if on_tpu:
-        flash_ring = flash_cfg and 128 <= T // n <= 8192 and hd <= 256
+        # Per-shard blocks longer than the kernel envelope are CHUNKED
+        # (n_sub > 1), not abandoned: a cp8 x 128k-token run (16k/shard)
+        # stays on the no-materialization flash path.
+        n_sub = _ring_chunks(T // n, _RING_CHUNK)
+        flash_ring = flash_cfg and n_sub is not None and hd <= 256
         flash_uly = flash_cfg and 128 <= T <= 8192 and hd <= 256
     else:
         flash_ring = flash_uly = flash_cfg and _pk.FORCE_INTERPRET
+        if flash_ring:
+            n_sub = _ring_chunks(T // n, _RING_CHUNK, min_len=1)
+            flash_ring = n_sub is not None
+
+    if flash_cfg and on_tpu and (
+        (impl == "ring" and not flash_ring)
+        or (impl == "ulysses" and not flash_uly)
+    ):
+        key = (impl, T, n, hd)
+        if key not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(key)
+            # Ring's jnp body materializes [T/n, T/n] score blocks; the
+            # Ulysses body attends over the full all-to-all'd sequence,
+            # so its fallback cost is the FULL [T, T].
+            ext = T // n if impl == "ring" else T
+            logger.warning(
+                "cp_attention: Pallas flash path unavailable for "
+                "impl=%s T=%d cp=%d hd=%d — falling back to the "
+                "score-materializing jnp body (expect O(%d^2) fp32 "
+                "score temps).", impl, T, n, hd, ext,
+            )
 
     if impl == "ring":
         if flash_ring:
             body_fn = ring_attention_local_flash
             body_kw = dict(scale=scale, causal=causal, n_blocks=n,
                            zigzag=zigzag, interpret=interpret,
-                           dropout_rate=dropout_rate)
+                           dropout_rate=dropout_rate, n_sub=n_sub)
         else:
             body_fn = ring_attention_local
             body_kw = dict(scale=scale, causal=causal, n_blocks=n,

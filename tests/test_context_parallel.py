@@ -358,6 +358,69 @@ class TestCpFlashPath:
         # once; any call at all proves the flash body was dispatched.
         assert len(calls) == 1
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_chunked_ring_parity(self, monkeypatch, causal):
+        """Per-shard blocks beyond _RING_CHUNK split into n_sub kernel
+        calls per ring step (fwd) and n_sub^2 (bwd); outputs and grads
+        must match the jnp ring body bit-for-bit in pattern (dropout on,
+        kpad on) and numerically everywhere."""
+        from smdistributed_modelparallel_tpu.ops import pallas_attention as pk
+        from smdistributed_modelparallel_tpu.ops import context_parallel as cp
+
+        # Tl = 32/4 = 8; chunk 4 -> n_sub = 2.
+        monkeypatch.setattr(cp, "_RING_CHUNK", 4)
+        calls = []
+        orig = pk.flash_fwd_with_ids
+        monkeypatch.setattr(
+            pk, "flash_fwd_with_ids",
+            lambda *a, **kw: calls.append(a[1].shape) or orig(*a, **kw),
+        )
+        q, k, v = self._qkv()
+        kp = self._kpad()
+        seed = jnp.int32(11)
+        grads, outs = {}, {}
+        for pallas in (True, False):
+            smp.shutdown()
+            smp.init({"context_parallel_degree": 4, "ddp": True,
+                      "use_pallas_kernels": pallas})
+            cp._build_cp_call.cache_clear()
+            cp._ring_flash_fn.cache_clear()
+
+            def loss(q, k, v):
+                out = cp.cp_attention(
+                    q, k, v, scale=1.0 / np.sqrt(8), causal=causal,
+                    impl="ring", kpad=kp, dropout_rate=0.2, seed=seed,
+                )
+                return jnp.sum(out ** 2), out
+
+            with jax.set_mesh(state.mesh):
+                g, out = jax.jit(jax.grad(
+                    loss, argnums=(0, 1, 2), has_aux=True))(q, k, v)
+            grads[pallas], outs[pallas] = g, out
+        # The flash run chunked the KV blocks to length 4.
+        assert calls and all(s[1] == 4 for s in calls), calls
+        np.testing.assert_allclose(np.asarray(outs[True]),
+                                   np.asarray(outs[False]), atol=3e-5)
+        for a, b in zip(grads[True], grads[False]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
+    def test_ring_chunks_split_selection(self):
+        from smdistributed_modelparallel_tpu.ops.context_parallel import (
+            _ring_chunks,
+        )
+
+        assert _ring_chunks(4096, 8192) == 1
+        assert _ring_chunks(8192, 8192) == 1
+        assert _ring_chunks(16384, 8192) == 2
+        assert _ring_chunks(32768, 8192) == 4
+        assert _ring_chunks(3 * 8192, 8192) == 3
+        assert _ring_chunks(40960, 8192) == 5
+        # No split with chunks >= 128: falls back (and warns).
+        assert _ring_chunks(64, 8192) is None
+        prime = 13 * 8191
+        assert _ring_chunks(prime, 8192) == 13  # 8191 <= 8192, divides
+
     @pytest.mark.parametrize("impl", ["ring", "ulysses"])
     @pytest.mark.parametrize("causal", [True, False])
     @pytest.mark.parametrize("use_kpad", [False, True])
@@ -522,3 +585,83 @@ class TestCpFlashPath:
         block_bytes = Tl * Tl * 4
         assert temps["flash"] < block_bytes, temps
         assert temps["jnp"] > 4 * block_bytes, temps  # the counterfactual
+
+    @pytest.mark.slow
+    def test_no_score_block_materialized_at_64k(self):
+        """VERDICT r4 ask #2: the r3 proof repeated at cp4 / T=64k
+        (Tl=16k) — beyond the kernels' single-call envelope, so the
+        chunked dispatch (n_sub=2) carries it. The compiled fwd+bwd ring
+        step must still allocate less temp memory than ONE [Tl, Tl] fp32
+        score block (1 GiB here); no jnp counterfactual at this size (it
+        would materialize exactly that block)."""
+        from smdistributed_modelparallel_tpu.ops import pallas_attention as pk
+        from smdistributed_modelparallel_tpu.ops import context_parallel as cp
+
+        smp.shutdown()
+        smp.init({"context_parallel_degree": 4, "ddp": True})
+        B, T, H, hd = 1, 65536, 1, 64
+        Tl = T // 4
+        assert cp._ring_chunks(Tl, cp._RING_CHUNK, min_len=1) == 2
+        ks = jax.random.split(jax.random.key(0), 3)
+        q, k, v = (
+            jax.random.normal(kk, (B, T, H, hd), jnp.float32) for kk in ks
+        )
+
+        def loss(q, k, v):
+            return jnp.sum(cp.cp_attention(
+                q, k, v, scale=1.0 / np.sqrt(hd), causal=True, impl="ring"
+            ) ** 2)
+
+        pk.FORCE_INTERPRET = True
+        cp._build_cp_call.cache_clear()
+        cp._ring_flash_fn.cache_clear()
+        try:
+            with jax.set_mesh(state.mesh):
+                compiled = (
+                    jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                    .lower(q, k, v).compile()
+                )
+        finally:
+            pk.FORCE_INTERPRET = False
+            cp._build_cp_call.cache_clear()
+            cp._ring_flash_fn.cache_clear()
+        temp = compiled.memory_analysis().temp_size_in_bytes
+        assert temp < Tl * Tl * 4, temp
+
+    def test_fallback_to_jnp_body_warns_once(self, monkeypatch):
+        """When the flash path is unavailable on TPU (here: per-shard
+        length below the kernel floor), dispatch must fall back to the
+        jnp body WITH a log line — the silent r4 pathology — and warn
+        once per shape, not per call."""
+        import logging
+
+        from smdistributed_modelparallel_tpu.ops import context_parallel as cp
+        from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+        smp.shutdown()
+        smp.init({"context_parallel_degree": 4, "ddp": True})
+        # Pretend we're on TPU for dispatch; the chosen jnp body runs
+        # fine on CPU (the flash path cannot engage at Tl=8 < 128).
+        monkeypatch.setattr(cp.jax, "default_backend", lambda: "tpu")
+        cp._FALLBACK_WARNED.clear()
+        q, k, v = self._qkv()
+
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = Capture()
+        get_logger().addHandler(handler)
+        try:
+            with jax.set_mesh(state.mesh):
+                for _ in range(2):
+                    jax.jit(lambda q, k, v: cp.cp_attention(
+                        q, k, v, scale=1.0 / np.sqrt(8), causal=True,
+                        impl="ring",
+                    ))(q, k, v)
+        finally:
+            get_logger().removeHandler(handler)
+        warned = [m for m in records if "score-materializing" in m]
+        assert len(warned) == 1, records
