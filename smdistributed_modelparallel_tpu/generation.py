@@ -16,8 +16,11 @@ Design notes (TPU-first):
 - Under tensor parallelism nothing changes here: the decode forward runs
   the same TP-sharded layers; GSPMD shards the [B, C, H, hd] caches over
   the head axis exactly like the activations they buffer.
-- Generation requires ``pp == 1`` (the pipeline head protocol has no
-  decode path); tp/dp/fsdp meshes are fine.
+- Under pipeline parallelism the decode path does not run the pipeline
+  schedule: a ``DistributedModel``'s pp-stage-sharded layer stacks are
+  regathered onto the full mesh (``model.regather_for_decode``, cached
+  until the params change) and decode runs as a plain tp/dp forward —
+  train at pp x tp, then sample, without a topology change.
 """
 
 import collections
@@ -467,16 +470,27 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
       num_return_sequences: beams only — return the top R hypotheses per
         row (R <= num_beams) as a [B, R, L] array instead of [B, L].
 
+    Pipeline parallelism: with a ``DistributedModel`` trained at pp > 1,
+    generation regathers the pp-sharded layer stacks for decode
+    automatically (see ``DistributedModel.regather_for_decode``); a raw
+    flax module under pp needs explicit ``params``.
+
     Returns:
       Decoder-only: [B, T + max_new_tokens] — prompts with continuations.
       Seq2seq: [B, 1 + max_new_tokens] — start token + generated ids.
       With beams, finished rows are "hypothesis + EOS + pad" padded; with
       ``num_return_sequences`` R > 1 the shape gains a rank-R axis.
     """
-    if state.cfg is not None and state.cfg.pipeline_parallel_degree > 1:
+    pp_active = (
+        state.cfg is not None and state.cfg.pipeline_parallel_degree > 1
+    )
+    if pp_active and params is None and not hasattr(
+        model, "regather_for_decode"
+    ):
         raise SMPValidationError(
-            "smp.generate requires pipeline_parallel_degree == 1 "
-            "(tp/dp/fsdp are supported)."
+            "smp.generate under pipeline_parallel_degree > 1 needs a "
+            "DistributedModel (whose pp-sharded params are regathered "
+            "for decode) or explicit params=..."
         )
     if max_new_tokens < 1:
         raise SMPValidationError("max_new_tokens must be >= 1.")
@@ -490,7 +504,14 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
                     (input_ids, input_ids[:, :1]) if seq2seq else (input_ids,)
                 )
                 model._eager_init(init_args, {})
-            params = model.params
+            if pp_active:
+                # Decode is a plain forward (no pipeline schedule): the
+                # pp-stage-sharded layer stacks regather onto the full
+                # mesh, tp/ZeRO axes intact. Cached until the params
+                # change, so steady-state sampling pays no re-gather.
+                params = model.regather_for_decode()
+            else:
+                params = model.params
     else:
         module = model
         seq2seq = hasattr(module, "encode") and hasattr(module, "decode_step")

@@ -374,6 +374,11 @@ class DistributedModel:
     @params.setter
     def params(self, new_params):
         self._params = new_params
+        # The pp-regathered decode copy (regather_for_decode) is keyed to
+        # the old tree; dropping it here frees the full-size gathered
+        # params as soon as they go stale instead of pinning them in HBM
+        # across the following training steps.
+        self._decode_params_cache = None
 
     @property
     def grads(self):
@@ -475,6 +480,7 @@ class DistributedModel:
             jax.tree_util.tree_structure(self._params), new_leaves
         )
         self._params = jax.device_put(params, self._param_shardings)
+        self._decode_params_cache = None
 
     def load_sharded(self, catalog):
         """Load a sharded checkpoint (``shard_io`` catalog): each process
@@ -489,6 +495,7 @@ class DistributedModel:
             self._params = catalog.load_tree(
                 self._params, self._param_shardings
             )
+            self._decode_params_cache = None
         finally:
             catalog.close()
 
@@ -502,6 +509,51 @@ class DistributedModel:
         from smdistributed_modelparallel_tpu.generation import generate
 
         return generate(self, input_ids, max_new_tokens, **kwargs)
+
+    def regather_for_decode(self):
+        """Decode-ready view of the parameters under pipeline parallelism.
+
+        Training at pp > 1 shards stacked layer parameters over the 'pp'
+        mesh axis (one stage's layers per submesh). The decode path is a
+        plain forward — no pipeline schedule — so it wants those stacks
+        whole: this re-places the parameter tree onto shardings with the
+        pp axis stripped (an all-gather along pp over ICI), leaving
+        tp/ZeRO axes in place. Training state is untouched: the original
+        pp-sharded ``self.params`` remain installed, and the regathered
+        tree is cached until the next optimizer step replaces the params.
+
+        Enables the train-at-pp-then-sample workflow the reference
+        supports by exporting to HF (SURVEY §2.3; the reference has no
+        in-framework decode at all).
+        """
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from smdistributed_modelparallel_tpu.backend.topology import PP_AXIS
+
+        if self._params is None:
+            raise SMPValidationError(
+                "Model parameters are not initialized; run a step first."
+            )
+        cached = getattr(self, "_decode_params_cache", None)
+        if cached is not None and cached[0] is self._params:
+            return cached[1]
+
+        def strip_pp(sharding):
+            def drop(ax):
+                if ax == PP_AXIS:
+                    return None
+                if isinstance(ax, (tuple, list)):
+                    kept = tuple(a for a in ax if a != PP_AXIS)
+                    return kept if kept else None
+                return ax
+            spec = P(*(drop(a) for a in sharding.spec))
+            return NamedSharding(sharding.mesh, spec)
+
+        shardings = jax.tree_util.tree_map(strip_pp, self._param_shardings)
+        gathered = jax.device_put(self._params, shardings)
+        self._decode_params_cache = (self._params, gathered)
+        return gathered
 
     def train(self):
         self._train = True

@@ -61,7 +61,21 @@ def masked_vocab_parallel_cross_entropy(logits, targets, ignore_index=-100,
     return jnp.where(valid, per, 0.0)
 
 
-def _want_fused_ce(x, embedding_table):
+def _build_tp_fused_ce(mesh, v_global, block_n, block_v, interpret,
+                       smoothing):
+    """Vocab-parallel fused CE for the tp-sharded table (cached in
+    ``pallas_ce.make_vocab_parallel_fused_ce``; partial-manual over tp
+    only — dp/cp axes stay GSPMD-automatic)."""
+    from smdistributed_modelparallel_tpu.ops.pallas_ce import (
+        make_vocab_parallel_fused_ce,
+    )
+
+    return make_vocab_parallel_fused_ce(
+        mesh, v_global, block_n, block_v, interpret, smoothing, TP_AXIS
+    )
+
+
+def _want_fused_ce(x, embedding_table, tp=1):
     """Policy half of the CE dispatch (capability half: ``pc.fused_ce_ok``).
 
     The blockwise kernel trades ~5/3 the head matmul flops (the backward
@@ -88,8 +102,12 @@ def _want_fused_ce(x, embedding_table):
     # Estimate the materialized path's logits at the ACTIVATION dtype
     # (fp32 activations materialize 4-byte logits plus the softmax's fp32
     # copy — underestimating here would defeat the capacity policy).
+    # Under tp the vocab axis is sharded, so the per-chip logits are
+    # [N, V/tp] — the capacity threshold applies to what one chip holds.
     itemsize = jnp.dtype(x.dtype).itemsize
-    logits_mb = x.shape[0] * embedding_table.shape[0] * itemsize / 2**20
+    logits_mb = (
+        x.shape[0] * embedding_table.shape[0] * itemsize / 2**20 / tp
+    )
     return logits_mb > thresh_mb
 
 
@@ -103,10 +121,13 @@ def fused_lm_head_cross_entropy(hidden, embedding_table, targets,
     (``ops/pallas_ce.py``) — the [.., V] logits tensor, the single largest
     HBM intermediate of large-vocab LM training, never exists. Block sizes
     default to ``pallas_ce.auto_blocks`` (shrunk to fit VMEM for wide D).
-    Falls back to the materialized-logits ``vocab_parallel_cross_entropy``
-    path off-TPU or under tensor parallelism (where the vocab axis is
-    sharded and the Megatron allreduce path is the right tool); a forced
-    ``fused_ce: True`` that cannot run logs a warning at trace time.
+    Under tensor parallelism the kernels run per-shard on the local
+    [V/tp, D] table slice inside a tp manual region, combined with the
+    same pmax/psum pair the materialized Megatron path uses — at modern
+    256k vocabs this is where the capacity win matters most. Falls back
+    to the materialized-logits ``vocab_parallel_cross_entropy`` path
+    off-TPU; a forced ``fused_ce: True`` that cannot run logs a warning
+    at trace time.
 
     Args:
       hidden: [..., D] final hidden states (post final-layernorm).
@@ -126,20 +147,34 @@ def fused_lm_head_cross_entropy(hidden, embedding_table, targets,
     valid = t != ignore_index
     t_safe = jnp.where(valid, t, 0)
     tp = state.mesh.shape.get(TP_AXIS, 1) if state.initialized else 1
-    want = _want_fused_ce(x, embedding_table)
-    can = tp == 1 and pc.fused_ce_ok(x, embedding_table, block_n, block_v)
+    want = _want_fused_ce(x, embedding_table, tp)
+    V = embedding_table.shape[0]
+    can = pc.fused_ce_ok(x, embedding_table, block_n, block_v) and (
+        tp == 1 or V % tp == 0
+    )
     if want and can:
         bn, bv = pc.auto_blocks(D, block_n, block_v)
-        per = pc.fused_lm_head_ce(x, embedding_table, t_safe,
-                                  bn, bv, False,
-                                  float(label_smoothing))
+        if tp == 1:
+            per = pc.fused_lm_head_ce(x, embedding_table, t_safe,
+                                      bn, bv, False,
+                                      float(label_smoothing))
+        else:
+            # Vocab-parallel: per-shard kernels on the local [V/tp, D]
+            # slice, pmax/psum-combined inside a tp manual region — the
+            # Megatron composition of vocab_parallel_cross_entropy with
+            # the logits never materialized.
+            interp = jax.default_backend() != "tpu"
+            fn = _build_tp_fused_ce(
+                state.mesh, V, bn, bv, interp, float(label_smoothing)
+            )
+            per = fn(x, embedding_table, t_safe)
     else:
         if want and not can and state.initialized \
                 and getattr(state.cfg, "fused_ce", "auto") is True:
             import os
 
-            if tp > 1:
-                why = "vocab is tp-sharded"
+            if tp > 1 and V % tp != 0:
+                why = f"vocab {V} not divisible by tp {tp}"
             elif os.environ.get("SMP_DISABLE_FUSED_CE", "0") == "1":
                 why = "SMP_DISABLE_FUSED_CE=1 is set"
             elif jax.default_backend() != "tpu":

@@ -91,7 +91,7 @@ def _fwd_kernel(*refs, block_v, v_total, smoothing):
 
 
 def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, *, block_v,
-                   v_total, smoothing):
+                   v_total, smoothing, smooth_denom=None):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -113,8 +113,10 @@ def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, *, block_v,
     target_mass = (cols == tids).astype(jnp.float32)
     if smoothing:
         # dloss/dlogit = p - (1-eps)*onehot - eps/V on valid columns.
+        # Under vocab sharding (tp) the denominator is the GLOBAL vocab
+        # while the valid mask covers only the local shard.
         target_mass = (1.0 - smoothing) * target_mass + jnp.where(
-            valid, smoothing / v_total, 0.0
+            valid, smoothing / (smooth_denom or v_total), 0.0
         )
     dlog = (p - target_mass) * g
     dx_ref[...] = dx_ref[...] + jax.lax.dot_general(
@@ -124,7 +126,7 @@ def _bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, *, block_v,
 
 
 def _bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dw_ref, *, block_n,
-                   block_v, n_total, v_total, smoothing):
+                   block_v, n_total, v_total, smoothing, smooth_denom=None):
     j = pl.program_id(0)                                # vocab block (outer)
     i = pl.program_id(1)                                # row block (inner)
 
@@ -153,7 +155,7 @@ def _bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dw_ref, *, block_n,
         # All columns of a dW program's block are valid (v_pad slicing
         # happens host-side), but guard like the dx kernel for symmetry.
         target_mass = (1.0 - smoothing) * target_mass + jnp.where(
-            cols < v_total, smoothing / v_total, 0.0
+            cols < v_total, smoothing / (smooth_denom or v_total), 0.0
         )
     dlog = (p - target_mass) * g
     # Padded rows carry g=0 already (their loss cotangent is zero), but
@@ -215,7 +217,7 @@ def _fused_ce_fwd_impl(x, w, targets, block_n, block_v, interpret,
 
 
 def _fused_ce_bwd_impl(x, w, targets, lse, g, block_n, block_v, interpret,
-                       smoothing=0.0):
+                       smoothing=0.0, smooth_denom=None):
     N, D = x.shape
     V = w.shape[0]
     block_n, block_v, n_pad, v_pad = _blocks(N, V, block_n, block_v)
@@ -230,7 +232,7 @@ def _fused_ce_bwd_impl(x, w, targets, lse, g, block_n, block_v, interpret,
 
     dx = pl.pallas_call(
         functools.partial(_bwd_dx_kernel, block_v=block_v, v_total=V,
-                          smoothing=smoothing),
+                          smoothing=smoothing, smooth_denom=smooth_denom),
         grid=(n_pad // block_n, v_pad // block_v),
         in_specs=[
             pl.BlockSpec((block_n, D), lambda i, j: (i, 0)),
@@ -249,7 +251,8 @@ def _fused_ce_bwd_impl(x, w, targets, lse, g, block_n, block_v, interpret,
     row_j = pl.BlockSpec((1, block_n), lambda j, i: (0, i))
     dw = pl.pallas_call(
         functools.partial(_bwd_dw_kernel, block_n=block_n, block_v=block_v,
-                          n_total=N, v_total=V, smoothing=smoothing),
+                          n_total=N, v_total=V, smoothing=smoothing,
+                          smooth_denom=smooth_denom),
         grid=(v_pad // block_v, n_pad // block_n),
         in_specs=[
             pl.BlockSpec((block_n, D), lambda j, i: (i, 0)),
@@ -303,6 +306,96 @@ def _fce_bwd(block_n, block_v, interpret, label_smoothing, res, g):
 
 
 fused_lm_head_ce.defvjp(_fce_fwd, _fce_bwd)
+
+
+@functools.lru_cache(maxsize=32)
+def make_vocab_parallel_fused_ce(mesh, v_global, block_n, block_v,
+                                 interpret, smoothing, axis_name="tp"):
+    """Vocab-parallel fused CE (the Megatron composition of
+    ``nn/cross_entropy.py``, fused): returns ``ce(x, w, targets)`` for a
+    [V, D] table sharded over ``axis_name`` on the given mesh.
+
+    Each shard runs the blockwise kernels on its LOCAL [V/tp, D] table
+    slice with targets shifted into local coordinates (out-of-range
+    targets simply never hit). The custom_vjp lives at GSPMD level;
+    shard_map appears only INSIDE its fwd/bwd implementations (the
+    manual regions are never differentiated through, so no dependence on
+    shard_map's replicated-cotangent transpose rules):
+
+    - fwd: a tp manual region emits per-shard (lse, target-logit,
+      smoothing-sum) stacked on a leading shard axis; the stable
+      log-sum-exp merge and loss assembly happen outside (small GSPMD
+      collectives) — exactly the allreduce(max)/allreduce(sum) pair the
+      materialized path codes (reference ``torch/nn/cross_entropy.py:
+      28-112``).
+    - bwd: a second manual region recomputes logit blocks per shard from
+      the GLOBAL lse, contracting immediately into a psum'd dx
+      (replicated out) and a vocab-sharded dW. Smoothing's eps/V term
+      uses the GLOBAL vocab; the valid-column mask is local.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def _shift(t, v_local):
+        me = jax.lax.axis_index(axis_name)
+        return t.astype(jnp.int32) - me * v_local
+
+    def stats_body(x, w_local, t):
+        lse_l, tgt_l, sum_l = _fused_ce_fwd_impl(
+            x, w_local, _shift(t, w_local.shape[0]),
+            block_n, block_v, interpret, smoothing,
+        )
+        if sum_l is None:
+            sum_l = jnp.zeros_like(lse_l)
+        return lse_l[None], tgt_l[None], sum_l[None]   # [1, N] per shard
+
+    stats_fn = jax.shard_map(
+        stats_body, mesh=mesh,
+        in_specs=(P(), P(axis_name, None), P()),
+        out_specs=(P(axis_name, None),) * 3,
+        axis_names={axis_name},
+        check_vma=False,
+    )
+
+    def bwd_body(x, w_local, t, lse_g, g):
+        dx_l, dw_l = _fused_ce_bwd_impl(
+            x, w_local, _shift(t, w_local.shape[0]), lse_g, g,
+            block_n, block_v, interpret, smoothing,
+            smooth_denom=v_global,
+        )
+        # dx sums vocab-shard contributions -> identical across the axis,
+        # so the unmapped out_spec is sound; dW stays vocab-sharded.
+        dx = jax.lax.psum(dx_l.astype(jnp.float32), axis_name)
+        return dx, dw_l
+
+    bwd_fn = jax.shard_map(
+        bwd_body, mesh=mesh,
+        in_specs=(P(), P(axis_name, None), P(), P(), P()),
+        out_specs=(P(), P(axis_name, None)),
+        axis_names={axis_name},
+        check_vma=False,
+    )
+
+    def fwd_impl(x, w, t):
+        lse_s, tgt_s, sum_s = stats_fn(x, w, t)        # [tp, N]
+        m_g = jnp.max(lse_s, axis=0)
+        z = jnp.sum(jnp.exp(lse_s - m_g[None]), axis=0)
+        lse_g = m_g + jnp.log(jnp.maximum(z, 1e-30))
+        tgt_g = jnp.sum(tgt_s, axis=0)
+        sum_g = jnp.sum(sum_s, axis=0) if smoothing else None
+        loss = _assemble_loss(lse_g, tgt_g, sum_g, v_global, smoothing)
+        return loss, (x, w, t, lse_g)
+
+    @jax.custom_vjp
+    def ce(x, w, t):
+        return fwd_impl(x, w, t)[0]
+
+    def bwd(res, g):
+        x, w, t, lse_g = res
+        dx, dw = bwd_fn(x, w, t, lse_g, g.astype(jnp.float32))
+        return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+    ce.defvjp(fwd_impl, bwd)
+    return jax.jit(ce)
 
 
 def _step_bytes(D, block_n, block_v):

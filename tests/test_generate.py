@@ -196,6 +196,65 @@ class TestDistributedParity:
         want = _greedy_reference(model.module, model.params, ids, 4)
         np.testing.assert_array_equal(np.asarray(out), want)
 
+    def test_generate_after_pp_training(self):
+        """VERDICT r4 ask #3: train at pp2 x tp2, then sample WITHOUT a
+        topology change — the pp-sharded layer stacks regather for
+        decode, token-exact with a pp=1 run of the same trained
+        weights."""
+        import optax
+
+        smp.init({"pipeline_parallel_degree": 2, "tensor_parallel_degree": 2,
+                  "ddp": True, "microbatches": 2})
+        model = smp.DistributedModel(self._nn_head())
+        optimizer = smp.DistributedOptimizer(optax.adamw(1e-3), model)
+
+        @smp.step
+        def train_step(model, ids):
+            logits = model(ids)
+            lg = logits[:, :-1]
+            tgt = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
+            lse = jax.scipy.special.logsumexp(
+                lg.astype(jnp.float32), axis=-1
+            )
+            loss = jnp.mean(lse - tgt.astype(jnp.float32))
+            model.backward(loss)
+            return loss
+
+        batch = jax.random.randint(jax.random.key(8), (4, 16), 0, 97)
+        for _ in range(2):
+            train_step(model, batch)
+            optimizer.step()
+
+        prompts = jax.random.randint(jax.random.key(9), (2, 6), 0, 97)
+        out_mid = np.asarray(model.generate(prompts, 5))
+        # Regathered decode params are cached by params identity.
+        cache = model._decode_params_cache
+        assert cache is not None and cache[0] is model.params
+        out_mid2 = np.asarray(model.generate(prompts, 5))
+        assert model._decode_params_cache is cache
+        np.testing.assert_array_equal(out_mid, out_mid2)
+        # The next optimizer step replaces the params and must drop the
+        # regathered decode copy (it would otherwise pin a full-size
+        # param tree in memory through the rest of training).
+        train_step(model, batch)
+        optimizer.step()
+        assert model._decode_params_cache is None
+
+        trained = model.state_dict()
+        out_pp = np.asarray(model.generate(prompts, 5))
+        beams_pp = np.asarray(model.generate(prompts, 5, num_beams=2))
+
+        # Reference: the same trained weights on a pp=1 tp2 mesh.
+        smp.reset()
+        smp.init({"tensor_parallel_degree": 2, "ddp": True})
+        ref_model = smp.DistributedModel(self._nn_head())
+        ref_model._eager_init((prompts,), {})
+        ref_model.load_state_dict(trained)
+        out_1 = np.asarray(ref_model.generate(prompts, 5))
+        beams_1 = np.asarray(ref_model.generate(prompts, 5, num_beams=2))
+        np.testing.assert_array_equal(out_pp, out_1)
+        np.testing.assert_array_equal(beams_pp, beams_1)
+
 
 class TestSamplingBehavior:
     def test_eos_freezes_rows(self):
@@ -290,12 +349,14 @@ class TestSamplingBehavior:
         with pytest.raises(SMPValidationError):
             smp.generate(mod, ids, 10, params=params)
 
-    def test_pp_refused(self):
+    def test_pp_raw_module_without_params_refused(self):
+        # Under pp, auto-regather needs a DistributedModel; a raw flax
+        # module must come with explicit params.
         smp.init({"pipeline_parallel_degree": 2, "microbatches": 2})
         mod = _zoo("learned")
         ids = jnp.zeros((1, 4), jnp.int32)
-        with pytest.raises(SMPValidationError):
-            smp.generate(mod, ids, 2, params={})
+        with pytest.raises(SMPValidationError, match="regather"):
+            smp.generate(mod, ids, 2)
 
     def test_zero_new_tokens_refused(self):
         smp.init({})

