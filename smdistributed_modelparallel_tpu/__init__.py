@@ -269,6 +269,23 @@ def process_count():
     return state.core.process_count()
 
 
+def instance_id(rank=None):
+    """Host (instance) id of device ``rank`` (default: this process's).
+    Parity: reference ``smp.instance_id`` (backend/core.py:486-489)."""
+    return state.core.instance_id(rank)
+
+
+def is_in_same_instance(rank):
+    """Whether device ``rank`` is on this process's host. Parity:
+    reference ``smp.is_in_same_instance`` (backend/core.py:479-481)."""
+    return state.core.is_in_same_instance(rank)
+
+
+def is_multi_node():
+    """Parity: reference ``smp.is_multi_node`` (backend/core.py:483-485)."""
+    return state.core.is_multi_node()
+
+
 # Process-group aliases (reference naming: get_*_process_group).
 get_pp_process_group = get_pp_group
 get_tp_process_group = get_tp_group

@@ -24,7 +24,10 @@ import os
 import jax
 
 from smdistributed_modelparallel_tpu.backend.topology import DeviceTopology
-from smdistributed_modelparallel_tpu.utils.exceptions import NotInitializedError
+from smdistributed_modelparallel_tpu.utils.exceptions import (
+    NotInitializedError,
+    SMPValidationError,
+)
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 
 logger = get_logger()
@@ -187,6 +190,40 @@ class ModelParallelCore:
 
     def local_size(self):
         return jax.local_device_count()
+
+    def _flat_devices(self):
+        """Cached rank -> device list (per topology: large pods shouldn't
+        rebuild an O(devices) list per instance query)."""
+        cached = getattr(self, "_flat_devices_cache", None)
+        if cached is None or cached[0] is not self.topology:
+            cached = (self.topology, list(self.topology.mesh.devices.flat))
+            self._flat_devices_cache = cached
+        return cached[1]
+
+    def instance_id(self, rank=None):
+        """Host id of the given device rank (default: this process's
+        rank). Ranks index ``mesh.devices.flat``; each device belongs to
+        exactly one jax process, and a process is host-bound — so the
+        reference's "instance" (machine) maps to ``device.process_index``
+        on a TPU pod. Parity: reference ``backend/core.py:486-489``."""
+        self._check()
+        r = self._default_rank() if rank is None else rank
+        flat = self._flat_devices()
+        if not 0 <= r < len(flat):
+            raise SMPValidationError(
+                f"rank {r} out of range [0, {len(flat)})."
+            )
+        return flat[r].process_index
+
+    def is_in_same_instance(self, rank):
+        """Whether device ``rank`` lives on the same host as this
+        process. Parity: reference ``backend/core.py:479-481``."""
+        return self.instance_id(rank) == self.instance_id()
+
+    def is_multi_node(self):
+        """Parity: reference ``backend/core.py:483-485``."""
+        self._check()
+        return jax.process_count() > 1
 
     def pp_rank(self, device_index=None):
         return self.topology.ranker.get_pp_rank(self.rank(device_index))

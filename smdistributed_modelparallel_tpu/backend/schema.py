@@ -298,11 +298,13 @@ SCHEMA = {
         "description": "Reference-compat; not functional.",
     },
     "_match_weights": {
-        "advisory": "use the HF translators/parity tests instead",
         "type": bool,
         "default": False,
         "internal": True,
-        "description": "Debug: slice and copy original weights into distributed modules.",
+        "description": "Debug: verify distributed weights match the source "
+                       "module at distribution time (here: the HF "
+                       "translation round-trips against the source state "
+                       "dict, logged per key).",
     },
     "_fp32_grad_accumulation": {
         "type": bool,

@@ -232,6 +232,14 @@ def _worker_subgroup(rank, world, coord_port, conn):
         assert gathered == list(procs), (gathered, procs)
         smp.barrier(group=CommGroup.TP_GROUP)
 
+        # Instance queries: 4 processes x 1 device each — every device
+        # rank lives on a DIFFERENT host-process, so only this process's
+        # own rank shares its instance.
+        assert smp.instance_id() == rank
+        same = [r for r in range(smp.size()) if smp.is_in_same_instance(r)]
+        assert same == [smp.rank()], same
+        assert smp.is_multi_node()
+
         smp.shutdown()
         conn.send(("ok", rank))
     except Exception as e:  # pragma: no cover - surfaced in parent

@@ -120,3 +120,26 @@ def test_axis_group_cp():
     tp_grp = topo.axis_group(0, TP_AXIS)
     assert tp_grp == list(state.core.get_tp_group(0))
     assert state.core.get_cp_group(0) == topo.axis_group(0, CP_AXIS)
+
+
+def test_instance_queries():
+    """smp.instance_id / is_in_same_instance / is_multi_node (reference
+    backend/core.py:479-489): ranks map to mesh devices; an "instance" is
+    the host (jax process) owning the device. Single-process tier: every
+    rank is on instance 0."""
+    from smdistributed_modelparallel_tpu.utils.exceptions import (
+        SMPValidationError,
+    )
+
+    smp.reset()
+    smp.init({"pipeline_parallel_degree": 2, "tensor_parallel_degree": 2,
+              "ddp": True, "microbatches": 1})
+    assert smp.instance_id() == jax.process_index()
+    for r in range(smp.size()):
+        assert smp.instance_id(r) == 0
+        assert smp.is_in_same_instance(r)
+    assert smp.is_multi_node() == (jax.process_count() > 1)
+    with pytest.raises(SMPValidationError):
+        smp.instance_id(smp.size())
+    with pytest.raises(SMPValidationError):
+        smp.instance_id(-1)
