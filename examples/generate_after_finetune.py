@@ -85,6 +85,29 @@ def main():
                        rng=jax.random.key(0))
     )[0, 8:])
 
+    # ---- The same workflow under PIPELINE parallelism -----------------
+    # Decode never runs the pipeline schedule: smp.generate regathers the
+    # pp-stage-sharded layer stacks onto the full mesh automatically
+    # (model.regather_for_decode, cached between calls), so training at
+    # pp x tp and sampling need no topology change.
+    trained = model.state_dict()
+    smp.reset()
+    smp.init({"pipeline_parallel_degree": 2, "tensor_parallel_degree": 2,
+              "ddp": True, "microbatches": 2})
+    print(f"\npp x tp mesh: {dict(smp.get_mesh().shape)}")
+    model = smp.DistributedModel(
+        gpt2(vocab_size=vocab, max_len=64, d_model=64, n_layers=2, n_heads=4)
+    )
+    optimizer = smp.DistributedOptimizer(optax.adamw(3e-3), model)
+    loss = train_step(model, jnp.asarray(batch())).reduce_mean()
+    optimizer.step()
+    print(f"pp step loss {float(loss):.4f} (fresh init; now loading the "
+          "tp-phase weights)")
+    model.load_state_dict(trained)  # reuse the tp-phase weights
+    out_pp = np.asarray(model.generate(prompts, 8))
+    assert np.array_equal(out_pp, out), "pp decode must match tp decode"
+    print("pp2 x tp2 generation matches the tp2 run token for token")
+
 
 if __name__ == "__main__":
     main()

@@ -30,6 +30,10 @@ class ModelParallelState:
         self.loaded_optimizer_state = None
         self.last_compile_report = None     # one_time_compile_report output
         self._comm = None                   # lazy CollectiveCommunicator
+        # Bumped on every (re-)initialize: compiled-step cache keys include
+        # it, so a program compiled under an old cfg/mesh can never serve a
+        # re-initialized topology (the key's shapes/flags may collide).
+        self.generation = 0
 
     @property
     def comm(self):
@@ -50,6 +54,7 @@ class ModelParallelState:
 
     def initialize(self, cfg, devices=None):
         self.cfg = cfg
+        self.generation += 1
         self.core.initialize(cfg, devices=devices)
         from smdistributed_modelparallel_tpu.utils.random import RngManager
 

@@ -232,7 +232,11 @@ class StepFunction:
         if fused:
             opt._ensure_state()
 
-        key = (treedef, tuple(scan_idx), tuple(bcast_idx),
+        # state.generation pins the entry to the topology it was compiled
+        # under: smp.reset()/re-init with a different cfg or mesh must not
+        # serve a stale program whose shapes/flags happen to collide.
+        key = (state.generation,
+               treedef, tuple(scan_idx), tuple(bcast_idx),
                tuple((i, _static_key(v)) for i, v in sorted(static.items())),
                tuple((v.shape, str(v.dtype)) for v in scan_vals),
                tuple(scan_meta),

@@ -384,6 +384,60 @@ def test_eval_step_preserves_pending_train_state(fused):
     assert not np.allclose(before, after)
 
 
+def test_step_recompiles_after_reinit_same_shapes():
+    # A compiled-step cache entry must not survive smp.reset()/re-init:
+    # without fused_optimizer_step (whose optimizer serial happens to
+    # differ), the cache key's shapes/flags collide across topologies and
+    # a stale program compiled under the DEAD mesh would silently run —
+    # here a pp2 re-init would skip the pipeline schedule entirely.
+    import logging
+
+    from smdistributed_modelparallel_tpu.models.transformer_lm import (
+        TransformerLM,
+    )
+    from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+    def lm():
+        return TransformerLM(vocab_size=32, max_len=12, d_model=16,
+                             n_layers=4, n_heads=2)
+
+    smp.init({"microbatches": 2, "ddp": True,
+              "fused_optimizer_step": False})
+    ids = jax.random.randint(jax.random.key(0), (4, 12), 0, 32)
+
+    @smp.step
+    def train_step(model, batch):
+        logits = model(batch)
+        loss = jnp.mean(logits.astype(jnp.float32) ** 2)
+        model.backward(loss)
+        return loss
+
+    model = smp.DistributedModel(lm())
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    train_step(model, ids)
+    optimizer.step()
+
+    smp.reset()
+    smp.init({"pipeline_parallel_degree": 2, "microbatches": 2,
+              "ddp": True, "fused_optimizer_step": False})
+    model2 = smp.DistributedModel(lm())
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    handler = Capture()
+    get_logger().addHandler(handler)
+    try:
+        train_step(model2, ids)
+    finally:
+        get_logger().removeHandler(handler)
+    assert any("Pipeline partition" in m for m in records), (
+        "re-initialized pp topology did not recompile the step", records)
+
+
 def test_no_warning_for_eval_steps_between_updates():
     # A train step followed by several forward-only eval steps before
     # optimizer.step() is a normal eval-loop shape: the unconsumed grads
