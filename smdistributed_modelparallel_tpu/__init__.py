@@ -269,6 +269,28 @@ def process_count():
     return state.core.process_count()
 
 
+def pp_rank_to_rank(pp_rank):
+    """World rank of pipeline stage ``pp_rank`` in this rank's tp x rdp
+    group. Parity: reference ``backend/core.py:439-446``."""
+    return state.core.pp_rank_to_rank(pp_rank)
+
+
+def tp_rank_to_rank(tp_rank):
+    return state.core.tp_rank_to_rank(tp_rank)
+
+
+def rdp_rank_to_rank(rdp_rank):
+    return state.core.rdp_rank_to_rank(rdp_rank)
+
+
+def dp_rank_to_rank(dp_rank):
+    return state.core.dp_rank_to_rank(dp_rank)
+
+
+def mp_rank_to_rank(mp_rank):
+    return state.core.mp_rank_to_rank(mp_rank)
+
+
 def instance_id(rank=None):
     """Host (instance) id of device ``rank`` (default: this process's).
     Parity: reference ``smp.instance_id`` (backend/core.py:486-489)."""

@@ -271,6 +271,67 @@ class ModelParallelCore:
         self._check()
         return self.topology.ep_size
 
+    # -- rank conversions (parity: reference backend/core.py:439-477) ---
+    # Each converts a per-axis rank into the WORLD rank of the peer
+    # holding that coordinate within this process's other-axis groups.
+
+    @staticmethod
+    def _axis_rank_in_range(value, size, name):
+        """Numpy indexing would silently wrap negatives (pp_rank_to_rank(-1)
+        -> last stage) — an off-by-one would target the wrong peer in a
+        collective, so validate like instance_id does."""
+        if not 0 <= value < size:
+            raise SMPValidationError(
+                f"{name} {value} out of range [0, {size})."
+            )
+
+    def pp_rank_to_rank(self, pp_rank):
+        """World rank of pipeline stage ``pp_rank`` within this rank's
+        tp x rdp group."""
+        self._axis_rank_in_range(pp_rank, self.pp_size(), "pp_rank")
+        rk = self.topology.ranker
+        me = self._default_rank()
+        return rk.translate(pp_rank=pp_rank, tp_rank=rk.get_tp_rank(me),
+                            rdp_rank=rk.get_rdp_rank(me))
+
+    def tp_rank_to_rank(self, tp_rank):
+        self._axis_rank_in_range(tp_rank, self.tp_size(), "tp_rank")
+        rk = self.topology.ranker
+        me = self._default_rank()
+        return rk.translate(pp_rank=rk.get_pp_rank(me), tp_rank=tp_rank,
+                            rdp_rank=rk.get_rdp_rank(me))
+
+    def rdp_rank_to_rank(self, rdp_rank):
+        self._axis_rank_in_range(rdp_rank, self.rdp_size(), "rdp_rank")
+        rk = self.topology.ranker
+        me = self._default_rank()
+        return rk.translate(pp_rank=rk.get_pp_rank(me),
+                            tp_rank=rk.get_tp_rank(me), rdp_rank=rdp_rank)
+
+    def dp_rank_to_rank(self, dp_rank):
+        """World rank of composite-dp rank ``dp_rank`` in this rank's
+        pp group (dp folds tp x rdp, reference composite order)."""
+        self._axis_rank_in_range(dp_rank, self.dp_size(), "dp_rank")
+        rk = self.topology.ranker
+        me = self._default_rank()
+        return rk.translate(
+            pp_rank=rk.get_pp_rank(me),
+            tp_rank=rk.get_tp_rank_from_dp_rank(dp_rank),
+            rdp_rank=rk.get_rdp_rank_from_dp_rank(dp_rank),
+        )
+
+    def mp_rank_to_rank(self, mp_rank):
+        """World rank of composite-mp rank ``mp_rank`` in this rank's
+        rdp group (mp folds pp x tp)."""
+        self._axis_rank_in_range(mp_rank, self.mp_size(), "mp_rank")
+        rk = self.topology.ranker
+        me = self._default_rank()
+        return rk.translate(
+            pp_rank=rk.get_pp_rank_from_mp_rank(mp_rank),
+            tp_rank=rk.get_tp_rank_from_mp_rank(mp_rank),
+            rdp_rank=rk.get_rdp_rank(me),
+        )
+
     def get_pp_group(self, device_index=None):
         return self.topology.ranker.get_pp_group(self.rank(device_index))
 

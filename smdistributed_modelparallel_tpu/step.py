@@ -246,6 +246,12 @@ class StepFunction:
                model.training if model is not None else None)
         compiled = self._cache.get(key)
         if compiled is None:
+            # Prior-generation entries are unreachable (their key[0] can
+            # never match again) — evict them so re-init cycles don't
+            # accumulate dead compiled executables.
+            stale = [k for k in self._cache if k[0] != state.generation]
+            for k in stale:
+                del self._cache[k]
             compiled = self._build(
                 model, treedef, scan_idx, bcast_idx, static, num_mb,
                 scan_meta, opt.build_update_fn() if fused else None,

@@ -417,10 +417,17 @@ def test_step_recompiles_after_reinit_same_shapes():
     train_step(model, ids)
     optimizer.step()
 
+    from smdistributed_modelparallel_tpu.backend.state import state
+
+    gen1 = state.generation
+    keys1 = list(train_step._cache)
+    assert keys1 and all(k[0] == gen1 for k in keys1), keys1
+
     smp.reset()
     smp.init({"pipeline_parallel_degree": 2, "microbatches": 2,
               "ddp": True, "fused_optimizer_step": False})
     model2 = smp.DistributedModel(lm())
+    assert state.generation == gen1 + 1
 
     records = []
 
@@ -435,7 +442,14 @@ def test_step_recompiles_after_reinit_same_shapes():
     finally:
         get_logger().removeHandler(handler)
     assert any("Pipeline partition" in m for m in records), (
-        "re-initialized pp topology did not recompile the step", records)
+        "re-initialized pp topology did not run the pipeline schedule",
+        records)
+    # The discriminating check: the new entry is keyed to the NEW
+    # generation (reverting the generation key would make the old entry's
+    # shapes/flags collide and serve the stale dp-mesh program), and the
+    # unreachable old-generation entry was evicted, not leaked.
+    keys2 = list(train_step._cache)
+    assert keys2 and all(k[0] == gen1 + 1 for k in keys2), keys2
 
 
 def test_no_warning_for_eval_steps_between_updates():

@@ -143,3 +143,51 @@ def test_instance_queries():
         smp.instance_id(smp.size())
     with pytest.raises(SMPValidationError):
         smp.instance_id(-1)
+
+
+def test_rank_conversions():
+    """smp.{pp,tp,rdp,dp,mp}_rank_to_rank (reference backend/core.py:
+    439-477): invert the per-axis rank queries within this process's
+    other-axis groups, for every placement strategy."""
+    for placement in ("cluster", "spread"):
+        smp.reset()
+        smp.init({"pipeline_parallel_degree": 2, "tensor_parallel_degree": 2,
+                  "ddp": True, "microbatches": 1,
+                  "placement_strategy": placement})
+        topo_size = smp.size()
+        rk = smp.rank()
+        # Round-trips: converting this rank's own per-axis rank yields
+        # this rank back.
+        assert smp.pp_rank_to_rank(smp.pp_rank()) == rk
+        assert smp.tp_rank_to_rank(smp.tp_rank()) == rk
+        assert smp.rdp_rank_to_rank(smp.rdp_rank()) == rk
+        assert smp.dp_rank_to_rank(smp.dp_rank()) == rk
+        assert smp.mp_rank_to_rank(smp.mp_rank()) == rk
+        # Structural: pp_rank_to_rank enumerates this rank's pp group in
+        # stage order; dp/mp likewise enumerate their composite groups.
+        from smdistributed_modelparallel_tpu.backend.state import state
+        ranker = state.topology.ranker
+        pp_group = [smp.pp_rank_to_rank(i) for i in range(smp.pp_size())]
+        assert sorted(pp_group) == sorted(smp.get_pp_group())
+        assert [ranker.get_pp_rank(r) for r in pp_group] == list(
+            range(smp.pp_size())
+        )
+        dp_group = [smp.dp_rank_to_rank(i) for i in range(smp.dp_size())]
+        assert sorted(dp_group) == sorted(smp.get_dp_group())
+        mp_group = [smp.mp_rank_to_rank(i) for i in range(smp.mp_size())]
+        assert sorted(mp_group) == sorted(smp.get_mp_group())
+        assert all(0 <= r < topo_size for r in pp_group + dp_group + mp_group)
+        # No silent numpy wraparound or raw IndexError: out-of-range
+        # per-axis ranks raise the API's validation error.
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            SMPValidationError,
+        )
+        for fn, size in ((smp.pp_rank_to_rank, smp.pp_size()),
+                         (smp.tp_rank_to_rank, smp.tp_size()),
+                         (smp.rdp_rank_to_rank, smp.rdp_size()),
+                         (smp.dp_rank_to_rank, smp.dp_size()),
+                         (smp.mp_rank_to_rank, smp.mp_size())):
+            with pytest.raises(SMPValidationError):
+                fn(-1)
+            with pytest.raises(SMPValidationError):
+                fn(size)
