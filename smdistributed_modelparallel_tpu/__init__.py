@@ -380,6 +380,75 @@ def tensor_parallelism(enabled=True, **tp_config):
         mm._active_tp = prev
 
 
+@_contextmanager
+def delay_param_initialization(enabled=True):
+    """Parity: reference ``smp.delay_param_initialization``
+    (``torch/parameter.py``). In this framework delayed initialization is
+    STRUCTURAL, not opt-in: flax modules are declarative, and parameters
+    materialize directly into their mesh shardings on the first step (or
+    ``state_dict`` load) via ``eval_shape`` + ``jit(init, out_shardings)``
+    — no full-size host tensor ever exists (``model.py``,
+    ``tests/test_delayed_init.py``). The context is accepted for source
+    compatibility; ``enabled=False`` cannot force eager host-side init
+    and raises rather than silently diverging from the reference
+    semantics.
+    """
+    if not enabled:
+        raise SMPValidationError(
+            "delay_param_initialization(enabled=False) is not supported: "
+            "parameters always initialize lazily and sharded under the "
+            "JAX runtime (there is no eager host-side init to restore)."
+        )
+    yield
+
+
+@_contextmanager
+def model_creation(tensor_parallelism=False, dtype=None,
+                   **tensor_parallel_config):
+    """Parity: reference ``smp.model_creation`` (``torch/model.py:79``).
+
+    Bundles the reference's model-construction concerns the way they map
+    to this runtime: parameter initialization is always delayed (see
+    ``delay_param_initialization``), and the training compute dtype is
+    the ``bf16``/``fp16`` config (parameters stay fp32 master copies, as
+    the reference's FP16_Module keeps). ``dtype`` must therefore agree
+    with the configured half dtype — a mismatch raises instead of
+    silently creating a model the step would cast differently. With
+    ``tensor_parallelism=True``, modules constructed inside the context
+    are marked for auto-distribution (``smp.tensor_parallelism``).
+    """
+    if dtype is not None:
+        import jax.numpy as _jnp
+
+        # state.cfg survives shutdown()/reset() (other surfaces read it
+        # as a last-known config); the dtype check must only ever consult
+        # the LIVE config, so an uninitialized session is an error rather
+        # than a comparison against a dead or absent config.
+        if not state.initialized:
+            raise SMPValidationError(
+                "model_creation(dtype=...) requires smp.init first (the "
+                "dtype is validated against the configured bf16/fp16 "
+                "compute dtype)."
+            )
+        half = state.cfg.half_dtype
+        want = _jnp.dtype(dtype)
+        allowed = {_jnp.dtype(_jnp.float32)}
+        if half is not None:
+            allowed.add(_jnp.dtype(half))
+        if want not in allowed:
+            raise SMPValidationError(
+                f"model_creation(dtype={want}) conflicts with the "
+                f"configured compute dtype ({half or 'float32'}); set the "
+                "bf16/fp16 config key instead of a per-model dtype."
+            )
+    # The parameter shadows the module-level context manager of the same
+    # name (the reference's signature dictates both names).
+    tp_ctx = globals()["tensor_parallelism"]
+    with tp_ctx(enabled=tensor_parallelism, **tensor_parallel_config):
+        with delay_param_initialization():
+            yield
+
+
 def set_activation_checkpointing(module_prefix, **config):
     _module_manager().set_activation_checkpointing(module_prefix, **config)
 

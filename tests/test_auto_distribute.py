@@ -39,6 +39,46 @@ class TestContextMarking:
         assert isinstance(model.module.dense2, nn.Dense)
         assert model._tp_replaced == ["dense1"]
 
+    def test_model_creation_context(self):
+        """smp.model_creation (reference torch/model.py:79): bundles the
+        tp-construction marking and the always-delayed param init; dtype
+        must agree with the configured compute dtype."""
+        import jax.numpy as jnp
+        import pytest
+
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            SMPValidationError,
+        )
+
+        smp.shutdown()
+        smp.init({"tensor_parallel_degree": 4, "ddp": True, "bf16": True})
+        from smdistributed_modelparallel_tpu.nn import DistributedLinear
+
+        with smp.model_creation(tensor_parallelism=True):
+            d1 = nn.Dense(64)
+        net = UserNet(dense1=d1, dense2=nn.Dense(16))
+        model = smp.DistributedModel(net)
+        assert isinstance(model.module.dense1, DistributedLinear)
+        # dtype agreeing with the config (bf16 or fp32 master) is fine...
+        with smp.model_creation(dtype=jnp.bfloat16):
+            pass
+        with smp.model_creation(dtype=jnp.float32):
+            pass
+        # ...a conflicting half dtype raises instead of diverging.
+        with pytest.raises(SMPValidationError, match="dtype"):
+            with smp.model_creation(dtype=jnp.float16):
+                pass
+        with pytest.raises(SMPValidationError, match="not supported"):
+            with smp.delay_param_initialization(enabled=False):
+                pass
+        with smp.delay_param_initialization():
+            pass
+        # After shutdown the dead config must not validate dtypes.
+        smp.shutdown()
+        with pytest.raises(SMPValidationError, match="smp.init"):
+            with smp.model_creation(dtype=jnp.bfloat16):
+                pass
+
     def test_user_kernel_init_carried_into_distributed_dense(self):
         """VERDICT r3 weak #8: a custom kernel_init on a distributed
         nn.Dense survives the swap (seed-consistent values, not the
