@@ -394,7 +394,11 @@ def delay_param_initialization(enabled=True):
     semantics.
     """
     if not enabled:
-        raise SMPValidationError(
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            SMPUnsupportedError,
+        )
+
+        raise SMPUnsupportedError(
             "delay_param_initialization(enabled=False) is not supported: "
             "parameters always initialize lazily and sharded under the "
             "JAX runtime (there is no eager host-side init to restore)."
@@ -425,11 +429,11 @@ def model_creation(tensor_parallelism=False, dtype=None,
         # the LIVE config, so an uninitialized session is an error rather
         # than a comparison against a dead or absent config.
         if not state.initialized:
-            raise SMPValidationError(
-                "model_creation(dtype=...) requires smp.init first (the "
-                "dtype is validated against the configured bf16/fp16 "
-                "compute dtype)."
+            from smdistributed_modelparallel_tpu.utils.exceptions import (
+                NotInitializedError,
             )
+
+            raise NotInitializedError("smp.model_creation(dtype=...)")
         half = state.cfg.half_dtype
         want = _jnp.dtype(dtype)
         allowed = {_jnp.dtype(_jnp.float32)}

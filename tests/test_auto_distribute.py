@@ -68,7 +68,11 @@ class TestContextMarking:
         with pytest.raises(SMPValidationError, match="dtype"):
             with smp.model_creation(dtype=jnp.float16):
                 pass
-        with pytest.raises(SMPValidationError, match="not supported"):
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            SMPUnsupportedError,
+        )
+
+        with pytest.raises(SMPUnsupportedError, match="not supported"):
             with smp.delay_param_initialization(enabled=False):
                 pass
         with smp.delay_param_initialization():
