@@ -57,6 +57,39 @@ _RING_CHUNK = 8192
 _FALLBACK_WARNED = set()
 
 
+def _tr(a):
+    """[B, H, T] per-row weight -> broadcastable over [B, T, H, hd]."""
+    return a.transpose(0, 2, 1)[..., None]
+
+
+def _merge_partial(u, m_run, z, o_i, lse_i):
+    """One online-softmax merge step for blockwise flash partials.
+
+    Shared by the ring steps, the ring kv chunks, and the Ulysses full-
+    sequence chunks — the merge rule must stay bit-identical across
+    impls, so it lives in exactly one place. ``lse_i`` uses the kernels'
+    +_LSE_MASKED sentinel (> 1e29) for fully-masked rows."""
+    lse_i = jnp.where(lse_i > 1e29, NEG_INF, lse_i)
+    m_new = jnp.maximum(m_run, lse_i)
+    m_safe = jnp.maximum(m_new, -1e29)
+    alpha = jnp.where(m_run > NEG_INF / 2, jnp.exp(m_run - m_safe), 0.0)
+    w_i = jnp.where(lse_i > NEG_INF / 2, jnp.exp(lse_i - m_safe), 0.0)
+    u = u * _tr(alpha) + o_i.astype(jnp.float32) * _tr(w_i)
+    z = z * alpha + w_i
+    return u, m_new, z
+
+
+def _finalize_merge(u, m_run, z, dtype):
+    """(normalized output, global lse with NEG_INF on all-masked rows)."""
+    out = (u / _tr(jnp.maximum(z, 1e-30))).astype(dtype)
+    lse = jnp.where(
+        z > 0.0,
+        jnp.maximum(m_run, -1e29) + jnp.log(jnp.maximum(z, 1e-30)),
+        NEG_INF,
+    )
+    return out, lse
+
+
 def _ring_chunks(Tl, chunk, min_len=128):
     """Smallest split count s with Tl % s == 0 and min_len <= Tl//s <=
     chunk, or None if no such split exists (then dispatch falls back)."""
@@ -265,9 +298,6 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
             return _zig_rows(dev, Tl // 2, n_blocks)
         return dev * Tl + jnp.arange(Tl)
 
-    def tr(a):  # [B, H, T] weight -> broadcastable over [B, T, H, hd]
-        return a.transpose(0, 2, 1)[..., None]
-
     def fwd_impl(q, k, v, kp, seed):
         me = jax.lax.axis_index(axis_name)
         if zigzag:
@@ -299,18 +329,7 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
                     dropout_rate=dropout_rate,
                     counter_len=Tl * n_blocks,
                 )
-                lse_i = jnp.where(lse_i > 1e29, NEG_INF, lse_i)
-                m_new = jnp.maximum(m_run, lse_i)
-                m_safe = jnp.maximum(m_new, -1e29)
-                alpha = jnp.where(
-                    m_run > NEG_INF / 2, jnp.exp(m_run - m_safe), 0.0
-                )
-                w_i = jnp.where(
-                    lse_i > NEG_INF / 2, jnp.exp(lse_i - m_safe), 0.0
-                )
-                u = u * tr(alpha) + o_i.astype(jnp.float32) * tr(w_i)
-                z = z * alpha + w_i
-                m_run = m_new
+                u, m_run, z = _merge_partial(u, m_run, z, o_i, lse_i)
             k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
             kp_nxt = (
@@ -325,12 +344,7 @@ def _ring_flash_fn(scale, causal, n_blocks, zigzag, axis_name, interpret,
         u, m_run, z, _, _, _ = jax.lax.fori_loop(
             0, n_blocks, step, (u0, m0, z0, k, v, kp)
         )
-        out = (u / tr(jnp.maximum(z, 1e-30))).astype(q.dtype)
-        lse = jnp.where(
-            z > 0.0,
-            jnp.maximum(m_run, -1e29) + jnp.log(jnp.maximum(z, 1e-30)),
-            NEG_INF,
-        )
+        out, lse = _finalize_merge(u, m_run, z, q.dtype)
         out_nat = (
             _zig_exit(out, me, n_blocks, axis_name) if zigzag else out
         )
@@ -437,10 +451,110 @@ def ring_attention_local_flash(q, k, v, kpad, seed, *, scale, causal,
     return fn(q, k, v, seed_arg)
 
 
+@functools.lru_cache(maxsize=32)
+def _chunked_full_flash_fn(scale, causal, n_sub, interpret, has_kp,
+                           dropout_rate, head_total, counter_len):
+    """custom_vjp full attention over [B, T, H_local, hd] with T beyond
+    the kernels' single-call VMEM envelope: the same chunk-and-merge
+    composition as the chunked ring (kv chunks online-softmax merged in
+    the forward; (q-chunk x kv-chunk) additive accumulation against the
+    global logsumexp in the backward), minus the ring permutes. Used by
+    the Ulysses body after its all_to_all, so per-device global sequences
+    up to n_sub * _RING_CHUNK stay on the no-materialization path.
+    Dropout hashes with global head ids (head0 runtime arg) and the
+    ``counter_len`` stride — bit-identical to the jnp Ulysses body."""
+    from smdistributed_modelparallel_tpu.ops.pallas_attention import (
+        _LSE_MASKED,
+        flash_bwd_with_ids,
+        flash_fwd_with_ids,
+    )
+
+    def fwd_impl(q, k, v, kp, seed, head0):
+        B, T, H, hd = q.shape
+        C = T // n_sub
+        rows = jnp.arange(T)
+        u = jnp.zeros((B, T, H, hd), jnp.float32)
+        m_run = jnp.full((B, H, T), NEG_INF, jnp.float32)
+        z = jnp.zeros((B, H, T), jnp.float32)
+        for sub in range(n_sub):
+            sl = slice(sub * C, (sub + 1) * C)
+            o_i, lse_i = flash_fwd_with_ids(
+                q, k[:, sl], v[:, sl],
+                kp[:, sl] if kp is not None else None,
+                rows, rows[sl],
+                scale=scale, causal=causal, interpret=interpret,
+                seed=seed if dropout_rate > 0.0 else None,
+                dropout_rate=dropout_rate, counter_len=counter_len,
+                head0=head0 if dropout_rate > 0.0 else None,
+                head_total=head_total,
+            )
+            u, m_run, z = _merge_partial(u, m_run, z, o_i, lse_i)
+        out, lse = _finalize_merge(u, m_run, z, q.dtype)
+        return out, (q, k, v, kp, seed, head0, out, lse)
+
+    def bwd_impl(res, g):
+        q, k, v, kp, seed, head0, o, lse = res
+        B, T, H, hd = q.shape
+        C = T // n_sub
+        rows = jnp.arange(T)
+        lse_b = jnp.where(lse <= NEG_INF / 2, _LSE_MASKED, lse)
+        zq = jnp.zeros((B, T, H, hd), jnp.float32)
+        dq, dk, dv = zq, zq, zq
+        for qs in range(n_sub):
+            qsl = slice(qs * C, (qs + 1) * C)
+            for ks in range(n_sub):
+                if causal and ks > qs:
+                    # Static ids (unlike the ring's rotating blocks):
+                    # every block strictly above the diagonal is fully
+                    # masked — skip the kernel call outright.
+                    continue
+                ksl = slice(ks * C, (ks + 1) * C)
+                dq_i, dk_i, dv_i = flash_bwd_with_ids(
+                    q[:, qsl], k[:, ksl], v[:, ksl],
+                    o[:, qsl], g[:, qsl], lse_b[:, :, qsl],
+                    kp[:, ksl] if kp is not None else None,
+                    rows[qsl], rows[ksl],
+                    scale=scale, causal=causal, interpret=interpret,
+                    seed=seed if dropout_rate > 0.0 else None,
+                    dropout_rate=dropout_rate, counter_len=counter_len,
+                    head0=head0 if dropout_rate > 0.0 else None,
+                    head_total=head_total,
+                )
+                dq = dq.at[:, qsl].add(dq_i.astype(jnp.float32))
+                dk = dk.at[:, ksl].add(dk_i.astype(jnp.float32))
+                dv = dv.at[:, ksl].add(dv_i.astype(jnp.float32))
+        grads = (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+        if has_kp:
+            grads = grads + (jnp.zeros_like(kp),)
+        return grads + (None, None)    # seed, head0: no cotangent
+
+    if has_kp:
+        @jax.custom_vjp
+        def attn(q, k, v, kp, seed, head0):
+            return fwd_impl(q, k, v, kp, seed, head0)[0]
+
+        attn.defvjp(lambda q, k, v, kp, s, h0: fwd_impl(q, k, v, kp, s, h0),
+                    bwd_impl)
+    else:
+        @jax.custom_vjp
+        def attn(q, k, v, seed, head0):
+            return fwd_impl(q, k, v, None, seed, head0)[0]
+
+        attn.defvjp(
+            lambda q, k, v, s, h0: fwd_impl(q, k, v, None, s, h0),
+            bwd_impl,
+        )
+    return attn
+
+
 def ulysses_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
                             dropout_rate, use_flash=False, interpret=False,
-                            axis_name=CP_AXIS):
+                            n_sub=1, axis_name=CP_AXIS):
     """Per-shard Ulysses body: all_to_all heads<->sequence, local attention.
+
+    ``n_sub`` > 1 chunks the post-exchange global sequence through the
+    flash kernels (forward kv chunks online-merged, backward additive),
+    lifting the per-call VMEM ceiling exactly like the chunked ring.
 
     Parity note: the head/sequence exchange is the reference's
     ``scatter_and_merge`` collective (``torch/collectives.py:218-245``).
@@ -477,12 +591,27 @@ def ulysses_attention_local(q, k, v, kpad, seed, *, scale, causal, n_blocks,
         h_local = qg.shape[2]
         use_drop = dropout_rate > 0.0 and seed is not None
         head0 = (me * h_local) if use_drop else None
-        out = flash_attention(
-            qg, kg, vg, kp_full,
-            seed if use_drop else None, head0,
-            scale, causal, None, dropout_rate if use_drop else 0.0,
-            256, 256, interpret, H, T,
-        ).astype(q.dtype)
+        if n_sub > 1:
+            fn = _chunked_full_flash_fn(
+                scale, causal, n_sub, interpret, kp_full is not None,
+                dropout_rate if use_drop else 0.0, H, T,
+            )
+            head0_arg = (
+                (me * h_local).astype(jnp.int32) if use_drop
+                else jnp.int32(0)
+            )
+            seed_arg = seed if use_drop else jnp.int32(0)
+            if kp_full is not None:
+                out = fn(qg, kg, vg, kp_full, seed_arg, head0_arg)
+            else:
+                out = fn(qg, kg, vg, seed_arg, head0_arg)
+        else:
+            out = flash_attention(
+                qg, kg, vg, kp_full,
+                seed if use_drop else None, head0,
+                scale, causal, None, dropout_rate if use_drop else 0.0,
+                256, 256, interpret, H, T,
+            ).astype(q.dtype)
         return jax.lax.all_to_all(
             out, axis_name, split_axis=1, concat_axis=2, tiled=True
         )
@@ -547,19 +676,23 @@ def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
     )
     on_tpu = jax.default_backend() == "tpu"
     interpret = not on_tpu
-    n_sub = None
+    n_sub = n_sub_uly = None
     if on_tpu:
-        # Per-shard blocks longer than the kernel envelope are CHUNKED
-        # (n_sub > 1), not abandoned: a cp8 x 128k-token run (16k/shard)
-        # stays on the no-materialization flash path.
+        # Blocks longer than the kernel envelope are CHUNKED (n_sub > 1),
+        # not abandoned: a cp8 x 128k-token run (16k/shard ring, full-T
+        # Ulysses) stays on the no-materialization flash path.
         n_sub = _ring_chunks(T // n, _RING_CHUNK)
         flash_ring = flash_cfg and n_sub is not None and hd <= 256
-        flash_uly = flash_cfg and 128 <= T <= 8192 and hd <= 256
+        n_sub_uly = _ring_chunks(T, _RING_CHUNK)
+        flash_uly = flash_cfg and n_sub_uly is not None and hd <= 256
     else:
         flash_ring = flash_uly = flash_cfg and _pk.FORCE_INTERPRET
         if flash_ring:
             n_sub = _ring_chunks(T // n, _RING_CHUNK, min_len=1)
             flash_ring = n_sub is not None
+        if flash_uly:
+            n_sub_uly = _ring_chunks(T, _RING_CHUNK, min_len=1)
+            flash_uly = n_sub_uly is not None
 
     if flash_cfg and on_tpu and (
         (impl == "ring" and not flash_ring)
@@ -593,7 +726,8 @@ def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
         body_fn = ulysses_attention_local
         body_kw = dict(scale=scale, causal=causal, n_blocks=n,
                        dropout_rate=dropout_rate, use_flash=flash_uly,
-                       interpret=interpret)
+                       interpret=interpret,
+                       n_sub=n_sub_uly if flash_uly else 1)
     else:
         raise SMPValidationError(f"Unknown context_parallel_impl {impl!r}")
 

@@ -795,14 +795,16 @@ def _rows_to_lse(lse, t_pad):
 
 def flash_fwd_with_ids(q, k, v, kpad_bias, q_ids, kv_ids, *, scale, causal,
                        seed=None, dropout_rate=0.0, counter_len=None,
-                       block_q=None, block_k=None, interpret=False):
+                       block_q=None, block_k=None, interpret=False,
+                       head0=None, head_total=None):
     """One blockwise forward over a (q block, kv block) pair.
 
     Dropout hashes on the GLOBAL ids (rows/cols from q_ids/kv_ids, stride
-    ``counter_len``) so the pattern matches the jnp ring/Ulysses bodies
-    bit for bit. Returns (o [B, T, H, hd] fp32-normalized per-block
-    output, lse [B, H, T] with +_LSE_MASKED sentinel on fully-masked
-    rows).
+    ``counter_len``; ``head0``/``head_total`` remap head-sharded callers'
+    local heads to global ids, as in ``flash_attention``) so the pattern
+    matches the jnp ring/Ulysses bodies bit for bit. Returns (o
+    [B, T, H, hd] fp32-normalized per-block output, lse [B, H, T] with
+    +_LSE_MASKED sentinel on fully-masked rows).
     """
     block_q, block_k = resolve_blocks(block_q, block_k, default_k=256)
     block_q = _clamp_block(block_q, q.shape[1])
@@ -810,7 +812,7 @@ def flash_fwd_with_ids(q, k, v, kpad_bias, q_ids, kv_ids, *, scale, causal,
     o, lse = _flash_fwd_impl(
         q, k, v, kpad_bias, seed, scale, causal, None, dropout_rate,
         block_q, block_k, interpret, q_ids=q_ids, kv_ids=kv_ids,
-        counter_len=counter_len,
+        counter_len=counter_len, head0=head0, head_total=head_total,
     )
     B, T, H = q.shape[0], q.shape[1], q.shape[2]
     return o, _lse_to_rows(lse, B, H, T)
@@ -819,7 +821,7 @@ def flash_fwd_with_ids(q, k, v, kpad_bias, q_ids, kv_ids, *, scale, causal,
 def flash_bwd_with_ids(q, k, v, o, g, lse, kpad_bias, q_ids, kv_ids, *,
                        scale, causal, seed=None, dropout_rate=0.0,
                        counter_len=None, block_q=None, block_k=None,
-                       interpret=False):
+                       interpret=False, head0=None, head_total=None):
     """Blockwise backward for one (q block, kv block) pair given the GLOBAL
     per-row logsumexp ``lse`` [B, H, T] (+_LSE_MASKED sentinel rows) and
     the GLOBAL output ``o`` / cotangent ``g``. Returns (dq, dk, dv)."""
@@ -831,5 +833,6 @@ def flash_bwd_with_ids(q, k, v, o, g, lse, kpad_bias, q_ids, kv_ids, *,
     return _flash_bwd_impl(
         q, k, v, o, g, lse_raw, kpad_bias, seed, scale, causal, None,
         dropout_rate, block_q, block_k, interpret, q_ids=q_ids,
-        kv_ids=kv_ids, counter_len=counter_len,
+        kv_ids=kv_ids, counter_len=counter_len, head0=head0,
+        head_total=head_total,
     )

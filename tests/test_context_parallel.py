@@ -405,6 +405,54 @@ class TestCpFlashPath:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_chunked_ulysses_parity(self, monkeypatch, causal):
+        """Global sequences beyond _RING_CHUNK run the chunked full-flash
+        body after the Ulysses all_to_all (n_sub kv chunks fwd, n_sub^2
+        bwd); outputs and grads must match the jnp Ulysses body with
+        dropout (global head0 hash) and kpad active."""
+        from smdistributed_modelparallel_tpu.ops import pallas_attention as pk
+        from smdistributed_modelparallel_tpu.ops import context_parallel as cp
+
+        # T = 32; chunk 16 -> n_sub = 2 for the full-T Ulysses sequence.
+        monkeypatch.setattr(cp, "_RING_CHUNK", 16)
+        calls = []
+        orig = pk.flash_fwd_with_ids
+        monkeypatch.setattr(
+            pk, "flash_fwd_with_ids",
+            lambda *a, **kw: calls.append(a[1].shape) or orig(*a, **kw),
+        )
+        q, k, v = self._qkv()
+        kp = self._kpad()
+        seed = jnp.int32(23)
+        grads, outs = {}, {}
+        for pallas in (True, False):
+            smp.shutdown()
+            smp.init({"context_parallel_degree": 4, "ddp": True,
+                      "context_parallel_impl": "ulysses",
+                      "use_pallas_kernels": pallas})
+            cp._build_cp_call.cache_clear()
+            cp._chunked_full_flash_fn.cache_clear()
+
+            def loss(q, k, v):
+                out = cp.cp_attention(
+                    q, k, v, scale=1.0 / np.sqrt(8), causal=causal,
+                    impl="ulysses", kpad=kp, dropout_rate=0.2, seed=seed,
+                )
+                return jnp.sum(out ** 2), out
+
+            with jax.set_mesh(state.mesh):
+                g, out = jax.jit(jax.grad(
+                    loss, argnums=(0, 1, 2), has_aux=True))(q, k, v)
+            grads[pallas], outs[pallas] = g, out
+        # The flash run chunked the post-exchange kv to length 16.
+        assert calls and all(s[1] == 16 for s in calls), calls
+        np.testing.assert_allclose(np.asarray(outs[True]),
+                                   np.asarray(outs[False]), atol=3e-5)
+        for a, b in zip(grads[True], grads[False]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
     def test_ring_chunks_split_selection(self):
         from smdistributed_modelparallel_tpu.ops.context_parallel import (
             _ring_chunks,
@@ -627,6 +675,47 @@ class TestCpFlashPath:
             cp._ring_flash_fn.cache_clear()
         temp = compiled.memory_analysis().temp_size_in_bytes
         assert temp < Tl * Tl * 4, temp
+
+    @pytest.mark.slow
+    def test_ulysses_no_score_block_materialized_at_32k(self):
+        """Chunked Ulysses at cp4 / T=32k (n_sub=4 over the full
+        post-exchange sequence): the compiled fwd+bwd step must allocate
+        less temp memory than ONE [T, T] fp32 score matrix — the jnp body
+        would materialize exactly that."""
+        from smdistributed_modelparallel_tpu.ops import pallas_attention as pk
+        from smdistributed_modelparallel_tpu.ops import context_parallel as cp
+
+        smp.shutdown()
+        smp.init({"context_parallel_degree": 4, "ddp": True,
+                  "context_parallel_impl": "ulysses"})
+        B, T, H, hd = 1, 32768, 4, 64
+        assert cp._ring_chunks(T, cp._RING_CHUNK, min_len=1) == 4
+        ks = jax.random.split(jax.random.key(0), 3)
+        q, k, v = (
+            jax.random.normal(kk, (B, T, H, hd), jnp.float32) for kk in ks
+        )
+
+        def loss(q, k, v):
+            return jnp.sum(cp.cp_attention(
+                q, k, v, scale=1.0 / np.sqrt(hd), causal=True,
+                impl="ulysses",
+            ) ** 2)
+
+        pk.FORCE_INTERPRET = True
+        cp._build_cp_call.cache_clear()
+        cp._chunked_full_flash_fn.cache_clear()
+        try:
+            with jax.set_mesh(state.mesh):
+                compiled = (
+                    jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+                    .lower(q, k, v).compile()
+                )
+        finally:
+            pk.FORCE_INTERPRET = False
+            cp._build_cp_call.cache_clear()
+            cp._chunked_full_flash_fn.cache_clear()
+        temp = compiled.memory_analysis().temp_size_in_bytes
+        assert temp < T * T * 4, temp
 
     def test_fallback_to_jnp_body_warns_once(self, monkeypatch):
         """When the flash path is unavailable on TPU (here: per-shard
