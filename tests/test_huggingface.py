@@ -400,6 +400,21 @@ class TestMatchWeights:
         assert mism, records
         assert any("translator pair is inconsistent" in m for m in records)
 
+    def test_t5_translator_round_trips_clean(self):
+        # The seq2seq family's bidirectional translators (the largest
+        # translator pair) under the same distribute-time verification.
+        hf = _t5_hf()
+        smp.reset()
+        smp.init({"microbatches": 1, "_match_weights": True})
+        records, handler, lg = self._capture()
+        lg.addHandler(handler)
+        try:
+            smp.from_hf(hf, deterministic=True)
+        finally:
+            lg.removeHandler(handler)
+        assert not any("MISMATCH" in m for m in records), records
+        assert any("round-trip" in m for m in records), records
+
     def test_off_by_default(self):
         hf = _hf_model("gpt2", _tiny_configs()["gpt2"])
         smp.reset()
