@@ -191,3 +191,35 @@ def test_rank_conversions():
                 fn(-1)
             with pytest.raises(SMPValidationError):
                 fn(size)
+
+
+def test_public_surface_queries():
+    """Smoke every public rank/size/group/barrier query through the smp
+    surface (several were previously only exercised via state.core) on a
+    cp2 x pp2 x tp2 mesh — values must be mutually consistent."""
+    smp.reset()
+    smp.init({"context_parallel_degree": 2, "pipeline_parallel_degree": 2,
+              "tensor_parallel_degree": 2, "ddp": True, "microbatches": 3})
+    assert smp.local_rank() == 0
+    assert smp.local_size() == jax.local_device_count()
+    assert 0 <= smp.cp_rank() < smp.cp_size() == 2
+    assert smp.num_microbatches() == 3
+    assert smp.process_index() == 0 and smp.process_count() == 1
+    assert not smp.is_tracing()
+    tp_group = smp.get_tp_group()
+    rdp_group = smp.get_rdp_group()
+    assert len(tp_group) == 2 and smp.rank() in tp_group
+    assert smp.rank() in rdp_group
+    # Single-process tier: subgroup barriers complete without peers.
+    smp.mp_barrier()
+    smp.tp_barrier()
+    smp.rdp_barrier()
+    # get_partition reflects the partitioner's ASSIGNMENT (stage 0 until
+    # a step has partitioned; pin honoring is covered in
+    # test_config_honored) and validates its argument type.
+    assert smp.get_partition("transformer/layer0") == 0
+    from smdistributed_modelparallel_tpu.utils.exceptions import (
+        SMPValidationError,
+    )
+    with pytest.raises(SMPValidationError):
+        smp.get_partition(123)
