@@ -89,11 +89,15 @@ def _wait_for_devices(probe_every=None, window=None, probe_timeout=150):
     while True:
         attempt += 1
         t0 = time.time()
+        # Cap each probe by the REMAINING window too: a probe that wedges
+        # just before the deadline must not extend the total wait to
+        # window + probe_timeout (ADVICE round 5).
+        this_timeout = max(min(probe_timeout, deadline - t0), 5)
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; assert len(jax.devices()) > 0"],
-                timeout=probe_timeout,
+                timeout=this_timeout,
                 stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
             )
             ok = r.returncode == 0
@@ -101,7 +105,7 @@ def _wait_for_devices(probe_every=None, window=None, probe_timeout=150):
             why = f"rc={r.returncode}" + (
                 ": " + " | ".join(err[-3:]) if not ok and err else "")
         except subprocess.TimeoutExpired:
-            ok, why = False, f"probe hung >{probe_timeout}s (wedged tunnel?)"
+            ok, why = False, f"probe hung >{this_timeout:.0f}s (wedged tunnel?)"
         if ok:
             if attempt > 1:
                 sys.stderr.write(

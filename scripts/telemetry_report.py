@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Pretty-print a step report from an SMP telemetry JSON dump.
+
+Usage:
+    SMP_TELEMETRY_PATH=/tmp/telemetry.json python train.py ...
+    python scripts/telemetry_report.py /tmp/telemetry.json
+    python scripts/telemetry_report.py /tmp/telemetry.json --prometheus
+
+Renders the run the way the reference's one-time Studio metrics upload was
+read: throughput (tokens/sec), pipeline bubble fraction (measured vs the
+(pp-1)/(mb+pp-1) bound), host comm volume by collective, compile-cache
+behavior and compile wall time, XLA-counted FLOPs/bytes of the compiled
+step, and peak HBM per device. Stdlib only — runnable anywhere the JSON
+can be copied to, no jax required.
+"""
+
+import argparse
+import json
+import sys
+
+
+def _series(report, name):
+    fam = report.get("metrics", {}).get(name)
+    return fam["series"] if fam else []
+
+
+def _value(report, name, default=None, **labels):
+    for s in _series(report, name):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s.get("value", default)
+    return default
+
+
+def _hist_totals(report, name):
+    """(sum, count) aggregated over every label set of a histogram."""
+    total, count = 0.0, 0
+    for s in _series(report, name):
+        total += s.get("sum", 0.0)
+        count += s.get("count", 0)
+    return total, count
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "n/a"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n):,} B"
+        n /= 1024
+    return f"{n:,.1f} TiB"
+
+
+def _fmt_num(n):
+    if n is None:
+        return "n/a"
+    n = float(n)
+    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if abs(n) >= div:
+            return f"{n / div:,.2f}{unit}"
+    return f"{n:,.0f}"
+
+
+def render(report, out=sys.stdout):
+    w = out.write
+    meta = report.get("meta", {})
+    w("=== SMP step report ===\n")
+    w(f"pid {meta.get('pid')}  phase {meta.get('phase')!r} "
+      f"(age {meta.get('phase_age_seconds', 0):.1f}s)\n")
+    history = meta.get("phase_history", [])[-5:]
+    if history:
+        w("recent phases: " + " -> ".join(p["phase"] for p in history) + "\n")
+
+    # -- throughput -----------------------------------------------------
+    steps = _value(report, "smp_step_total", 0)
+    tokens = _value(report, "smp_step_tokens_total")
+    disp_sum, disp_count = _hist_totals(report, "smp_step_dispatch_seconds")
+    w("\n-- throughput --\n")
+    w(f"steps: {int(steps or 0)}   tokens: {_fmt_num(tokens)}\n")
+    if disp_count:
+        w(f"dispatch wall: {disp_sum:.3f}s over {disp_count} steps "
+          f"({disp_sum / disp_count:.3f}s/step)\n")
+        if tokens and disp_sum > 0:
+            w(f"tokens/sec (host dispatch bound): {_fmt_num(tokens / disp_sum)}\n")
+
+    # -- pipeline bubble ------------------------------------------------
+    bubbles = _series(report, "smp_pipeline_bubble_fraction")
+    if bubbles:
+        w("\n-- pipeline --\n")
+        for s in bubbles:
+            sched = s["labels"].get("schedule", "?")
+            theo = _value(
+                report, "smp_pipeline_bubble_fraction_theoretical",
+                schedule=sched,
+            )
+            pp = _value(report, "smp_pipeline_stages", schedule=sched)
+            mb = _value(report, "smp_pipeline_microbatches", schedule=sched)
+            w(f"{sched}: bubble {100 * s['value']:.1f}% measured"
+              + (f" vs {100 * theo:.1f}% fill-drain bound" if theo is not None else "")
+              + (f"  (pp={int(pp)}, mb={int(mb)})" if pp and mb else "")
+              + "\n")
+
+    # -- comm volume ----------------------------------------------------
+    ops = _series(report, "smp_comm_ops_total")
+    if ops:
+        w("\n-- host collectives --\n")
+        w(f"{'op':<12}{'group':<12}{'calls':>8}{'bytes':>14}\n")
+        for s in sorted(ops, key=lambda s: (s["labels"].get("op", ""),
+                                            s["labels"].get("group", ""))):
+            op = s["labels"].get("op", "?")
+            grp = s["labels"].get("group", "?")
+            nbytes = _value(report, "smp_comm_bytes_total", 0, op=op, group=grp)
+            w(f"{op:<12}{grp:<12}{int(s['value']):>8}"
+              f"{_fmt_bytes(nbytes):>14}\n")
+
+    # -- compile --------------------------------------------------------
+    hits = _value(report, "smp_step_compile_cache_total", 0, event="hit")
+    misses = _value(report, "smp_step_compile_cache_total", 0, event="miss")
+    comp_sum, comp_count = _hist_totals(report, "smp_step_compile_seconds")
+    if hits or misses or comp_count:
+        w("\n-- compilation --\n")
+        w(f"step cache: {int(hits or 0)} hits / {int(misses or 0)} misses\n")
+        if comp_count:
+            w(f"XLA compile wall: {comp_sum:.1f}s over {comp_count} compiles\n")
+    for s in _series(report, "smp_compiled_step_flops"):
+        name = s["labels"].get("step", "?")
+        ba = _value(report, "smp_compiled_step_bytes_accessed", step=name)
+        tmp = _value(report, "smp_compiled_step_temp_bytes", step=name)
+        w(f"compiled {name}: {_fmt_num(s['value'])} FLOPs, "
+          f"{_fmt_bytes(ba)} accessed, {_fmt_bytes(tmp)} temp\n")
+
+    # -- memory ---------------------------------------------------------
+    peaks = _series(report, "smp_device_peak_hbm_bytes")
+    w("\n-- memory --\n")
+    if peaks:
+        for s in sorted(peaks, key=lambda s: s["labels"].get("device", "")):
+            limit = _value(
+                report, "smp_device_hbm_bytes_limit",
+                device=s["labels"].get("device"),
+            )
+            w(f"peak HBM {s['labels'].get('device', '?')}: "
+              f"{_fmt_bytes(s['value'])}"
+              + (f" / {_fmt_bytes(limit)}" if limit else "") + "\n")
+    else:
+        w("peak HBM: n/a (backend reports no allocator stats)\n")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Pretty-print an SMP telemetry JSON dump "
+        "(SMP_TELEMETRY_PATH) as a step report."
+    )
+    ap.add_argument("path", help="telemetry JSON file")
+    ap.add_argument(
+        "--prometheus", action="store_true",
+        help="re-render the dump's metrics in Prometheus text format",
+    )
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"cannot read telemetry dump {args.path}: {e}\n")
+        return 2
+    if args.prometheus:
+        for name, fam in sorted(report.get("metrics", {}).items()):
+            sys.stdout.write(f"# TYPE {name} {fam['kind']}\n")
+            for s in fam["series"]:
+                lab = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(s["labels"].items())
+                )
+                sfx = f"{{{lab}}}" if lab else ""
+                if fam["kind"] == "histogram":
+                    acc = 0
+                    for b, c in zip(
+                        list(s.get("buckets", [])) + ["+Inf"], s["counts"]
+                    ):
+                        acc += c
+                        ble = (lab + "," if lab else "") + f'le="{b}"'
+                        sys.stdout.write(f"{name}_bucket{{{ble}}} {acc}\n")
+                    sys.stdout.write(f"{name}_sum{sfx} {s['sum']}\n")
+                    sys.stdout.write(f"{name}_count{sfx} {s['count']}\n")
+                else:
+                    sys.stdout.write(f"{name}{sfx} {s['value']}\n")
+        return 0
+    return render(report)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,8 +37,31 @@ import jax
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.utils.exceptions import SMPRuntimeError
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import (
+    record_comm,
+    telemetry,
+    watchdog,
+)
 
 logger = get_logger()
+
+
+def _payload_size(obj):
+    """Approximate payload size for the comm-volume counters on the
+    short-circuit paths (which never pickle). Raw buffers/arrays are sized
+    cheaply — pickling a multi-GB array tree just to count bytes would cost
+    seconds and 2x transient host memory; everything else (small
+    control-plane objects) pays one pickle. Best-effort: an unpicklable
+    object must not start failing just to be counted."""
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return len(obj)
+    leaves = jax.tree_util.tree_leaves(obj)
+    if leaves and all(hasattr(l, "nbytes") for l in leaves):
+        return int(sum(l.nbytes for l in leaves))
+    try:
+        return len(pickle.dumps(obj))
+    except Exception:
+        return 0
 
 
 class CommGroup(Enum):
@@ -223,21 +246,26 @@ class CollectiveCommunicator:
         group's processes. Full-world broadcasts ride multihost_utils;
         proper subgroups ride the native bus (only members may call)."""
         if not self._multi():
+            record_comm("broadcast", group, _payload_size(obj), 1)
             return obj
         procs = self.group_processes(group)
         if len(procs) < jax.process_count():
-            return self._subgroup_broadcast(obj, procs, src, group)
+            out, nbytes = self._subgroup_broadcast(obj, procs, src, group)
+            record_comm("broadcast", group, nbytes, len(procs))
+            return out
         from jax.experimental import multihost_utils
 
         payload = pickle.dumps(obj) if jax.process_index() == src else b""
-        # Length-prefix exchange, then the payload as a uint8 array.
-        n = multihost_utils.broadcast_one_to_all(
-            np.array([len(payload)], dtype=np.int64), is_source=jax.process_index() == src
-        )
-        buf = np.frombuffer(payload.ljust(int(n[0]), b"\0"), dtype=np.uint8)
-        out = multihost_utils.broadcast_one_to_all(
-            buf, is_source=jax.process_index() == src
-        )
+        with watchdog.guard(f"broadcast/{getattr(group, 'name', group)}"):
+            # Length-prefix exchange, then the payload as a uint8 array.
+            n = multihost_utils.broadcast_one_to_all(
+                np.array([len(payload)], dtype=np.int64), is_source=jax.process_index() == src
+            )
+            buf = np.frombuffer(payload.ljust(int(n[0]), b"\0"), dtype=np.uint8)
+            out = multihost_utils.broadcast_one_to_all(
+                buf, is_source=jax.process_index() == src
+            )
+        record_comm("broadcast", group, int(n[0]), len(procs))
         return pickle.loads(np.asarray(out).tobytes()[: int(n[0])])
 
     def allgather(self, obj, group=CommGroup.WORLD):
@@ -248,21 +276,26 @@ class CollectiveCommunicator:
         one padded uint8 process_allgather) — not P sequential broadcasts.
         """
         if not self._multi():
+            record_comm("allgather", group, _payload_size(obj), 1)
             return [obj]
         procs = self.group_processes(group)
         if len(procs) < jax.process_count():
-            return self._subgroup_allgather(obj, procs, group)
+            out, nbytes = self._subgroup_allgather(obj, procs, group)
+            record_comm("allgather", group, nbytes, len(procs))
+            return out
         from jax.experimental import multihost_utils
 
         payload = pickle.dumps(obj)
-        lens = np.asarray(
-            multihost_utils.process_allgather(
-                np.asarray([len(payload)], np.int64)
-            )
-        ).reshape(-1)
-        row = np.zeros(int(lens.max()), np.uint8)
-        row[: len(payload)] = np.frombuffer(payload, np.uint8)
-        rows = np.asarray(multihost_utils.process_allgather(row))
+        with watchdog.guard(f"allgather/{getattr(group, 'name', group)}"):
+            lens = np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([len(payload)], np.int64)
+                )
+            ).reshape(-1)
+            row = np.zeros(int(lens.max()), np.uint8)
+            row[: len(payload)] = np.frombuffer(payload, np.uint8)
+            rows = np.asarray(multihost_utils.process_allgather(row))
+        record_comm("allgather", group, int(lens.sum()), len(procs))
         return [
             pickle.loads(bytes(rows[i])[: int(lens[i])])
             for i in range(jax.process_count())
@@ -282,10 +315,12 @@ class CollectiveCommunicator:
             )
         root = procs[src]
         if me == root:
+            # Pickle ONCE for both the per-peer sends and the byte counter.
+            payload = pickle.dumps(obj)
             for p in procs:
                 if p != me:
-                    self._int_send(p, obj)
-            return obj
+                    self._int_send_bytes(p, payload)
+            return obj, len(payload)
         return self._int_recv(root)
 
     def _subgroup_allgather(self, obj, procs, group):
@@ -297,28 +332,42 @@ class CollectiveCommunicator:
             )
         root = procs[0]
         if me == root:
-            gathered = []
+            gathered, nbytes = [], 0
             for p in procs:
-                gathered.append(obj if p == me else self._int_recv(p))
+                if p == me:
+                    gathered.append(obj)
+                else:
+                    o, n = self._int_recv(p)
+                    gathered.append(o)
+                    nbytes += n
+            payload = pickle.dumps(gathered)
             for p in procs:
                 if p != me:
-                    self._int_send(p, gathered)
-            return gathered
+                    self._int_send_bytes(p, payload)
+            return gathered, nbytes + len(payload)
         self._int_send(root, obj)
         return self._int_recv(root)
 
+    # _int_send/_int_recv return the wire payload size so the comm-volume
+    # counters ride the serialization the bus already pays for (no
+    # re-pickling just to count bytes).
+
     def _int_send(self, gdest, obj):
+        return self._int_send_bytes(gdest, pickle.dumps(obj))
+
+    def _int_send_bytes(self, gdest, payload):
         bus = self._get_bus("framework collective")
         seq = self._int_send_seq.get(gdest, 0)
-        bus.send_bytes(gdest, pickle.dumps(obj), 2 * seq)
+        bus.send_bytes(gdest, payload, 2 * seq)
         self._int_send_seq[gdest] = seq + 1
+        return len(payload)
 
     def _int_recv(self, gsrc, timeout_ms=-1):
         bus = self._get_bus("framework collective")
         seq = self._int_recv_seq.get(gsrc, 0)
         payload = bus.recv_bytes(gsrc, 2 * seq, timeout_ms)
         self._int_recv_seq[gsrc] = seq + 1
-        return pickle.loads(payload)
+        return pickle.loads(payload), len(payload)
 
     def barrier(self, name="smp_ccl_barrier", group=CommGroup.WORLD):
         """Barrier over the processes of `group`. WORLD barriers are a
@@ -328,10 +377,12 @@ class CollectiveCommunicator:
         subgroup barriers raise when the bus is down rather than silently
         widening."""
         procs = self.group_processes(group)
+        record_comm("barrier", group, 0, len(procs))
         if len(procs) <= 1:
             return
         if len(procs) < jax.process_count():
-            self._get_bus(f"smp.barrier({group})").barrier(procs)
+            with watchdog.guard(f"barrier/{getattr(group, 'name', group)}"):
+                self._get_bus(f"smp.barrier({group})").barrier(procs)
             return
         state.core.barrier(name)
 
@@ -350,16 +401,20 @@ class CollectiveCommunicator:
         # TransactionIdentifier parity: 2*seq + is_user_api(=1). The counter
         # advances only after a successful enqueue so a failed send can be
         # retried without desynchronizing the per-peer stream.
-        bus.send_bytes(gdest, pickle.dumps(obj), 2 * seq + 1)
+        payload = pickle.dumps(obj)
+        bus.send_bytes(gdest, payload, 2 * seq + 1)
         self._send_seq[gdest] = seq + 1
+        record_comm("send", group, len(payload), 2)
 
     def recv_from(self, src, group=CommGroup.WORLD, timeout_ms=-1):
         """Receive the next in-order object sent by process `src` of `group`."""
         gsrc = self._resolve_peer(src, group, "recv_from src")
         bus = self._get_bus("smp.recv_from")
         seq = self._recv_seq.get(gsrc, 0)
+        telemetry.set_phase(f"recv_from/{gsrc}")
         payload = bus.recv_bytes(gsrc, 2 * seq + 1, timeout_ms)
         self._recv_seq[gsrc] = seq + 1
+        record_comm("recv_from", group, len(payload), 2)
         return pickle.loads(payload)
 
     def poll(self, src, group=CommGroup.WORLD):

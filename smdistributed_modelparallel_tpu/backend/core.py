@@ -29,6 +29,7 @@ from smdistributed_modelparallel_tpu.utils.exceptions import (
     SMPValidationError,
 )
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import telemetry, watchdog
 
 logger = get_logger()
 
@@ -47,8 +48,20 @@ class ModelParallelCore:
         if self._initialized:
             logger.warning("smp core already initialized; re-initializing topology.")
         self.cfg = cfg
+        telemetry.set_phase("init/distributed")
         self._maybe_init_distributed()
-        self.topology = DeviceTopology(cfg, devices=devices)
+        # The first device enumeration is the probe that wedges when the
+        # accelerator transport is down (BENCH_r05): guard it so an armed
+        # watchdog dumps instead of hanging smp.init silently.
+        telemetry.set_phase("init/topology")
+        with watchdog.guard("init/topology"):
+            # Rank identity first (inside the guard: process_index() itself
+            # touches the backend and can wedge), so a topology stall dumps
+            # rank-suffixed files instead of N ranks clobbering one path.
+            telemetry.process_index = jax.process_index()
+            telemetry.process_count = jax.process_count()
+            self.topology = DeviceTopology(cfg, devices=devices)
+        telemetry.set_phase("initialized")
         self._initialized = True
         self.attach_exit_hook()
         atexit.register(self.shutdown)
@@ -97,6 +110,8 @@ class ModelParallelCore:
         self._relay_exit_status(success)
         if self._timeline is not None:
             self._timeline.flush()
+        telemetry.set_phase("shutdown")
+        telemetry.dump()  # no-op unless SMP_TELEMETRY_PATH is set
 
     def _relay_exit_status(self, success):
         """Tell process 0 how this process ended; process 0 polls for peer
@@ -163,7 +178,12 @@ class ModelParallelCore:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices(name)
+            # A global device sync is not interruptible from Python; the
+            # guard's timer thread dumps diagnostics if it stalls, and the
+            # sync itself keeps waiting (see utils/telemetry.py).
+            telemetry.set_phase(f"barrier/{name}")
+            with watchdog.guard(f"barrier/{name}"):
+                multihost_utils.sync_global_devices(name)
 
     # -- device-level rank queries (reference API parity) ---------------
 

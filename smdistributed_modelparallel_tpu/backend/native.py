@@ -20,8 +20,11 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 
+from smdistributed_modelparallel_tpu.utils.exceptions import SMPWatchdogTimeout
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import watchdog
 
 logger = get_logger()
 
@@ -190,8 +193,30 @@ class MessageBus:
     def poll(self, src, tx):
         return bool(self._lib.smp_poll_recv(src, tx))
 
+    def _wait_recv(self, src, tx, timeout_ms):
+        """Blocking C wait, sliced under an armed watchdog: an unbounded
+        wait on a dead peer becomes a diagnostics dump + raise instead of
+        a silent wedge. Bounded waits keep their caller's timeout."""
+        wd = watchdog.timeout()
+        if timeout_ms >= 0 or wd is None:
+            return self._lib.smp_wait_recv(src, tx, timeout_ms)
+        deadline = time.monotonic() + wd
+        while True:
+            left_ms = int((deadline - time.monotonic()) * 1000)
+            if left_ms <= 0:
+                watchdog.dump(
+                    f"bus recv from process {src} (tx={tx}) stalled >{wd}s"
+                )
+                raise SMPWatchdogTimeout(
+                    f"watchdog: bus recv from process {src} stalled for "
+                    f"more than {wd}s (diagnostics dumped)."
+                )
+            n = self._lib.smp_wait_recv(src, tx, min(left_ms, 1000))
+            if n != -1:  # -1 = slice timed out; keep waiting
+                return n
+
     def recv_bytes(self, src, tx, timeout_ms=-1):
-        n = self._lib.smp_wait_recv(src, tx, timeout_ms)
+        n = self._wait_recv(src, tx, timeout_ms)
         if n == -1:
             raise TimeoutError(f"recv from {src} (tx={tx}) timed out")
         if n < 0:
@@ -206,8 +231,27 @@ class MessageBus:
         self._lib.smp_clean_recv_resources(src, tx)
 
     def barrier(self, ranks, timeout_ms=600000):
+        # An armed watchdog tightens the C-side timeout so a wedged peer
+        # produces the dump within the configured window, not after 10 min.
+        wd = watchdog.timeout()
+        if wd is not None:
+            timeout_ms = min(timeout_ms, max(int(wd * 1000), 1))
         arr = (ctypes.c_int * len(ranks))(*sorted(ranks))
+        t0 = time.monotonic()
         if self._lib.smp_bus_barrier(arr, len(ranks), timeout_ms) != 0:
+            # The C side returns -1 for timeouts AND for immediate failures
+            # (bus already shut down, dead peer): only a wait that actually
+            # consumed the window is a stall — instant failures keep the
+            # plain OSError their callers handle.
+            elapsed_ms = (time.monotonic() - t0) * 1000
+            if wd is not None and elapsed_ms >= 0.9 * timeout_ms:
+                watchdog.dump(
+                    f"bus barrier over {sorted(ranks)} stalled >{timeout_ms}ms"
+                )
+                raise SMPWatchdogTimeout(
+                    f"watchdog: bus barrier over {sorted(ranks)} stalled "
+                    f"(diagnostics dumped)."
+                )
             raise OSError(f"bus barrier over {sorted(ranks)} failed")
 
     def shutdown(self):

@@ -114,6 +114,9 @@ class ModelParallelState:
 
     def reset(self):
         """Testing hook: drop model/optimizer registrations and counters."""
+        from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+
+        telemetry.reset()
         self.model = None
         self.optimizer = None
         self.module_manager = None

@@ -351,6 +351,11 @@ class DistributedModel:
         mesh = state.mesh
         self._param_shardings = self.module_manager.param_shardings(mesh, self._params)
         self._params = jax.device_put(self._params, self._param_shardings)
+        # The identity-keyed regather_for_decode cache can never serve the
+        # replaced tree, but the superseded full-size gathered copy would
+        # stay pinned in HBM until the next params-setter call — drop it
+        # with the tree it was built from (ADVICE round 5).
+        self._decode_params_cache = None
 
     def post_partition(self, partition_result):
         """Install a pipeline-partition result (M2)."""

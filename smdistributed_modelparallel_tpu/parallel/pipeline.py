@@ -527,6 +527,18 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     )
 
     n_ticks = num_mb + S - 1
+    # Schedule occupancy -> measured bubble fraction. Fill-drain busy slots
+    # are exactly num_mb per stage over num_mb + S - 1 ticks, so the
+    # measured fraction coincides with the theoretical (pp-1)/(mb+pp-1);
+    # recording both keeps the report honest when the executor changes.
+    from smdistributed_modelparallel_tpu.utils.telemetry import (
+        record_pipeline_occupancy,
+    )
+
+    record_pipeline_occupancy(
+        "fill_drain", S, num_mb, busy_slots=num_mb * S,
+        total_slots=n_ticks * S,
+    )
     # Only the hidden flows stage-to-stage over the pp permute; tuple-carry
     # side values (cross_states, attention_mask) are static per-microbatch
     # inputs, gathered per stage per tick instead of rolled through ICI.

@@ -95,6 +95,20 @@ def build_1f1b_schedule(num_stages, num_microbatches, window):
     return np.asarray(fwd_rows, np.int32), np.asarray(bwd_rows, np.int32)
 
 
+def schedule_occupancy(fwd, bwd):
+    """(busy_slots, total_slots) of a static 1F1B schedule.
+
+    Each tick has a forward and a backward sub-step per stage; a sub-slot
+    is busy when its schedule entry is a microbatch index (>= 0). The
+    compiled program executes exactly this schedule, so this IS the
+    measured occupancy (every microbatch appears exactly once per stage
+    per direction: busy == 2*S*M).
+    """
+    busy = int((fwd >= 0).sum()) + int((bwd >= 0).sum())
+    total = 2 * int(fwd.shape[0]) * int(fwd.shape[1])
+    return busy, total
+
+
 def _tree_zeros(avals_or_tree, like=None):
     src = avals_or_tree if like is None else like
     return jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), src)
@@ -141,6 +155,12 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
 
     fwd_np, bwd_np = build_1f1b_schedule(S, M, W)
     n_ticks = fwd_np.shape[0]
+    from smdistributed_modelparallel_tpu.utils.telemetry import (
+        record_pipeline_occupancy,
+    )
+
+    busy, total = schedule_occupancy(fwd_np, bwd_np)
+    record_pipeline_occupancy("1f1b", S, M, busy_slots=busy, total_slots=total)
     fwd_sched = jnp.asarray(fwd_np)
     bwd_sched = jnp.asarray(bwd_np)
 

@@ -25,6 +25,7 @@ first microbatch, the partitioner runs, then the step compiles.
 """
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +44,7 @@ from smdistributed_modelparallel_tpu.model import DistributedModel
 from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
 from smdistributed_modelparallel_tpu.utils.exceptions import StepUsageError
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
 from smdistributed_modelparallel_tpu.nn.utils import half_cast as half_cast_util
 
 logger = get_logger()
@@ -104,6 +106,8 @@ class StepFunction:
                 maybe_auto_partition(model)
 
         tl = state.timeline
+        telemetry.set_phase(f"step_{state.step_count}")
+        t_step = time.perf_counter()
         if tl is not None and tl.enabled:
             tl.start_step(state.step_count)
             with tl.span(f"step_{state.step_count}"):
@@ -117,8 +121,20 @@ class StepFunction:
             grads, outputs = self._run_compiled(
                 model, stacked_args, stacked_kwargs
             )
+        # Dispatch wall time: exact when the timeline forced a block above,
+        # otherwise a lower bound (async dispatch returns before the device
+        # finishes) — still enough for compile-vs-steady-state attribution.
+        telemetry.histogram(
+            "smp_step_dispatch_seconds", "host wall time per step dispatch"
+        ).observe(time.perf_counter() - t_step)
+        telemetry.counter("smp_step_total", "step invocations").inc()
         if state.memory_metrics is not None:
             state.memory_metrics.record_step(state.step_count)
+        from smdistributed_modelparallel_tpu.utils.metrics import (
+            record_device_memory_telemetry,
+        )
+
+        record_device_memory_telemetry()
         state.step_count += 1
         return StepOutput(outputs)
 
@@ -245,18 +261,36 @@ class StepFunction:
                fused, opt._serial if fused else None,
                model.training if model is not None else None)
         compiled = self._cache.get(key)
+        cache_events = telemetry.counter(
+            "smp_step_compile_cache_total",
+            "compiled-step cache lookups by outcome",
+        )
         if compiled is None:
+            cache_events.labels(event="miss").inc()
             # Prior-generation entries are unreachable (their key[0] can
             # never match again) — evict them so re-init cycles don't
             # accumulate dead compiled executables.
             stale = [k for k in self._cache if k[0] != state.generation]
             for k in stale:
                 del self._cache[k]
+            telemetry.set_phase(f"step_{state.step_count}/trace")
+            t_build = time.perf_counter()
             compiled = self._build(
                 model, treedef, scan_idx, bcast_idx, static, num_mb,
                 scan_meta, opt.build_update_fn() if fused else None,
             )
+            telemetry.histogram(
+                "smp_step_trace_seconds", "step program build/trace wall time"
+            ).observe(time.perf_counter() - t_build)
             self._cache[key] = compiled
+        else:
+            cache_events.labels(event="hit").inc()
+        tokens = _count_tokens(scan_vals, scan_meta)
+        if tokens:
+            telemetry.counter(
+                "smp_step_tokens_total",
+                "input tokens consumed by step invocations",
+            ).inc(tokens)
 
         # Device placement: params already sharded; shard batch over data axes
         # (replicate arrays whose dims don't divide the mesh axes, e.g. tiny
@@ -669,6 +703,8 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
         with jax.set_mesh(mesh):
             if "compiled" not in holder:
                 compiled = None
+                telemetry.set_phase(f"compile/{name}")
+                t_compile = time.perf_counter()
                 try:
                     lowered = jitted.lower(
                         params, opt_state, scan_vals, bcast_vals, rng, loss_scale
@@ -679,6 +715,10 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                     )
                 except Exception as e:  # pragma: no cover - backend-specific
                     logger.debug("AOT compile report unavailable: %s", e)
+                telemetry.histogram(
+                    "smp_step_compile_seconds", "XLA compile wall time"
+                ).observe(time.perf_counter() - t_compile)
+                telemetry.set_phase(f"run/{name}")
                 holder["compiled"] = compiled
             c = holder["compiled"]
             if c is not None:
@@ -701,6 +741,24 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
     run.holder = holder
     run.raw_divisor = raw_divisor if fused_update is not None else None
     return run
+
+
+def _count_tokens(scan_vals, scan_meta):
+    """Token count of one step's batch for the telemetry throughput
+    counter: leading batch dims x sequence dim of the FIRST batch-like scan
+    input ([B, T, ...] raw; [num_mb, mb, T, ...] pre-stacked). A proxy, not
+    an exact semantic count — inputs without a sequence dim count their
+    batch elements."""
+    for v, (axis, num_mb, stacked) in zip(scan_vals, scan_meta):
+        shape = getattr(v, "shape", None)
+        if not shape or len(shape) < 2:
+            continue
+        lead = min(3 if stacked else 2, len(shape))
+        tokens = 1
+        for d in shape[:lead]:
+            tokens *= int(d)
+        return tokens
+    return 0
 
 
 def _place(v, sharding):

@@ -63,6 +63,11 @@ class CheckpointError(SMPValidationError):
     """Checkpoint save/load failure or incompatible smp config on resume."""
 
 
+class SMPWatchdogTimeout(SMPRuntimeError):
+    """A watchdog-guarded wait (collective, device probe) stalled past
+    SMP_WATCHDOG_TIMEOUT; diagnostics were dumped (utils/telemetry.py)."""
+
+
 class DelayedParamError(SMPRuntimeError):
     """Materialization of delayed-initialized parameters failed."""
 

@@ -19,10 +19,34 @@ import os
 import jax
 
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
 
 logger = get_logger()
 
 MEMORY_METRICS_ENV = "SMP_WRITE_STEP_MEMORY_METRICS"
+
+
+def record_device_memory_telemetry():
+    """Per-device allocator gauges for the telemetry report (peak HBM is
+    what the step report CLI surfaces). Backends without allocator stats
+    (XLA:CPU) simply record nothing. Runs unconditionally on the per-step
+    dispatch path: ``smp.telemetry.report()`` / ``render_prometheus()`` are
+    live surfaces that must contain memory gauges without any env var, and
+    the cost is one local memory_stats() round-trip per device per step."""
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats() or {}
+        except Exception:
+            continue
+        for key, metric in (
+            ("peak_bytes_in_use", "smp_device_peak_hbm_bytes"),
+            ("bytes_in_use", "smp_device_hbm_bytes_in_use"),
+            ("bytes_limit", "smp_device_hbm_bytes_limit"),
+        ):
+            if ms.get(key) is not None:
+                telemetry.gauge(
+                    metric, "device allocator stats (memory_stats)"
+                ).labels(device=str(d)).set(int(ms[key]))
 
 
 class StepMemoryMetricsCollector:
@@ -89,4 +113,17 @@ def one_time_compile_report(step_name, lowered_or_compiled):
         step_name, report.get("flops"), report.get("bytes_accessed"),
         report.get("temp_size_in_bytes"),
     )
+    # XLA's own accounting of the compiled step — the compiler-counted
+    # analogue of the reference's hand-counted comm volume upload.
+    for key, metric in (
+        ("flops", "smp_compiled_step_flops"),
+        ("bytes_accessed", "smp_compiled_step_bytes_accessed"),
+        ("temp_size_in_bytes", "smp_compiled_step_temp_bytes"),
+        ("argument_size_in_bytes", "smp_compiled_step_argument_bytes"),
+        ("output_size_in_bytes", "smp_compiled_step_output_bytes"),
+    ):
+        if report.get(key) is not None:
+            telemetry.gauge(
+                metric, "XLA cost/memory analysis of the compiled step"
+            ).labels(step=step_name).set(float(report[key]))
     return report

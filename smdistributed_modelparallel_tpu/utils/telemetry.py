@@ -1,0 +1,548 @@
+"""Process-wide telemetry registry + hang watchdog.
+
+The reference uploads a one-time comm-volume / hop-count profile per run
+(``torch/step.py:295-312``, ``backend/utils.py:134-149``) and counts the
+bytes of every NCCL collective by hand. This module is the TPU build's
+generalization: a thread-safe metrics registry (counters, gauges,
+histograms, all with optional labels) that every layer of the stack feeds —
+
+- ``backend/collectives.py``: per-collective op counts / payload bytes /
+  group sizes (the hand-counted comm volume, now live);
+- ``parallel/pipeline.py`` / ``pipeline_1f1b.py``: schedule slot occupancy
+  -> measured pipeline bubble fraction vs the theoretical
+  ``(pp-1)/(mb+pp-1)``;
+- ``step.py`` / ``utils/metrics.py``: compile-cache hits/misses, compile
+  wall time, XLA ``cost_analysis`` FLOPs/bytes, per-step peak HBM.
+
+Exports: ``smp.telemetry.report()`` (plain dict), ``render_prometheus()``
+(text exposition format), and a JSON dump — written on demand, at
+``smp.shutdown``, and from an ``atexit`` hook — to ``SMP_TELEMETRY_PATH``.
+``scripts/telemetry_report.py`` pretty-prints the dump.
+
+The **watchdog** (``SMP_WATCHDOG_TIMEOUT`` seconds; unset/0 = off) turns
+silent wedges (a stalled collective, a hung device probe — see BENCH_r05's
+eight silent 150 s probe hangs) into actionable dumps: when a guarded
+operation overruns the timeout, the full registry state, the per-rank
+last-known phase, and every thread's stack are written to stderr and to
+``SMP_WATCHDOG_PATH`` (default ``smp_watchdog_dump.json``). Pollable waits
+(the native bus) additionally *raise* ``SMPWatchdogTimeout`` instead of
+blocking forever; non-interruptible waits (XLA global syncs) dump from a
+timer thread and keep waiting — the dump is the diagnostic.
+
+Import-hygiene contract: this module must import nothing that initializes
+an accelerator backend (stdlib + the package logger/exceptions only).
+"""
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+from smdistributed_modelparallel_tpu.utils.exceptions import SMPWatchdogTimeout
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+
+logger = get_logger()
+
+TELEMETRY_PATH_ENV = "SMP_TELEMETRY_PATH"
+WATCHDOG_TIMEOUT_ENV = "SMP_WATCHDOG_TIMEOUT"
+WATCHDOG_PATH_ENV = "SMP_WATCHDOG_PATH"
+
+# Powers-of-4 seconds-scale buckets: host control-plane operations span
+# ~1ms (local bus delivery) to minutes (XLA pipeline compiles).
+DEFAULT_BUCKETS = (
+    0.001, 0.004, 0.016, 0.064, 0.25, 1.0, 4.0, 16.0, 64.0, 256.0,
+)
+
+
+def _label_key(labels):
+    return tuple(sorted(labels.items()))
+
+
+def _atomic_json_dump(payload, path, what):
+    """Temp-file + rename so a reader (or a concurrent writer) never sees a
+    torn JSON. Returns the path written, or None on failure."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)
+        return path
+    except OSError as e:
+        logger.warning("%s to %s failed: %s", what, path, e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+class _Child:
+    """One (metric, label-set) time series. Thread-safe."""
+
+    def __init__(self, kind, labels, buckets=None):
+        self._kind = kind
+        self._labels = dict(labels)
+        self._lock = threading.Lock()
+        self._value = 0.0
+        if kind == "histogram":
+            self._buckets = tuple(buckets or DEFAULT_BUCKETS)
+            self._counts = [0] * (len(self._buckets) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+    # -- counter / gauge --
+
+    def inc(self, value=1):
+        if self._kind == "counter" and value < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += value
+
+    def dec(self, value=1):
+        if self._kind != "gauge":
+            raise ValueError("dec() is gauge-only")
+        with self._lock:
+            self._value -= value
+
+    def set(self, value):
+        if self._kind != "gauge":
+            raise ValueError("set() is gauge-only")
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    # -- histogram --
+
+    def observe(self, value):
+        if self._kind != "histogram":
+            raise ValueError("observe() is histogram-only")
+        v = float(value)
+        with self._lock:
+            i = 0
+            while i < len(self._buckets) and v > self._buckets[i]:
+                i += 1
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def _snapshot(self):
+        with self._lock:
+            if self._kind == "histogram":
+                return {
+                    "labels": self._labels,
+                    "buckets": list(self._buckets),
+                    "counts": list(self._counts),
+                    "sum": self._sum,
+                    "count": self._count,
+                }
+            return {"labels": self._labels, "value": self._value}
+
+
+class _Family:
+    """A named metric; ``labels(**kw)`` returns the per-label-set child.
+
+    Label-less metrics proxy inc/dec/set/observe/value straight to their
+    single default child, so ``registry.counter("x").inc()`` works.
+    """
+
+    def __init__(self, name, kind, help="", buckets=None):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self._buckets = buckets
+        self._lock = threading.Lock()
+        self._children = {}
+
+    def labels(self, **kw):
+        key = _label_key(kw)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = _Child(self.kind, kw, self._buckets)
+                self._children[key] = child
+            return child
+
+    def _default(self):
+        return self.labels()
+
+    def inc(self, value=1):
+        self._default().inc(value)
+
+    def dec(self, value=1):
+        self._default().dec(value)
+
+    def set(self, value):
+        self._default().set(value)
+
+    def observe(self, value):
+        self._default().observe(value)
+
+    @property
+    def value(self):
+        return self._default().value
+
+    def _snapshot(self):
+        with self._lock:
+            children = list(self._children.values())
+        return {
+            "kind": self.kind,
+            "help": self.help,
+            "series": [c._snapshot() for c in children],
+        }
+
+
+class TelemetryRegistry:
+    """Process-wide metric registry. All methods are thread-safe;
+    registration is idempotent (same name -> same family) but re-registering
+    a name under a different kind is a bug and raises."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families = {}
+        self._phase = "startup"
+        self._phase_ts = time.time()
+        self._phase_history = []
+        self._created = time.time()
+        # Set by backend/core.py at smp.init (asking jax at dump time could
+        # itself initialize — or hang on — a wedged backend at exit).
+        self.process_index = None
+        self.process_count = 1
+
+    # -- registration ---------------------------------------------------
+
+    def _family(self, name, kind, help, buckets=None):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, kind, help, buckets)
+                self._families[name] = fam
+            elif fam.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind}, "
+                    f"requested {kind}"
+                )
+            return fam
+
+    def counter(self, name, help=""):
+        return self._family(name, "counter", help)
+
+    def gauge(self, name, help=""):
+        return self._family(name, "gauge", help)
+
+    def histogram(self, name, help="", buckets=None):
+        return self._family(name, "histogram", help, buckets)
+
+    # -- phase tracking (consumed by the watchdog dump) -----------------
+
+    def set_phase(self, phase):
+        """Record the process's last-known phase (e.g. "step_3/compile").
+        Bounded history so a wedged run's dump shows how it got there."""
+        with self._lock:
+            self._phase = phase
+            self._phase_ts = time.time()
+            self._phase_history.append((phase, self._phase_ts))
+            if len(self._phase_history) > 64:
+                del self._phase_history[:-64]
+
+    @property
+    def phase(self):
+        with self._lock:
+            return self._phase
+
+    # -- export ---------------------------------------------------------
+
+    def report(self):
+        """Plain-dict snapshot of every metric plus phase metadata."""
+        with self._lock:
+            families = dict(self._families)
+            meta = {
+                "pid": os.getpid(),
+                "created": self._created,
+                "exported": time.time(),
+                "phase": self._phase,
+                "phase_age_seconds": time.time() - self._phase_ts,
+                "phase_history": [
+                    {"phase": p, "time": t} for p, t in self._phase_history
+                ],
+            }
+        return {
+            "meta": meta,
+            "metrics": {n: f._snapshot() for n, f in families.items()},
+        }
+
+    def render_prometheus(self):
+        """Prometheus text exposition format (for scraping or eyeballing)."""
+        out = []
+        rep = self.report()
+        for name, fam in sorted(rep["metrics"].items()):
+            if fam["help"]:
+                out.append(f"# HELP {name} {fam['help']}")
+            out.append(f"# TYPE {name} {fam['kind']}")
+            for series in fam["series"]:
+                lab = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(series["labels"].items())
+                )
+                if fam["kind"] == "histogram":
+                    acc = 0
+                    for b, c in zip(
+                        list(series["buckets"]) + ["+Inf"], series["counts"]
+                    ):
+                        acc += c
+                        ble = (lab + "," if lab else "") + f'le="{b}"'
+                        out.append(f"{name}_bucket{{{ble}}} {acc}")
+                    sfx = f"{{{lab}}}" if lab else ""
+                    out.append(f"{name}_sum{sfx} {series['sum']}")
+                    out.append(f"{name}_count{sfx} {series['count']}")
+                else:
+                    sfx = f"{{{lab}}}" if lab else ""
+                    out.append(f"{name}{sfx} {series['value']}")
+        return "\n".join(out) + "\n"
+
+    def _rank_path(self, path):
+        """Multi-process runs write per-rank files: N processes dumping the
+        one SMP_TELEMETRY_PATH (shared filesystem) would clobber each other."""
+        if self.process_count > 1 and self.process_index is not None:
+            return f"{path}.rank{self.process_index}"
+        return path
+
+    def dump(self, path=None):
+        """Write the JSON report (atomically; rank-suffixed under
+        multi-process). Explicit ``path`` wins; otherwise
+        ``SMP_TELEMETRY_PATH`` (no-op when neither is set). Returns the
+        path written, or None."""
+        path = path or os.environ.get(TELEMETRY_PATH_ENV)
+        if not path:
+            return None
+        path = self._rank_path(path)
+        return _atomic_json_dump(self.report(), path, "telemetry dump")
+
+    def reset(self):
+        """Testing hook: drop every metric and the phase history."""
+        with self._lock:
+            self._families.clear()
+            self._phase = "startup"
+            self._phase_ts = time.time()
+            self._phase_history.clear()
+
+
+class Watchdog:
+    """Stall detector for blocking control-plane operations.
+
+    The timeout is read from ``SMP_WATCHDOG_TIMEOUT`` at *call* time (not
+    import time), so tests and long-running jobs can arm/disarm it without
+    reimporting. Two usage shapes:
+
+    - ``with watchdog.guard("barrier/step"):`` — a timer thread dumps the
+      diagnostics if the block outlives the timeout (the block itself keeps
+      waiting: XLA syncs are not interruptible from Python);
+    - ``watchdog.wait(poll_fn, "recv/peer3")`` — polls until ``poll_fn()``
+      is truthy; on timeout dumps AND raises ``SMPWatchdogTimeout``.
+
+    The native bus integrates directly (``backend/native.py``): unbounded C
+    waits are sliced against the watchdog deadline so they stay bounded.
+    """
+
+    def __init__(self, registry):
+        self._registry = registry
+        self._dump_lock = threading.Lock()
+
+    # -- configuration --------------------------------------------------
+
+    def timeout(self):
+        """Configured timeout in seconds, or None when disabled."""
+        raw = os.environ.get(WATCHDOG_TIMEOUT_ENV, "")
+        if not raw:
+            return None
+        try:
+            t = float(raw)
+        except ValueError:
+            logger.warning(
+                "invalid %s=%r (want seconds); watchdog disabled.",
+                WATCHDOG_TIMEOUT_ENV, raw,
+            )
+            return None
+        return t if t > 0 else None
+
+    @property
+    def enabled(self):
+        return self.timeout() is not None
+
+    # -- diagnostics ----------------------------------------------------
+
+    def dump(self, reason, phase=None):
+        """Snapshot registry + phase + all thread stacks to stderr and the
+        SMP_WATCHDOG_PATH JSON file. Never raises (a broken dump must not
+        mask the stall it is reporting). Returns the dump dict."""
+        with self._dump_lock:
+            try:
+                stacks = {}
+                frames = sys._current_frames()
+                names = {t.ident: t.name for t in threading.enumerate()}
+                for tid, frame in frames.items():
+                    stacks[f"{names.get(tid, '?')}:{tid}"] = (
+                        traceback.format_stack(frame)
+                    )
+                payload = {
+                    "reason": reason,
+                    "phase": phase or self._registry.phase,
+                    "time": time.time(),
+                    "pid": os.getpid(),
+                    "threads": stacks,
+                    "telemetry": self._registry.report(),
+                }
+                path = self._registry._rank_path(
+                    os.environ.get(WATCHDOG_PATH_ENV, "smp_watchdog_dump.json")
+                )
+                path = _atomic_json_dump(payload, path, "watchdog dump")
+                sys.stderr.write(
+                    "\n=== SMP WATCHDOG: %s (phase=%s) ===\n"
+                    "full dump: %s\n" % (reason, payload["phase"], path)
+                )
+                for tname, stack in stacks.items():
+                    sys.stderr.write(f"--- thread {tname} ---\n")
+                    sys.stderr.write("".join(stack[-6:]))
+                sys.stderr.flush()
+                return payload
+            except Exception:  # pragma: no cover - diagnostics must not throw
+                return None
+
+    # -- guards ---------------------------------------------------------
+
+    class _Guard:
+        def __init__(self, watchdog, phase, timeout):
+            self._watchdog = watchdog
+            self._phase = phase
+            self._timeout = timeout
+            self._timer = None
+            self.fired = False
+
+        def __enter__(self):
+            if self._timeout is not None:
+                self._timer = threading.Timer(self._timeout, self._on_stall)
+                self._timer.daemon = True
+                self._timer.start()
+            return self
+
+        def _on_stall(self):
+            self.fired = True
+            self._watchdog.dump(
+                f"operation exceeded {self._timeout}s", phase=self._phase
+            )
+
+        def __exit__(self, *exc):
+            if self._timer is not None:
+                self._timer.cancel()
+            return False
+
+    def guard(self, phase):
+        """Context manager: dump diagnostics if the body outlives the
+        configured timeout. No-op (no timer thread) when disabled."""
+        return self._Guard(self, phase, self.timeout())
+
+    def wait(self, poll, phase, interval=0.05, timeout=None):
+        """Poll ``poll()`` until truthy. On watchdog timeout: dump + raise
+        ``SMPWatchdogTimeout``. With the watchdog disabled (and no explicit
+        ``timeout``), polls forever — matching the unguarded behavior."""
+        limit = timeout if timeout is not None else self.timeout()
+        deadline = None if limit is None else time.monotonic() + limit
+        while True:
+            result = poll()
+            if result:
+                return result
+            if deadline is not None and time.monotonic() >= deadline:
+                self.dump(f"wait exceeded {limit}s", phase=phase)
+                raise SMPWatchdogTimeout(
+                    f"watchdog: {phase} stalled for more than {limit}s "
+                    "(diagnostics dumped; see stderr / "
+                    f"{os.environ.get(WATCHDOG_PATH_ENV, 'smp_watchdog_dump.json')})."
+                )
+            time.sleep(interval)
+
+
+# ----------------------------------------------------------------------
+# Singletons + convenience recorders
+# ----------------------------------------------------------------------
+
+telemetry = TelemetryRegistry()
+watchdog = Watchdog(telemetry)
+
+
+def record_comm(op, group, nbytes, group_size):
+    """One host-collective record: op count, payload bytes, group size.
+
+    The TPU analogue of the reference's hand-counted comm volume
+    (``backend/utils.py:134-149``): device-side collective traffic is
+    compiled into the step program (accounted via XLA cost_analysis in
+    ``utils/metrics.py``); what remains observable per-op at runtime is the
+    host control plane, counted here.
+    """
+    g = getattr(group, "name", None) or str(group)
+    telemetry.counter(
+        "smp_comm_ops_total", "host collective operations"
+    ).labels(op=op, group=g).inc()
+    if nbytes:
+        telemetry.counter(
+            "smp_comm_bytes_total", "host collective payload bytes"
+        ).labels(op=op, group=g).inc(int(nbytes))
+    telemetry.gauge(
+        "smp_comm_group_size", "process count of the last collective per op/group"
+    ).labels(op=op, group=g).set(int(group_size))
+
+
+def record_pipeline_occupancy(schedule, num_stages, num_microbatches,
+                              busy_slots, total_slots):
+    """Record measured schedule occupancy -> bubble fraction gauges.
+
+    ``busy_slots``/``total_slots`` count (tick, stage[, sub-step]) slots of
+    the static schedule actually baked into the compiled program; the
+    theoretical fill-drain bound is ``(pp-1)/(mb+pp-1)``. Gauges (not
+    counters): executors trace more than once per compile and gauge sets
+    are idempotent.
+    """
+    measured = 1.0 - (busy_slots / total_slots) if total_slots else 0.0
+    theoretical = (
+        (num_stages - 1) / (num_microbatches + num_stages - 1)
+        if num_microbatches + num_stages > 1 else 0.0
+    )
+    lab = dict(schedule=schedule)
+    telemetry.gauge(
+        "smp_pipeline_bubble_fraction",
+        "measured idle fraction of pipeline schedule slots",
+    ).labels(**lab).set(measured)
+    telemetry.gauge(
+        "smp_pipeline_bubble_fraction_theoretical",
+        "fill-drain bound (pp-1)/(mb+pp-1)",
+    ).labels(**lab).set(theoretical)
+    telemetry.gauge(
+        "smp_pipeline_schedule_slots", "slots in the static schedule"
+    ).labels(state="busy", **lab).set(busy_slots)
+    telemetry.gauge(
+        "smp_pipeline_schedule_slots", "slots in the static schedule"
+    ).labels(state="total", **lab).set(total_slots)
+    telemetry.gauge(
+        "smp_pipeline_stages", "pipeline stage count"
+    ).labels(**lab).set(num_stages)
+    telemetry.gauge(
+        "smp_pipeline_microbatches", "microbatch count"
+    ).labels(**lab).set(num_microbatches)
+    return measured
+
+
+def _atexit_dump():  # pragma: no cover - exercised via subprocess test
+    try:
+        # An empty registry must not clobber the dump smp.shutdown already
+        # wrote (shutdown resets the registry after dumping).
+        if telemetry._families:
+            telemetry.dump()
+    except Exception:
+        pass
+
+
+atexit.register(_atexit_dump)

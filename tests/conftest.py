@@ -118,6 +118,27 @@ _SLOW_TESTS = (
     "test_generate.py::TestSeq2SeqGreedyParity",
     "test_generate.py::TestPaddedPrompts::test_hf_gpt2_left_padded_parity",
     "test_generate.py::TestDistributedParity::test_tp4_matches_single_device",
+    # Re-tiered after the jax.set_mesh compat shim revived the step/decode
+    # engines on this image: these end-to-end loops each measured >= ~15s
+    # single-core (--durations, same rule as the block above) and the fast
+    # tier must fit the driver's 870s budget.
+    "test_generate.py::TestBeamSearch::test_seq2seq_beam_runs_and_improves_score",
+    "test_generate.py::TestBeamSearch::test_seq2seq_num_return_sequences",
+    "test_generate.py::TestZooGreedyParity",
+    "test_generate.py::TestDistributedParity::test_generate_after_pp_training",
+    "test_generate.py::TestHalfPrecision::test_bf16_config_casts_decode_params",
+    "test_attention_dispatch.py::test_block_size_config_resolution",
+    "test_native.py::test_multiprocess_mesh[4]",
+    "test_encoder_decoder.py::test_cross_attention_masked_by_encoder_padding",
+    "test_encoder_decoder.py::test_forward_shapes_and_causality",
+    "test_encoder_decoder.py::test_padding_mask_2d_normalized",
+    "test_checkpoint.py::TestAsyncSave::test_async_snapshot_is_exact",
+    "test_checkpoint.py::TestSaveCheckpointDir::test_retention_gc",
+    "test_moe.py::TestAuxLossPlumbing::test_balance_improves_with_aux_under_dp",
+    "test_pipeline_1f1b.py::TestMemory::test_interleaved_uses_less_temp_memory_than_simple",
+    "test_optimizer.py::TestFusedOptimizerStep",
+    "test_step.py::test_step_recompiles_after_reinit_same_shapes",
+    "test_data.py::TestPrefetch::test_trains_through_step_engine",
 )
 
 

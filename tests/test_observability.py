@@ -1,11 +1,21 @@
-"""M5 observability tests: timeline + step memory metrics.
+"""M5 observability tests: timeline, step memory metrics, telemetry.
 
 Mirrors the reference's timeline/memory-metrics surfaces
-(``torch/step.py:69-115``, ``backend/core.py:524-562``).
+(``torch/step.py:69-115``, ``backend/core.py:524-562``) plus the unified
+telemetry subsystem (``utils/telemetry.py``): registry semantics under
+threads, collective byte accounting, pipeline bubble-fraction math, the
+hang watchdog, and the end-to-end JSON step report + CLI.
 """
 
 import json
 import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -13,6 +23,8 @@ import optax
 
 import smdistributed_modelparallel_tpu as smp
 from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils.exceptions import SMPWatchdogTimeout
+from smdistributed_modelparallel_tpu.utils import telemetry as tel
 
 
 def _tiny_train(tmp_path, env):
@@ -74,3 +86,315 @@ class TestMemoryMetrics:
         assert len(lines) >= 2
         assert lines[0]["step"] == 0
         assert "devices" in lines[0]
+
+
+# ----------------------------------------------------------------------
+# Telemetry registry
+# ----------------------------------------------------------------------
+
+
+def _ops(report, name):
+    return {
+        tuple(sorted(s["labels"].items())): s["value"]
+        for s in report["metrics"].get(name, {"series": []})["series"]
+    }
+
+
+class TestTelemetryRegistry:
+    def test_counter_semantics(self):
+        tel.telemetry.reset()
+        c = smp.telemetry.counter("t_ops_total", "help text")
+        c.inc()
+        c.inc(4)
+        c.labels(op="a").inc(2)
+        c.labels(op="a").inc()
+        c.labels(op="b").inc()
+        rep = smp.telemetry.report()
+        vals = _ops(rep, "t_ops_total")
+        assert vals[()] == 5
+        assert vals[(("op", "a"),)] == 3
+        assert vals[(("op", "b"),)] == 1
+        with pytest.raises(ValueError):
+            c.inc(-1)  # counters only go up
+
+    def test_gauge_and_kind_conflict(self):
+        tel.telemetry.reset()
+        g = smp.telemetry.gauge("t_gauge")
+        g.set(7.5)
+        g.dec(0.5)
+        assert g.value == 7.0
+        # Same family back on re-registration; kind mismatch is a bug.
+        assert smp.telemetry.gauge("t_gauge") is g
+        with pytest.raises(ValueError):
+            smp.telemetry.counter("t_gauge")
+
+    def test_histogram_semantics(self):
+        tel.telemetry.reset()
+        h = smp.telemetry.histogram("t_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        (series,) = smp.telemetry.report()["metrics"]["t_seconds"]["series"]
+        assert series["counts"] == [1, 2, 1, 1]  # per-bucket, not cumulative
+        assert series["count"] == 5
+        assert series["sum"] == pytest.approx(56.05)
+
+    def test_thread_safety_exact_totals(self):
+        tel.telemetry.reset()
+        c = smp.telemetry.counter("t_threads_total")
+        h = smp.telemetry.histogram("t_threads_seconds")
+        n_threads, n_iters = 8, 500
+
+        def work():
+            for _ in range(n_iters):
+                c.inc()
+                c.labels(op="x").inc(2)
+                h.observe(0.01)
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rep = smp.telemetry.report()
+        vals = _ops(rep, "t_threads_total")
+        assert vals[()] == n_threads * n_iters
+        assert vals[(("op", "x"),)] == 2 * n_threads * n_iters
+        (series,) = rep["metrics"]["t_threads_seconds"]["series"]
+        assert series["count"] == n_threads * n_iters
+
+    def test_prometheus_render(self):
+        tel.telemetry.reset()
+        smp.telemetry.counter("t_prom_total", "a counter").labels(op="a").inc(3)
+        smp.telemetry.histogram("t_prom_seconds").observe(0.002)
+        text = smp.telemetry.render_prometheus()
+        assert '# TYPE t_prom_total counter' in text
+        assert 't_prom_total{op="a"} 3.0' in text
+        assert 't_prom_seconds_count 1' in text
+        assert '+Inf' in text
+
+    def test_phase_history_and_dump(self, tmp_path, monkeypatch):
+        tel.telemetry.reset()
+        smp.telemetry.set_phase("alpha")
+        smp.telemetry.set_phase("beta")
+        rep = smp.telemetry.report()
+        assert rep["meta"]["phase"] == "beta"
+        assert [p["phase"] for p in rep["meta"]["phase_history"]] == [
+            "alpha", "beta",
+        ]
+        path = smp.telemetry.dump(str(tmp_path / "t.json"))
+        assert json.load(open(path))["meta"]["phase"] == "beta"
+        # No path and no SMP_TELEMETRY_PATH -> explicit no-op.
+        monkeypatch.delenv("SMP_TELEMETRY_PATH", raising=False)
+        assert smp.telemetry.dump() is None
+
+
+class TestCollectiveAccounting:
+    def test_cp2xpp2_byte_accounting(self):
+        smp.shutdown()
+        smp.init({
+            "context_parallel_degree": 2,
+            "pipeline_parallel_degree": 2,
+        })
+        tel.telemetry.reset()
+        obj = {"payload": list(range(128))}
+        assert smp.broadcast(obj, group=smp.CommGroup.CP_GROUP) == obj
+        assert smp.allgather(obj) == [obj]
+        smp.barrier()
+        rep = smp.telemetry.report()
+        ops = _ops(rep, "smp_comm_ops_total")
+        assert ops[(("group", "CP_GROUP"), ("op", "broadcast"))] == 1
+        assert ops[(("group", "WORLD"), ("op", "allgather"))] == 1
+        assert ops[(("group", "WORLD"), ("op", "barrier"))] == 1
+        nbytes = _ops(rep, "smp_comm_bytes_total")
+        # Byte counters carry the pickled payload size (nonzero even on the
+        # single-process short-circuit paths — the accounting is the point).
+        assert nbytes[(("group", "CP_GROUP"), ("op", "broadcast"))] > 100
+        assert nbytes[(("group", "WORLD"), ("op", "allgather"))] > 100
+
+
+# ----------------------------------------------------------------------
+# Pipeline bubble fraction
+# ----------------------------------------------------------------------
+
+
+class TestBubbleFraction:
+    def test_hand_computed_1f1b_schedule(self):
+        from smdistributed_modelparallel_tpu.parallel.pipeline_1f1b import (
+            schedule_occupancy,
+        )
+
+        # S=2, M=2 lockstep 1F1B by hand: 4 ticks, each with a fwd and a
+        # bwd sub-step per stage. 8 busy sub-slots of 16 -> bubble 1/2.
+        fwd = np.array([[0, -1], [1, 0], [-1, 1], [-1, -1]], np.int32)
+        bwd = np.array([[-1, -1], [-1, 0], [0, 1], [1, -1]], np.int32)
+        busy, total = schedule_occupancy(fwd, bwd)
+        assert (busy, total) == (8, 16)
+        tel.telemetry.reset()
+        measured = tel.record_pipeline_occupancy("1f1b", 2, 2, busy, total)
+        assert measured == pytest.approx(0.5)
+        rep = smp.telemetry.report()
+        assert _ops(rep, "smp_pipeline_bubble_fraction")[
+            (("schedule", "1f1b"),)
+        ] == pytest.approx(0.5)
+        # Theoretical fill-drain bound: (pp-1)/(mb+pp-1) = 1/3.
+        assert _ops(rep, "smp_pipeline_bubble_fraction_theoretical")[
+            (("schedule", "1f1b"),)
+        ] == pytest.approx(1 / 3)
+
+    def test_generated_schedule_occupancy_invariants(self):
+        from smdistributed_modelparallel_tpu.parallel.pipeline_1f1b import (
+            build_1f1b_schedule,
+            schedule_occupancy,
+        )
+
+        for S, M, W in ((2, 4, 3), (4, 8, 2), (3, 7, 4)):
+            fwd, bwd = build_1f1b_schedule(S, M, W)
+            busy, total = schedule_occupancy(fwd, bwd)
+            # Every microbatch exactly once per stage per direction.
+            assert busy == 2 * S * M
+            assert 0.0 <= 1.0 - busy / total <= 1.0
+
+    def test_fill_drain_measured_equals_theoretical(self):
+        tel.telemetry.reset()
+        S, M = 4, 8
+        measured = tel.record_pipeline_occupancy(
+            "fill_drain", S, M, busy_slots=M * S, total_slots=(M + S - 1) * S
+        )
+        assert measured == pytest.approx((S - 1) / (M + S - 1))
+
+
+# ----------------------------------------------------------------------
+# Hang watchdog
+# ----------------------------------------------------------------------
+
+
+class TestWatchdog:
+    def test_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv("SMP_WATCHDOG_TIMEOUT", raising=False)
+        assert not smp.watchdog.enabled
+        with smp.watchdog.guard("noop") as g:
+            pass
+        assert not g.fired
+
+    def test_stalled_fake_collective_dumps_and_raises(
+        self, tmp_path, monkeypatch
+    ):
+        dump_path = tmp_path / "watchdog.json"
+        monkeypatch.setenv("SMP_WATCHDOG_TIMEOUT", "1")
+        monkeypatch.setenv("SMP_WATCHDOG_PATH", str(dump_path))
+        tel.telemetry.reset()
+        smp.telemetry.counter("smp_comm_ops_total").labels(
+            op="fake_recv", group="WORLD"
+        ).inc()
+
+        def fake_blocked_collective():
+            # A peer that never answers: the pollable wait must convert the
+            # hang into a dump + raise within the watchdog window.
+            smp.telemetry.set_phase("fake_collective/recv_from/1")
+            return smp.watchdog.wait(
+                lambda: False, "fake_collective/recv_from/1", interval=0.01
+            )
+
+        t0 = time.monotonic()
+        with pytest.raises(SMPWatchdogTimeout):
+            fake_blocked_collective()
+        assert time.monotonic() - t0 < 30  # dumped, not hung
+        dump = json.load(open(dump_path))
+        assert dump["phase"] == "fake_collective/recv_from/1"
+        assert dump["threads"]  # all-thread stacks captured
+        # Full registry state rides along: the comm counter is in the dump.
+        assert _ops(dump["telemetry"], "smp_comm_ops_total")[
+            (("group", "WORLD"), ("op", "fake_recv"))
+        ] == 1
+
+    def test_guard_dumps_on_overrun_but_does_not_interrupt(
+        self, tmp_path, monkeypatch
+    ):
+        dump_path = tmp_path / "watchdog.json"
+        monkeypatch.setenv("SMP_WATCHDOG_TIMEOUT", "0.2")
+        monkeypatch.setenv("SMP_WATCHDOG_PATH", str(dump_path))
+        with smp.watchdog.guard("slow_sync") as g:
+            time.sleep(0.8)  # a non-interruptible block (e.g. XLA sync)
+        assert g.fired
+        assert json.load(open(dump_path))["phase"] == "slow_sync"
+
+    def test_guard_cancels_when_fast(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SMP_WATCHDOG_TIMEOUT", "5")
+        monkeypatch.setenv(
+            "SMP_WATCHDOG_PATH", str(tmp_path / "watchdog.json")
+        )
+        with smp.watchdog.guard("fast_sync") as g:
+            pass
+        time.sleep(0.05)
+        assert not g.fired
+        assert not os.path.exists(tmp_path / "watchdog.json")
+
+
+# ----------------------------------------------------------------------
+# End-to-end step report (the acceptance path): pp2 toy run -> JSON ->
+# scripts/telemetry_report.py
+# ----------------------------------------------------------------------
+
+
+class TestStepReportE2E:
+    def test_pp2_dump_and_cli(self, tmp_path, monkeypatch):
+        from smdistributed_modelparallel_tpu.models.transformer_lm import (
+            TransformerLM,
+        )
+        from tests.models import softmax_xent
+
+        path = tmp_path / "telemetry.json"
+        monkeypatch.setenv("SMP_TELEMETRY_PATH", str(path))
+        smp.shutdown()
+        smp.init({
+            "pipeline_parallel_degree": 2,
+            "microbatches": 2,
+            "pipeline": "simple",
+        })
+        module = TransformerLM(
+            vocab_size=16, max_len=8, d_model=8, n_layers=2, n_heads=2
+        )
+        model = smp.DistributedModel(module)
+        opt = smp.DistributedOptimizer(optax.sgd(0.1), model)
+        ids = jax.random.randint(jax.random.key(0), (4, 8), 0, 16)
+
+        @smp.step
+        def train(model, batch):
+            logits = model(batch)
+            loss = softmax_xent(logits[:, :-1], batch[:, 1:])
+            model.backward(loss)
+            return loss
+
+        train(model, ids)
+        opt.step()
+        train(model, ids)
+        smp.broadcast({"sync": True})
+        smp.shutdown()  # writes SMP_TELEMETRY_PATH
+
+        report = json.load(open(path))
+        m = report["metrics"]
+        # Nonzero collective byte counters.
+        assert sum(_ops(report, "smp_comm_bytes_total").values()) > 0
+        # Measured bubble fraction within [0, 1] (pp2 x mb2 -> 1/3 here).
+        (bubble,) = m["smp_pipeline_bubble_fraction"]["series"]
+        assert 0.0 <= bubble["value"] <= 1.0
+        assert bubble["value"] == pytest.approx(1 / 3)
+        # Compile-cache hit/miss counts: 2 step calls = 1 miss + 1 hit.
+        cache = _ops(report, "smp_step_compile_cache_total")
+        assert cache[(("event", "miss"),)] == 1
+        assert cache[(("event", "hit"),)] == 1
+        assert _ops(report, "smp_step_total")[()] == 2
+
+        # The CLI renders it without error (stdlib-only subprocess).
+        script = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "telemetry_report.py",
+        )
+        out = subprocess.run(
+            [sys.executable, script, str(path)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "SMP step report" in out.stdout
+        assert "bubble 33.3% measured" in out.stdout
+        assert "hits / 1 misses" in out.stdout
