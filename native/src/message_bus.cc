@@ -329,21 +329,27 @@ class MessageBus {
 
   void SendLoop(int dest) {
     auto& q = send_queues_[dest];
-    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(static_cast<uint16_t>(peers_[dest].second));
     ::inet_pton(AF_INET, peers_[dest].first.c_str(), &addr.sin_addr);
-    // Retry connect: peers come up in arbitrary order.
+    // Retry connect: peers come up in arbitrary order. The socket must be
+    // RECREATED per attempt — a fd whose connect() failed (ECONNREFUSED
+    // from a peer whose listener isn't up yet) is not reusable, and
+    // retrying on it fails forever: the link stays silently dead in this
+    // direction and the peer times out minutes later with no clue.
+    int fd = -1;
     for (int attempt = 0; attempt < 600; ++attempt) {
-      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0)
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd >= 0 &&
+          ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0)
         break;
-      if (shut_.load() || attempt == 599) {
-        ::close(fd);
-        return;
-      }
+      if (fd >= 0) ::close(fd);
+      fd = -1;
+      if (shut_.load() || attempt == 599) return;
       std::this_thread::sleep_for(std::chrono::milliseconds(50));
     }
+    if (fd < 0) return;
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     while (true) {

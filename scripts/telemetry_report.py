@@ -1,21 +1,32 @@
 #!/usr/bin/env python
-"""Pretty-print a step report from an SMP telemetry JSON dump.
+"""Pretty-print a step report from SMP telemetry JSON dump(s).
 
 Usage:
     SMP_TELEMETRY_PATH=/tmp/telemetry.json python train.py ...
     python scripts/telemetry_report.py /tmp/telemetry.json
     python scripts/telemetry_report.py /tmp/telemetry.json --prometheus
+    python scripts/telemetry_report.py /tmp/dumps/      # per-rank dir
 
 Renders the run the way the reference's one-time Studio metrics upload was
 read: throughput (tokens/sec), pipeline bubble fraction (measured vs the
 (pp-1)/(mb+pp-1) bound), host comm volume by collective, compile-cache
 behavior and compile wall time, XLA-counted FLOPs/bytes of the compiled
-step, and peak HBM per device. Stdlib only — runnable anywhere the JSON
-can be copied to, no jax required.
+step, and peak HBM per device.
+
+Given a DIRECTORY, every telemetry dump in it (the per-rank
+``path.rank<i>`` files N processes write for one ``SMP_TELEMETRY_PATH``)
+is loaded and the report is the cross-rank aggregate: counters and
+histograms summed, gauges maxed (peak-HBM keeps the worst device), plus a
+per-rank table with step counts, phases, and wall-clock skew measured at
+the last shared barrier sync mark. Stdlib only — runnable anywhere the
+JSON can be copied to, no jax required.
 """
 
 import argparse
+import copy
 import json
+import os
+import re
 import sys
 
 
@@ -65,8 +76,11 @@ def render(report, out=sys.stdout):
     w = out.write
     meta = report.get("meta", {})
     w("=== SMP step report ===\n")
-    w(f"pid {meta.get('pid')}  phase {meta.get('phase')!r} "
-      f"(age {meta.get('phase_age_seconds', 0):.1f}s)\n")
+    if "ranks" in meta:
+        w(f"aggregated over ranks {meta['ranks']}\n")
+    else:
+        w(f"pid {meta.get('pid')}  phase {meta.get('phase')!r} "
+          f"(age {meta.get('phase_age_seconds', 0):.1f}s)\n")
     history = meta.get("phase_history", [])[-5:]
     if history:
         w("recent phases: " + " -> ".join(p["phase"] for p in history) + "\n")
@@ -146,17 +160,177 @@ def render(report, out=sys.stdout):
     return 0
 
 
+# ----------------------------------------------------------------------
+# Cross-rank aggregation (directory of per-rank dumps)
+# ----------------------------------------------------------------------
+
+_RANK_RE = re.compile(r"\.rank(\d+)$")
+
+
+def load_rank_dumps(dirpath):
+    """{rank: report} for every telemetry dump in the directory. Rank
+    comes from the dump's own meta, falling back to the ``.rank<i>``
+    filename suffix, then to load order."""
+    reports = {}
+    unranked = []
+    for name in sorted(os.listdir(dirpath)):
+        path = os.path.join(dirpath, name)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(payload, dict) or "metrics" not in payload:
+            continue
+        rank = payload.get("meta", {}).get("rank")
+        if rank is None:
+            m = _RANK_RE.search(name)
+            rank = int(m.group(1)) if m else None
+        if rank is None or rank in reports:
+            unranked.append((name, payload))
+        else:
+            reports[rank] = payload
+    nxt = (max(reports) + 1) if reports else 0
+    for name, payload in unranked:
+        # Aggregating a dump of unknown provenance (no rank, or a rank
+        # already claimed — e.g. a stale un-suffixed file from an earlier
+        # run left in the directory) inflates every summed counter; make
+        # the synthetic assignment loud so the reader can exclude it.
+        sys.stderr.write(
+            f"warning: {name} has no unclaimed rank; aggregating it as "
+            f"synthetic rank {nxt} (stale leftover dump?)\n"
+        )
+        reports[nxt] = payload
+        nxt += 1
+    return reports
+
+
+def aggregate(reports):
+    """One merged report: counters/histogram series summed element-wise
+    across ranks, gauges maxed (peak HBM keeps the worst device). Series
+    are matched by (metric, label-set)."""
+    out = {"meta": {"ranks": sorted(reports)}, "metrics": {}}
+    for rank in sorted(reports):
+        for name, fam in reports[rank].get("metrics", {}).items():
+            ofam = out["metrics"].setdefault(
+                name, {"kind": fam["kind"], "help": fam.get("help", ""),
+                       "series": []},
+            )
+            for series in fam.get("series", []):
+                key = tuple(sorted(series.get("labels", {}).items()))
+                dst = None
+                for s in ofam["series"]:
+                    if tuple(sorted(s.get("labels", {}).items())) == key:
+                        dst = s
+                        break
+                if dst is None:
+                    ofam["series"].append(copy.deepcopy(series))
+                    continue
+                if fam["kind"] == "histogram":
+                    dst["sum"] = dst.get("sum", 0.0) + series.get("sum", 0.0)
+                    dst["count"] = dst.get("count", 0) + series.get("count", 0)
+                    if dst.get("buckets") == series.get("buckets"):
+                        dst["counts"] = [
+                            a + b for a, b in zip(dst["counts"],
+                                                  series["counts"])
+                        ]
+                    else:
+                        # Mixed-build dumps: sum/count merge fine, the
+                        # per-bucket distribution cannot — say so rather
+                        # than render a distribution that doesn't add up.
+                        sys.stderr.write(
+                            f"warning: histogram {name} has differing "
+                            "buckets across ranks; aggregate bucket "
+                            "counts reflect only the first rank\n"
+                        )
+                elif fam["kind"] == "counter":
+                    dst["value"] = dst.get("value", 0) + series.get("value", 0)
+                else:  # gauge: keep the worst rank
+                    dst["value"] = max(dst.get("value", 0),
+                                       series.get("value", 0))
+    return out
+
+
+def render_cross_rank(reports, out=sys.stdout):
+    w = out.write
+    ranks = sorted(reports)
+    w(f"=== SMP cross-rank report ({len(ranks)} rank(s)) ===\n")
+
+    # Per-rank table with the wall-clock skew columns: the
+    # smp_sync_last_unix_seconds gauge is stamped at barrier exit, which
+    # every member leaves near-simultaneously — differences across ranks
+    # are clock skew (+ exit jitter), no extra collective needed. Skew is
+    # only meaningful between ranks stamped at the SAME barrier ordinal
+    # (smp_sync_seq): a rank that died earlier was stamped at a different
+    # physical barrier, and comparing those wall clocks would report
+    # inter-barrier elapsed time as skew.
+    syncs = {
+        r: _value(reports[r], "smp_sync_last_unix_seconds", group="WORLD")
+        for r in ranks
+    }
+    desync = {
+        r: _value(reports[r], "smp_sync_seq", group="WORLD") for r in ranks
+    }
+    seq_counts = {}
+    for r in ranks:
+        if desync[r] is not None and syncs[r] is not None:
+            seq_counts[desync[r]] = seq_counts.get(desync[r], 0) + 1
+    ref_seq = max(seq_counts, key=lambda s: seq_counts[s], default=None)
+    base = min((syncs[r] for r in ranks
+                if desync[r] == ref_seq and syncs[r] is not None),
+               default=None)
+    w(f"\n{'rank':>4}  {'steps':>6}  {'sync seq':>8}  {'skew ms':>9}  "
+      f"phase\n")
+    for r in ranks:
+        rep = reports[r]
+        steps = _value(rep, "smp_step_total", 0)
+        seq = desync[r]
+        comparable = (seq is not None and seq == ref_seq
+                      and syncs[r] is not None and base is not None)
+        skew = f"{(syncs[r] - base) * 1e3:+.3f}" if comparable else "n/a"
+        phase = rep.get("meta", {}).get("phase", "?")
+        w(f"{r:>4}  {int(steps or 0):>6}  "
+          f"{'n/a' if seq is None else int(seq):>8}  {skew:>9}  "
+          f"{phase}\n")
+    seqs = {v for v in desync.values() if v is not None}
+    if len(seqs) > 1:
+        w("!! sync sequence numbers differ across ranks "
+          f"({desync}): ranks stopped at different barriers (crash or "
+          "desync); skew is only shown for ranks at barrier "
+          f"{ref_seq}\n")
+
+    w("\n--- aggregate (counters summed, gauges maxed across ranks) ---\n")
+    return render(aggregate(reports), out=out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Pretty-print an SMP telemetry JSON dump "
-        "(SMP_TELEMETRY_PATH) as a step report."
+        "(SMP_TELEMETRY_PATH) as a step report; a directory of per-rank "
+        "dumps renders the cross-rank aggregate."
     )
-    ap.add_argument("path", help="telemetry JSON file")
+    ap.add_argument("path", help="telemetry JSON file, or a directory of "
+                    "per-rank dumps")
     ap.add_argument(
         "--prometheus", action="store_true",
         help="re-render the dump's metrics in Prometheus text format",
     )
     args = ap.parse_args(argv)
+    if os.path.isdir(args.path):
+        reports = load_rank_dumps(args.path)
+        if not reports:
+            sys.stderr.write(
+                f"no telemetry dumps found in directory {args.path}\n"
+            )
+            return 2
+        if args.prometheus:
+            sys.stderr.write(
+                "--prometheus applies to a single dump, not a directory\n"
+            )
+            return 2
+        return render_cross_rank(reports)
     try:
         with open(args.path) as f:
             report = json.load(f)

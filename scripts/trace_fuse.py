@@ -1,0 +1,477 @@
+#!/usr/bin/env python
+"""Fuse per-rank SMP observability dumps into one clock-aligned trace.
+
+Usage:
+    python scripts/trace_fuse.py -o fused.json DUMP [DUMP ...]
+
+``DUMP`` arguments are files or directories holding any mix of:
+
+- **timelines** (``SMP_TIMELINE_PATH`` -> ``path.rank<i>``): Chrome-trace
+  JSON with ``traceEvents``;
+- **telemetry dumps** (``SMP_TELEMETRY_PATH`` -> ``path.rank<i>``): the
+  registry JSON (``meta`` + ``metrics``);
+- **flight-recorder rings** (``SMP_FLIGHT_RECORDER_PATH`` ->
+  ``path.rank<i>``): JSONL, meta line first.
+
+Output: ONE Perfetto/chrome://tracing-loadable JSON — one pid per rank
+(named ``rank N``), per-rank tracks preserved (pipeline/host/sync/...),
+flight-recorder events as instants on a ``flight_recorder`` track — with
+every rank's clock aligned:
+
+1. each stream carries a wall-clock anchor (the
+   ``smp_clock_anchor/<unix_us>/<rank>`` instant / the recorder meta's
+   ``anchor_unix_us``), giving a naive wall-clock placement;
+2. barrier sync marks (``smp_sync/<name>/<group>/<seq>`` instants /
+   recorder ``sync`` events) refine it: all ranks leave a barrier within
+   network jitter, so per-rank residual offsets are measured against the
+   earliest rank at each shared mark and subtracted (median over marks).
+
+Also prints a straggler report: the per-rank clock table, per-step
+durations/skew with slowest-rank attribution, measured-vs-expected
+pipeline bubble per rank, and a collective-desync check that diffs the
+per-group sequence streams across ranks.
+
+Stdlib only — runnable anywhere the dumps can be copied to.
+"""
+
+import argparse
+import json
+import os
+import re
+import statistics
+import sys
+
+_RANK_RE = re.compile(r"\.rank(\d+)$")
+_ANCHOR_RE = re.compile(r"^smp_clock_anchor/(\d+)/(\d+)$")
+_SYNC_RE = re.compile(r"^smp_sync/(.+)/([^/]+)/(-?\d+)$")
+_STEP_RE = re.compile(r"^step_(\d+)_(begin|end)$")
+
+
+class Stream:
+    """One dump file: events on a local µs clock + a wall anchor."""
+
+    def __init__(self, path, kind, rank):
+        self.path = path
+        self.kind = kind            # "timeline" | "telemetry" | "recorder"
+        self.rank = rank
+        self.events = []            # timeline traceEvents / recorder dicts
+        self.report = None          # telemetry report dict
+        self.anchor_wall_us = None  # wall-clock µs of local ts ...
+        self.anchor_local_us = 0.0  # ... this local timestamp
+        self.syncs = {}             # (name, group, seq) -> local ts µs
+        self.offset_us = None       # local -> fused (filled by align())
+
+
+def _rank_from_name(path):
+    m = _RANK_RE.search(os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def load_stream(path):
+    """Classify + parse one dump file; None when unrecognized."""
+    try:
+        with open(path) as f:
+            head = f.read(1)
+            f.seek(0)
+            if head == "":
+                return None
+            # JSONL probe: only a parseable first LINE that is a recorder
+            # meta makes this a ring dump; a multi-line JSON document's
+            # first line (e.g. "{") must fall through to the full parse.
+            try:
+                first = json.loads(f.readline())
+            except ValueError:
+                first = None
+            if isinstance(first, dict) and first.get("kind") == "meta":
+                # Flight-recorder JSONL.
+                s = Stream(path, "recorder",
+                           _rank_from_name(path) if first.get("rank") is None
+                           else first["rank"])
+                s.anchor_wall_us = first.get("anchor_unix_us")
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    ev = json.loads(line)
+                    s.events.append(ev)
+                    if ev.get("kind") == "sync" and "wall_us" in ev:
+                        key = (ev.get("name"), ev.get("group"),
+                               ev.get("seq"))
+                        s.syncs[key] = ev["ts_us"]
+                        # A sync event is itself a (better) anchor: its
+                        # wall time was captured at its local ts.
+                        s.anchor_wall_us = ev["wall_us"]
+                        s.anchor_local_us = ev["ts_us"]
+                return s
+            f.seek(0)
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(payload, dict) and "traceEvents" in payload:
+        # A FUSED output (this script's own, under any name) re-ingested
+        # as an input would duplicate every rank's events under one bogus
+        # pid and poison the sync-mark alignment. Per-rank timelines never
+        # contain process_name metadata — only fuse() emits it.
+        if any(e.get("ph") == "M" and e.get("name") == "process_name"
+               for e in payload["traceEvents"]):
+            sys.stderr.write(
+                f"trace_fuse: skipping {path}: already a fused trace\n"
+            )
+            return None
+        s = Stream(path, "timeline", _rank_from_name(path))
+        s.events = payload["traceEvents"]
+        for ev in s.events:
+            name = ev.get("name", "")
+            m = _ANCHOR_RE.match(name)
+            if m:
+                s.anchor_wall_us = int(m.group(1))
+                s.anchor_local_us = ev.get("ts", 0.0)
+                if s.rank is None:
+                    s.rank = int(m.group(2))
+            m = _SYNC_RE.match(name)
+            if m:
+                s.syncs[(m.group(1), m.group(2), int(m.group(3)))] = (
+                    ev.get("ts", 0.0)
+                )
+        return s
+    if isinstance(payload, dict) and "metrics" in payload:
+        s = Stream(path, "telemetry", _rank_from_name(path))
+        meta = payload.get("meta", {})
+        if s.rank is None and meta.get("rank") is not None:
+            s.rank = meta["rank"]
+        s.report = payload
+        return s
+    return None
+
+
+def collect_inputs(paths, exclude=None):
+    """``exclude``: absolute paths to skip — above all the fuser's own
+    output file, which is itself a traceEvents JSON: writing fused.json
+    into the dump directory and re-running must not re-ingest it as a
+    bogus anchor-less extra rank."""
+    exclude = {os.path.abspath(p) for p in (exclude or ())}
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(
+                os.path.join(p, n) for n in sorted(os.listdir(p))
+                if os.path.isfile(os.path.join(p, n))
+            )
+        else:
+            files.append(p)
+    files = [f for f in files if os.path.abspath(f) not in exclude]
+    streams = []
+    for f in files:
+        s = load_stream(f)
+        if s is None:
+            sys.stderr.write(f"trace_fuse: skipping unrecognized {f}\n")
+        else:
+            streams.append(s)
+    # Unknown ranks: assign stable ids after the known ones.
+    known = {s.rank for s in streams if s.rank is not None}
+    nxt = (max(known) + 1) if known else 0
+    for s in streams:
+        if s.rank is None:
+            s.rank = nxt
+            nxt += 1
+    return streams
+
+
+# ----------------------------------------------------------------------
+# Clock alignment
+# ----------------------------------------------------------------------
+
+
+def align(streams):
+    """Fill per-stream ``offset_us`` (local -> fused clock) and return the
+    clock table: {rank: {"naive_us", "correction_us", "jitter_us"}}.
+
+    Fused clock = wall-clock µs since the earliest anchor. Naive placement
+    uses each stream's own anchor; the sync-mark correction is computed
+    PER RANK (wall clocks are per host/process, shared by all of a rank's
+    streams) as the median residual against the earliest rank across all
+    shared marks."""
+    anchored = [s for s in streams if s.anchor_wall_us is not None]
+    if not anchored:
+        for s in streams:
+            s.offset_us = 0.0
+        return {}
+    origin = min(s.anchor_wall_us - s.anchor_local_us for s in anchored)
+    for s in streams:
+        if s.anchor_wall_us is None:
+            s.offset_us = 0.0
+        else:
+            s.offset_us = (s.anchor_wall_us - s.anchor_local_us) - origin
+
+    # Naive fused times of every sync mark, keyed by (mark, rank).
+    marks = {}
+    for s in streams:
+        if s.anchor_wall_us is None:
+            continue
+        for key, local_ts in s.syncs.items():
+            marks.setdefault(key, {}).setdefault(
+                s.rank, local_ts + s.offset_us
+            )
+    residuals = {}
+    for key, per_rank in marks.items():
+        if len(per_rank) < 2:
+            continue
+        ref = min(per_rank.values())
+        for rank, t in per_rank.items():
+            residuals.setdefault(rank, []).append(t - ref)
+    corrections = {
+        rank: statistics.median(r) for rank, r in residuals.items()
+    }
+    for s in streams:
+        s.offset_us -= corrections.get(s.rank, 0.0)
+
+    table = {}
+    for s in anchored:
+        entry = table.setdefault(s.rank, {
+            "naive_us": (s.anchor_wall_us - s.anchor_local_us) - origin,
+            "correction_us": corrections.get(s.rank, 0.0),
+            "jitter_us": 0.0,
+        })
+        res = residuals.get(s.rank)
+        if res:
+            c = corrections.get(s.rank, 0.0)
+            entry["jitter_us"] = max(abs(r - c) for r in res)
+    return table
+
+
+# ----------------------------------------------------------------------
+# Fused trace assembly
+# ----------------------------------------------------------------------
+
+
+def fuse(streams):
+    out = []
+    ranks = sorted({s.rank for s in streams})
+    for r in ranks:
+        out.append({"ph": "M", "name": "process_name", "pid": r,
+                    "args": {"name": f"rank {r}"}})
+    for s in streams:
+        if s.kind == "timeline":
+            for ev in s.events:
+                ev = dict(ev)
+                ev["pid"] = s.rank
+                if "ts" in ev:
+                    ev["ts"] = ev["ts"] + s.offset_us
+                out.append(ev)
+        elif s.kind == "recorder":
+            for ev in s.events:
+                kind = ev.get("kind", "?")
+                name = kind
+                if kind == "collective":
+                    name = f"{ev.get('op', '?')}#{ev.get('seq', '?')}"
+                elif kind == "phase":
+                    name = ev.get("phase", "phase")
+                elif kind == "slot":
+                    name = (f"{ev.get('direction')}:mb"
+                            f"{ev.get('microbatch')}@s{ev.get('stage')}")
+                args = {k: v for k, v in ev.items()
+                        if k not in ("ts_us", "id")}
+                out.append({
+                    "name": name, "ph": "i",
+                    "ts": ev.get("ts_us", 0.0) + s.offset_us,
+                    "pid": s.rank, "tid": "flight_recorder", "s": "t",
+                    "args": args,
+                })
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# ----------------------------------------------------------------------
+# Straggler / skew report
+# ----------------------------------------------------------------------
+
+# These two helpers (and the .rank<i> parsing above) intentionally
+# duplicate telemetry_report.py's: each script stays a SINGLE copyable
+# file an operator can scp next to the dumps with no sibling imports.
+
+
+def _telemetry_value(report, name, default=None, **labels):
+    fam = report.get("metrics", {}).get(name)
+    for s in (fam or {}).get("series", []):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s.get("value", default)
+    return default
+
+
+def _telemetry_series(report, name):
+    fam = report.get("metrics", {}).get(name)
+    return (fam or {}).get("series", [])
+
+
+def step_table(streams):
+    """{step: {rank: (begin_fused, end_fused)}} from timeline instants."""
+    steps = {}
+    for s in streams:
+        if s.kind != "timeline":
+            continue
+        for ev in s.events:
+            m = _STEP_RE.match(ev.get("name", ""))
+            if not m:
+                continue
+            step, edge = int(m.group(1)), m.group(2)
+            slot = steps.setdefault(step, {}).setdefault(s.rank, [None, None])
+            slot[0 if edge == "begin" else 1] = ev.get("ts", 0.0) + s.offset_us
+    return steps
+
+
+def desync_check(streams):
+    """Diff per-group collective sequence streams across ranks. Returns a
+    list of human-readable findings (empty = consistent)."""
+    per_rank = {}  # rank -> group -> {seq: op}
+    for s in streams:
+        if s.kind != "recorder":
+            continue
+        g = per_rank.setdefault(s.rank, {})
+        for ev in s.events:
+            # Only sequenced (symmetric) collectives participate: p2p
+            # send/recv events are recorded with seq -1 because their
+            # streams are rank-local by design, not a desync.
+            if ev.get("kind") == "collective" and ev.get("seq", -1) >= 0:
+                g.setdefault(ev.get("group", "?"), {})[ev["seq"]] = (
+                    ev.get("op", "?")
+                )
+    findings = []
+    groups = sorted({g for r in per_rank.values() for g in r})
+    for group in groups:
+        ranks = sorted(r for r, gs in per_rank.items() if group in gs)
+        if len(ranks) < 2:
+            continue
+        shared = set.intersection(
+            *(set(per_rank[r][group]) for r in ranks)
+        )
+        for seq in sorted(shared):
+            ops = {r: per_rank[r][group][seq] for r in ranks}
+            if len(set(ops.values())) > 1:
+                findings.append(
+                    f"group {group} seq {seq}: DIVERGED ops {ops} "
+                    "(first mismatched collective — ranks are desynced "
+                    "from here on)"
+                )
+                break
+        counts = {r: (max(per_rank[r][group]) + 1 if per_rank[r][group]
+                      else 0) for r in ranks}
+        if len(set(counts.values())) > 1:
+            findings.append(
+                f"group {group}: collective counts differ across ranks "
+                f"{counts} (laggards may be stuck before their next "
+                "collective; ring eviction can also truncate old seqs)"
+            )
+    return findings
+
+
+def render_report(streams, clock_table, out=sys.stdout):
+    w = out.write
+    ranks = sorted({s.rank for s in streams})
+    w("=== trace_fuse report ===\n")
+    w(f"{len(streams)} stream(s), ranks {ranks}\n")
+
+    if clock_table:
+        w("\n-- clock alignment (µs) --\n")
+        w(f"{'rank':>4}  {'naive offset':>14}  {'sync correction':>16}  "
+          f"{'residual jitter':>16}\n")
+        for r in sorted(clock_table):
+            e = clock_table[r]
+            w(f"{r:>4}  {e['naive_us']:>14,.0f}  "
+              f"{e['correction_us']:>16,.0f}  {e['jitter_us']:>16,.0f}\n")
+
+    steps = step_table(streams)
+    if steps:
+        w("\n-- per-step skew / stragglers --\n")
+        w(f"{'step':>4}  {'rank':>4}  {'duration ms':>12}  "
+          f"{'vs median':>10}\n")
+        for step in sorted(steps):
+            per_rank = steps[step]
+            durs = {r: (be[1] - be[0]) / 1e3
+                    for r, be in per_rank.items()
+                    if be[0] is not None and be[1] is not None}
+            if not durs:
+                continue
+            med = statistics.median(durs.values())
+            slowest = max(durs, key=durs.get)
+            for r in sorted(durs):
+                mark = "  <- slowest" if (r == slowest and len(durs) > 1) else ""
+                w(f"{step:>4}  {r:>4}  {durs[r]:>12.3f}  "
+                  f"{durs[r] - med:>+10.3f}{mark}\n")
+            ends = [be[1] for be in per_rank.values() if be[1] is not None]
+            if len(ends) > 1:
+                w(f"      step {step} end skew across ranks: "
+                  f"{(max(ends) - min(ends)) / 1e3:.3f} ms\n")
+
+    tele = [s for s in streams if s.kind == "telemetry"]
+    if tele:
+        w("\n-- pipeline bubble (measured vs expected) --\n")
+        w(f"{'rank':>4}  {'schedule':<12}{'measured':>10}{'expected':>10}"
+          f"{'pp':>4}{'mb':>4}\n")
+        for s in sorted(tele, key=lambda s: s.rank):
+            for series in _telemetry_series(
+                s.report, "smp_pipeline_bubble_fraction"
+            ):
+                sched = series["labels"].get("schedule", "?")
+                theo = _telemetry_value(
+                    s.report, "smp_pipeline_bubble_fraction_theoretical",
+                    schedule=sched,
+                )
+                pp = _telemetry_value(
+                    s.report, "smp_pipeline_stages", schedule=sched
+                )
+                mb = _telemetry_value(
+                    s.report, "smp_pipeline_microbatches", schedule=sched
+                )
+                flag = ""
+                if theo is not None and series["value"] > theo + 0.05:
+                    flag = "  <- exceeds bound"
+                w(f"{s.rank:>4}  {sched:<12}"
+                  f"{100 * series['value']:>9.1f}%"
+                  + (f"{100 * theo:>9.1f}%" if theo is not None
+                     else f"{'n/a':>10}")
+                  + f"{int(pp) if pp else 0:>4}{int(mb) if mb else 0:>4}"
+                  + flag + "\n")
+
+    findings = desync_check(streams)
+    w("\n-- collective consistency --\n")
+    if findings:
+        for f in findings:
+            w(f"!! {f}\n")
+    else:
+        w("per-group collective sequence streams agree across ranks\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Fuse per-rank SMP timeline/telemetry/flight-recorder "
+        "dumps into one clock-aligned Perfetto trace + straggler report."
+    )
+    ap.add_argument("inputs", nargs="+",
+                    help="dump files or directories of dumps")
+    ap.add_argument("-o", "--output", default="fused_trace.json",
+                    help="fused Perfetto JSON path (default %(default)s)")
+    ap.add_argument("--no-report", action="store_true",
+                    help="write the fused trace only, skip the report")
+    args = ap.parse_args(argv)
+
+    streams = collect_inputs(args.inputs, exclude=[args.output])
+    if not streams:
+        sys.stderr.write("trace_fuse: no recognizable dumps found\n")
+        return 2
+    clock_table = align(streams)
+    fused = fuse(streams)
+    tmp = f"{args.output}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(fused, f)
+    os.replace(tmp, args.output)
+    n_ev = len(fused["traceEvents"])
+    sys.stdout.write(
+        f"wrote {args.output}: {n_ev} events, "
+        f"{len({s.rank for s in streams})} rank(s)\n"
+    )
+    if not args.no_report:
+        render_report(streams, clock_table)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,9 +36,11 @@ import jax
 
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.utils.exceptions import SMPRuntimeError
+from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.utils.telemetry import (
     record_comm,
+    record_sync_mark,
     telemetry,
     watchdog,
 )
@@ -114,6 +116,12 @@ class CollectiveCommunicator:
         self._bus_failed = False
         self._send_seq = {}
         self._recv_seq = {}
+        # Per-group barrier counter for the sync marks: deliberately NOT
+        # the flight recorder's collective seq (which goes away when the
+        # ring is disabled) — sync-mark identity across ranks must never
+        # depend on an observability knob, or trace_fuse would match
+        # DIFFERENT physical barriers and compute wrong clock offsets.
+        self._barrier_seq = {}
         # Internal (framework) P2P streams, kept separate from the user
         # API's: internal tx ids are even (is_user_api=0), user odd.
         self._int_send_seq = {}
@@ -256,6 +264,11 @@ class CollectiveCommunicator:
         from jax.experimental import multihost_utils
 
         payload = pickle.dumps(obj) if jax.process_index() == src else b""
+        # Begin-edge into the flight recorder BEFORE the blocking device
+        # collective (record_comm below fires only on completion): a rank
+        # wedged inside the broadcast must leave this as its ring's last
+        # word, same as the native bus waits do.
+        flight_recorder.record_wait("broadcast", -1, 0, "begin", 0.0)
         with watchdog.guard(f"broadcast/{getattr(group, 'name', group)}"):
             # Length-prefix exchange, then the payload as a uint8 array.
             n = multihost_utils.broadcast_one_to_all(
@@ -286,6 +299,8 @@ class CollectiveCommunicator:
         from jax.experimental import multihost_utils
 
         payload = pickle.dumps(obj)
+        # Begin-edge before the blocking collective; see broadcast.
+        flight_recorder.record_wait("allgather", -1, 0, "begin", 0.0)
         with watchdog.guard(f"allgather/{getattr(group, 'name', group)}"):
             lens = np.asarray(
                 multihost_utils.process_allgather(
@@ -378,13 +393,29 @@ class CollectiveCommunicator:
         widening."""
         procs = self.group_processes(group)
         record_comm("barrier", group, 0, len(procs))
-        if len(procs) <= 1:
-            return
-        if len(procs) < jax.process_count():
-            with watchdog.guard(f"barrier/{getattr(group, 'name', group)}"):
-                self._get_bus(f"smp.barrier({group})").barrier(procs)
-            return
-        state.core.barrier(name)
+        gname = getattr(group, "name", None) or str(group)
+        seq = self._barrier_seq.get(gname, 0)
+        self._barrier_seq[gname] = seq + 1
+        if len(procs) > 1:
+            if len(procs) < jax.process_count():
+                with watchdog.guard(f"barrier/{gname}"):
+                    self._get_bus(f"smp.barrier({group})").barrier(procs)
+            else:
+                state.core.barrier(name)
+        # Sync mark AFTER the barrier: every member leaves it within
+        # network jitter of the others, so this rank's wall clock at this
+        # point is the cross-rank alignment signal trace_fuse uses to
+        # correct per-rank clock offsets (and the skew gauges measure).
+        # `seq` is this group's barrier ordinal — identical on every
+        # member that executes the same barrier sequence, which is what
+        # lets trace_fuse match the SAME physical barrier across ranks.
+        self._record_sync(name, gname, seq)
+
+    def _record_sync(self, name, gname, seq):
+        record_sync_mark(name, gname, seq)
+        tl = state.timeline
+        if tl is not None and tl.enabled:
+            tl.sync_mark(name, gname, seq)
 
     # -- point-to-point (native bus; reference N2 user API) -------------
 

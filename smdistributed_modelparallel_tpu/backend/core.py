@@ -39,7 +39,6 @@ class ModelParallelCore:
         self.cfg = None
         self.topology = None
         self._initialized = False
-        self._timeline = None
         self.exit_hook = None
 
     # -- lifecycle ------------------------------------------------------
@@ -108,10 +107,21 @@ class ModelParallelCore:
                 self.exit_hook.exit_code, self.exit_hook.exception,
             )
         self._relay_exit_status(success)
-        if self._timeline is not None:
-            self._timeline.flush()
+        # The session timeline (state.timeline, fed by the step engine and
+        # the barrier sync marks) flushes here: events recorded after the
+        # last step's flush — the final barrier's sync mark above all —
+        # must reach the file or trace_fuse loses its alignment signal.
+        from smdistributed_modelparallel_tpu.backend.state import state
+
+        if state.timeline is not None:
+            state.timeline.flush()
         telemetry.set_phase("shutdown")
         telemetry.dump()  # no-op unless SMP_TELEMETRY_PATH is set
+        from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+            flight_recorder,
+        )
+
+        flight_recorder.dump()  # no-op unless SMP_FLIGHT_RECORDER_PATH is set
 
     def _relay_exit_status(self, success):
         """Tell process 0 how this process ended; process 0 polls for peer
@@ -381,12 +391,3 @@ class ModelParallelCore:
         self._check()
         return self.topology.mesh
 
-    # -- timeline -------------------------------------------------------
-
-    @property
-    def timeline(self):
-        if self._timeline is None:
-            from smdistributed_modelparallel_tpu.utils.timeline import Timeline
-
-            self._timeline = Timeline()
-        return self._timeline

@@ -23,6 +23,7 @@ import threading
 import time
 
 from smdistributed_modelparallel_tpu.utils.exceptions import SMPWatchdogTimeout
+from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.utils.telemetry import watchdog
 
@@ -216,11 +217,27 @@ class MessageBus:
                 return n
 
     def recv_bytes(self, src, tx, timeout_ms=-1):
-        n = self._wait_recv(src, tx, timeout_ms)
+        # Flight-record both edges of the wait: the begin event is what a
+        # post-mortem ring shows when this rank wedged INSIDE the wait
+        # (the end event never arrives), the end event carries the
+        # measured wait latency and outcome.
+        flight_recorder.record_wait("bus_recv", src, tx, "begin", 0.0)
+        t0 = time.monotonic()
+        try:
+            n = self._wait_recv(src, tx, timeout_ms)
+        except SMPWatchdogTimeout:
+            flight_recorder.record_wait(
+                "bus_recv", src, tx, "watchdog", time.monotonic() - t0
+            )
+            raise
+        elapsed = time.monotonic() - t0
         if n == -1:
+            flight_recorder.record_wait("bus_recv", src, tx, "timeout", elapsed)
             raise TimeoutError(f"recv from {src} (tx={tx}) timed out")
         if n < 0:
+            flight_recorder.record_wait("bus_recv", src, tx, "error", elapsed)
             raise OSError(f"smp_wait_recv failed ({n})")
+        flight_recorder.record_wait("bus_recv", src, tx, "ok", elapsed)
         buf = (ctypes.c_uint8 * int(n))()
         got = self._lib.smp_retrieve_object(src, tx, buf, n)
         if got != n:
@@ -237,6 +254,7 @@ class MessageBus:
         if wd is not None:
             timeout_ms = min(timeout_ms, max(int(wd * 1000), 1))
         arr = (ctypes.c_int * len(ranks))(*sorted(ranks))
+        flight_recorder.record_wait("bus_barrier", -1, len(ranks), "begin", 0.0)
         t0 = time.monotonic()
         if self._lib.smp_bus_barrier(arr, len(ranks), timeout_ms) != 0:
             # The C side returns -1 for timeouts AND for immediate failures
@@ -245,6 +263,9 @@ class MessageBus:
             # plain OSError their callers handle.
             elapsed_ms = (time.monotonic() - t0) * 1000
             if wd is not None and elapsed_ms >= 0.9 * timeout_ms:
+                flight_recorder.record_wait(
+                    "bus_barrier", -1, len(ranks), "watchdog", elapsed_ms / 1e3
+                )
                 watchdog.dump(
                     f"bus barrier over {sorted(ranks)} stalled >{timeout_ms}ms"
                 )
@@ -252,7 +273,13 @@ class MessageBus:
                     f"watchdog: bus barrier over {sorted(ranks)} stalled "
                     f"(diagnostics dumped)."
                 )
+            flight_recorder.record_wait(
+                "bus_barrier", -1, len(ranks), "error", elapsed_ms / 1e3
+            )
             raise OSError(f"bus barrier over {sorted(ranks)} failed")
+        flight_recorder.record_wait(
+            "bus_barrier", -1, len(ranks), "ok", time.monotonic() - t0
+        )
 
     def shutdown(self):
         self._lib.smp_bus_shutdown()

@@ -114,9 +114,17 @@ class ModelParallelState:
 
     def reset(self):
         """Testing hook: drop model/optimizer registrations and counters."""
+        from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+            flight_recorder,
+        )
         from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
 
         telemetry.reset()
+        flight_recorder.clear()
+        if self._comm is not None:
+            # Barrier ordinals restart with the session, like the metric
+            # counters (a re-init resets them on every rank uniformly).
+            self._comm._barrier_seq.clear()
         self.model = None
         self.optimizer = None
         self.module_manager = None

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from smdistributed_modelparallel_tpu.utils.jax_compat import shard_map
+
 NEG_INF = -1e30
 
 # Testing hook, mirroring pallas_attention.FORCE_INTERPRET.
@@ -348,7 +350,7 @@ def make_vocab_parallel_fused_ce(mesh, v_global, block_n, block_v,
             sum_l = jnp.zeros_like(lse_l)
         return lse_l[None], tgt_l[None], sum_l[None]   # [1, N] per shard
 
-    stats_fn = jax.shard_map(
+    stats_fn = shard_map(
         stats_body, mesh=mesh,
         in_specs=(P(), P(axis_name, None), P()),
         out_specs=(P(axis_name, None),) * 3,
@@ -367,7 +369,7 @@ def make_vocab_parallel_fused_ce(mesh, v_global, block_n, block_v,
         dx = jax.lax.psum(dx_l.astype(jnp.float32), axis_name)
         return dx, dw_l
 
-    bwd_fn = jax.shard_map(
+    bwd_fn = shard_map(
         bwd_body, mesh=mesh,
         in_specs=(P(), P(axis_name, None), P(), P(), P()),
         out_specs=(P(), P(axis_name, None)),

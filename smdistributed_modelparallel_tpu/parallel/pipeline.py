@@ -531,6 +531,9 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     # are exactly num_mb per stage over num_mb + S - 1 ticks, so the
     # measured fraction coincides with the theoretical (pp-1)/(mb+pp-1);
     # recording both keeps the report honest when the executor changes.
+    from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+        flight_recorder,
+    )
     from smdistributed_modelparallel_tpu.utils.telemetry import (
         record_pipeline_occupancy,
     )
@@ -538,6 +541,15 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     record_pipeline_occupancy(
         "fill_drain", S, num_mb, busy_slots=num_mb * S,
         total_slots=n_ticks * S,
+    )
+    # The busy (tick, stage) -> microbatch assignments land in the flight
+    # recorder once per trace: a stall dump can then say which schedule
+    # slot each rank's program was built to be in, not just "in step N".
+    flight_recorder.record_schedule(
+        "fill_drain",
+        ((t, s, "fwd", t - s)
+         for t in range(n_ticks) for s in range(S)
+         if 0 <= t - s < num_mb),
     )
     # Only the hidden flows stage-to-stage over the pp permute; tuple-carry
     # side values (cross_states, attention_mask) are static per-microbatch

@@ -155,12 +155,24 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
 
     fwd_np, bwd_np = build_1f1b_schedule(S, M, W)
     n_ticks = fwd_np.shape[0]
+    from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+        flight_recorder,
+    )
     from smdistributed_modelparallel_tpu.utils.telemetry import (
         record_pipeline_occupancy,
     )
 
     busy, total = schedule_occupancy(fwd_np, bwd_np)
     record_pipeline_occupancy("1f1b", S, M, busy_slots=busy, total_slots=total)
+    # Busy schedule slots (with microbatch ids) into the flight recorder,
+    # once per trace — see pipeline.py for why.
+    flight_recorder.record_schedule(
+        "1f1b",
+        ((t, s, d, int(sched[t, s]))
+         for t in range(n_ticks) for s in range(S)
+         for d, sched in (("fwd", fwd_np), ("bwd", bwd_np))
+         if sched[t, s] >= 0),
+    )
     fwd_sched = jnp.asarray(fwd_np)
     bwd_sched = jnp.asarray(bwd_np)
 

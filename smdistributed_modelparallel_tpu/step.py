@@ -43,6 +43,7 @@ from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.model import DistributedModel
 from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
 from smdistributed_modelparallel_tpu.utils.exceptions import StepUsageError
+from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
 from smdistributed_modelparallel_tpu.nn.utils import half_cast as half_cast_util
@@ -107,6 +108,7 @@ class StepFunction:
 
         tl = state.timeline
         telemetry.set_phase(f"step_{state.step_count}")
+        flight_recorder.record_step("begin", state.step_count)
         t_step = time.perf_counter()
         if tl is not None and tl.enabled:
             tl.start_step(state.step_count)
@@ -127,6 +129,7 @@ class StepFunction:
         telemetry.histogram(
             "smp_step_dispatch_seconds", "host wall time per step dispatch"
         ).observe(time.perf_counter() - t_step)
+        flight_recorder.record_step("end", state.step_count)
         telemetry.counter("smp_step_total", "step invocations").inc()
         if state.memory_metrics is not None:
             state.memory_metrics.record_step(state.step_count)
@@ -279,9 +282,11 @@ class StepFunction:
                 model, treedef, scan_idx, bcast_idx, static, num_mb,
                 scan_meta, opt.build_update_fn() if fused else None,
             )
+            t_build = time.perf_counter() - t_build
             telemetry.histogram(
                 "smp_step_trace_seconds", "step program build/trace wall time"
-            ).observe(time.perf_counter() - t_build)
+            ).observe(t_build)
+            flight_recorder.record_compile("trace", "step", t_build)
             self._cache[key] = compiled
         else:
             cache_events.labels(event="hit").inc()
@@ -715,9 +720,11 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                     )
                 except Exception as e:  # pragma: no cover - backend-specific
                     logger.debug("AOT compile report unavailable: %s", e)
+                t_compile = time.perf_counter() - t_compile
                 telemetry.histogram(
                     "smp_step_compile_seconds", "XLA compile wall time"
-                ).observe(time.perf_counter() - t_compile)
+                ).observe(t_compile)
+                flight_recorder.record_compile("xla_compile", name, t_compile)
                 telemetry.set_phase(f"run/{name}")
                 holder["compiled"] = compiled
             c = holder["compiled"]

@@ -1,0 +1,58 @@
+"""Version compatibility shims over the moving jax API surface.
+
+The package targets the modern spelling of jax APIs; this module maps
+them onto what the installed jax actually provides. Current shims:
+
+- ``shard_map``: the call sites (``ops/context_parallel.py``,
+  ``ops/pallas_ce.py``) use the ``jax.shard_map`` surface (jax >= 0.5):
+  ``axis_names=`` names the MANUALLY-mapped mesh axes and ``check_vma=``
+  toggles the varying-mesh-axes check. jax 0.4.x only has
+  ``jax.experimental.shard_map.shard_map`` (``auto=`` names the
+  complement set, ``check_rep=`` the flag). The obvious translation
+  ``auto = mesh.axis_names - axis_names`` was verified NOT to work on
+  the installed jax 0.4.37: a partial-auto region whose body contains
+  collectives (ppermute/psum/axis_index) either fails SPMD partitioning
+  ("PartitionId instruction is not supported") or hard-aborts XLA:CPU
+  (``spmd_partitioner.cc CHECK target.IsManualSubgroup() ==
+  sharding().IsManualSubgroup()``). The old-jax fallback therefore goes
+  FULL manual (``auto=frozenset()``): axes the caller left automatic
+  become manual-with-replicated-data (their dims are simply absent from
+  the in/out specs), which is semantically equivalent — inputs sharded
+  over those axes outside the region are gathered at region entry — at
+  the cost of replicated compute over those axes on multi-axis meshes.
+  jax >= 0.5 gets true partial-auto behavior back automatically.
+
+Keep this module import-light (jax only): it is imported at ops-module
+import time, which the import-hygiene test requires to not initialize
+any accelerator backend.
+"""
+
+import jax
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, axis_names=None,
+              check_vma=True):
+    """``jax.shard_map``-compatible wrapper that also runs on jax 0.4.x.
+
+    Args follow the new-style surface: ``axis_names`` is the set of mesh
+    axis names the body is manual over (None = all of them), ``check_vma``
+    the varying-axes check. On new jax this forwards directly; on old jax
+    it translates to ``auto=``/``check_rep=``.
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kw,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # Full manual on old jax — see module docstring for why NOT
+    # auto=mesh.axis_names - axis_names (it crashes 0.4.37's partitioner
+    # as soon as the body contains a collective).
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=bool(check_vma), auto=frozenset(),
+    )

@@ -214,6 +214,11 @@ class TelemetryRegistry:
         # itself initialize — or hang on — a wedged backend at exit).
         self.process_index = None
         self.process_count = 1
+        # Installed by utils/flight_recorder.py at import: phase
+        # transitions flow into the flight-recorder ring without this
+        # module importing it (telemetry must stay the leaf of the
+        # observability import graph).
+        self._phase_listener = None
 
     # -- registration ---------------------------------------------------
 
@@ -250,6 +255,9 @@ class TelemetryRegistry:
             self._phase_history.append((phase, self._phase_ts))
             if len(self._phase_history) > 64:
                 del self._phase_history[:-64]
+        listener = self._phase_listener
+        if listener is not None:
+            listener(phase)
 
     @property
     def phase(self):
@@ -264,6 +272,8 @@ class TelemetryRegistry:
             families = dict(self._families)
             meta = {
                 "pid": os.getpid(),
+                "rank": self.process_index,
+                "world": self.process_count,
                 "created": self._created,
                 "exported": time.time(),
                 "phase": self._phase,
@@ -382,6 +392,12 @@ class Watchdog:
         mask the stall it is reporting). Returns the dump dict."""
         with self._dump_lock:
             try:
+                # Mark the stall in the ring first: the snapshot below then
+                # carries it, and later dumps show this one as history.
+                try:
+                    _flight().record_watchdog(reason)
+                except Exception:
+                    pass
                 stacks = {}
                 frames = sys._current_frames()
                 names = {t.ident: t.name for t in threading.enumerate()}
@@ -396,6 +412,10 @@ class Watchdog:
                     "pid": os.getpid(),
                     "threads": stacks,
                     "telemetry": self._registry.report(),
+                    # The last ~N structured events (collectives with seq
+                    # numbers, schedule slots, phases): what this rank was
+                    # DOING, not just where its threads are parked.
+                    "flight_recorder": _flight_snapshot(),
                 }
                 path = self._registry._rank_path(
                     os.environ.get(WATCHDOG_PATH_ENV, "smp_watchdog_dump.json")
@@ -473,6 +493,50 @@ class Watchdog:
 telemetry = TelemetryRegistry()
 watchdog = Watchdog(telemetry)
 
+# Lazy seam to utils/flight_recorder.py (it imports THIS module for
+# _rank_path, so the reverse edge must not exist at import time). The
+# recorder-disabled case stays near-free: one module-attr lookup + the
+# recorder's own `enabled` test.
+_flight_mod = None
+
+
+def _flight():
+    global _flight_mod
+    if _flight_mod is None:
+        from smdistributed_modelparallel_tpu.utils import flight_recorder
+
+        _flight_mod = flight_recorder
+    return _flight_mod.flight_recorder
+
+
+def _flight_snapshot():
+    try:
+        fr = _flight()
+        return {"meta": fr._meta(), "events": fr.snapshot()}
+    except Exception:  # pragma: no cover - diagnostics must not throw
+        return None
+
+
+def record_sync_mark(name, group, seq):
+    """One barrier-exit sync mark: feeds the flight recorder (cross-rank
+    clock alignment for trace_fuse) and the skew gauges. All ranks of the
+    group leave the barrier within network jitter of each other, so
+    comparing ``smp_sync_last_unix_seconds`` for the same
+    ``smp_sync_seq`` across per-rank telemetry dumps measures per-rank
+    wall-clock skew (+ exit jitter) without any extra collective."""
+    fr = _flight()
+    fr.record_sync(name, group, seq)
+    telemetry.counter(
+        "smp_sync_marks_total", "barrier sync marks recorded"
+    ).labels(group=group).inc()
+    telemetry.gauge(
+        "smp_sync_seq", "per-group barrier ordinal of the last sync mark"
+    ).labels(group=group).set(seq)
+    telemetry.gauge(
+        "smp_sync_last_unix_seconds",
+        "wall-clock time of the last barrier exit (cross-rank skew probe)",
+    ).labels(group=group).set(time.time())
+
 
 def record_comm(op, group, nbytes, group_size):
     """One host-collective record: op count, payload bytes, group size.
@@ -484,6 +548,15 @@ def record_comm(op, group, nbytes, group_size):
     host control plane, counted here.
     """
     g = getattr(group, "name", None) or str(group)
+    # Every host collective also lands in the flight-recorder ring. Only
+    # SYMMETRIC ops — ones every group member executes in the same order —
+    # consume the per-group sequence number (that is what makes cross-rank
+    # ring diffs meaningful); p2p send/recv/poll streams are rank-local
+    # and are recorded unsequenced.
+    _flight().record_collective(
+        op, g, nbytes, group_size,
+        sequenced=op in ("broadcast", "allgather", "barrier"),
+    )
     telemetry.counter(
         "smp_comm_ops_total", "host collective operations"
     ).labels(op=op, group=g).inc()

@@ -139,6 +139,11 @@ _SLOW_TESTS = (
     "test_optimizer.py::TestFusedOptimizerStep",
     "test_step.py::test_step_recompiles_after_reinit_same_shapes",
     "test_data.py::TestPrefetch::test_trains_through_step_engine",
+    # Re-tiered after the shard_map compat wrapper (utils/jax_compat.py)
+    # revived the 31 context-parallel tests on jax 0.4.37: they compile
+    # for real now, and this causal ring-attention parity case measured
+    # >= ~20s single-core (same --durations rule as the blocks above).
+    "test_context_parallel.py::TestCpAttentionParity::test_matches_full_attention[True-ring]",
 )
 
 

@@ -289,10 +289,15 @@ class TestCpRealModelFeatures:
             back = cp._zig_exit(z, me, n, CP_AXIS)
             return back, z
 
-        shard_fn = jax.shard_map(
+        from smdistributed_modelparallel_tpu.utils.jax_compat import (
+            shard_map,
+        )
+
+        shard_fn = shard_map(
             body, mesh=state.mesh,
             in_specs=P(None, CP_AXIS),
             out_specs=(P(None, CP_AXIS), P(None, CP_AXIS)),
+            axis_names={CP_AXIS}, check_vma=False,
         )
         with jax.set_mesh(state.mesh):
             back, z = jax.jit(shard_fn)(x)

@@ -4,7 +4,12 @@ Mirrors the reference's timeline/memory-metrics surfaces
 (``torch/step.py:69-115``, ``backend/core.py:524-562``) plus the unified
 telemetry subsystem (``utils/telemetry.py``): registry semantics under
 threads, collective byte accounting, pipeline bubble-fraction math, the
-hang watchdog, and the end-to-end JSON step report + CLI.
+hang watchdog, and the end-to-end JSON step report + CLI — and the
+cross-rank layer (``utils/flight_recorder.py``, ``scripts/trace_fuse.py``,
+``telemetry_report.py`` directory mode): ring bounding, disabled-path
+overhead, collective sequence numbers, watchdog-dump embedding,
+clock-aligned trace fusion with a known synthetic skew, and the per-rank
+skew aggregate.
 """
 
 import json
@@ -25,6 +30,13 @@ import smdistributed_modelparallel_tpu as smp
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.utils.exceptions import SMPWatchdogTimeout
 from smdistributed_modelparallel_tpu.utils import telemetry as tel
+from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+    FlightRecorder,
+)
+
+_SCRIPTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"
+)
 
 
 def _tiny_train(tmp_path, env):
@@ -398,3 +410,436 @@ class TestStepReportE2E:
         assert "SMP step report" in out.stdout
         assert "bubble 33.3% measured" in out.stdout
         assert "hits / 1 misses" in out.stdout
+
+
+# ----------------------------------------------------------------------
+# Flight recorder
+# ----------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_keeps_newest(self):
+        fr = FlightRecorder(size=16)
+        for i in range(50):
+            fr.record_phase(f"p{i}")
+        assert len(fr) == 16
+        events = fr.snapshot()
+        assert [e["phase"] for e in events] == [f"p{i}" for i in range(34, 50)]
+        # Event ids stay globally monotonic across eviction.
+        assert events[0]["id"] < events[-1]["id"]
+
+    def test_snapshot_last_n(self):
+        fr = FlightRecorder(size=8)
+        for i in range(8):
+            fr.record_phase(f"p{i}")
+        assert [e["phase"] for e in fr.snapshot(last=3)] == ["p5", "p6", "p7"]
+
+    def test_disabled_is_a_measured_noop(self):
+        fr = FlightRecorder(size=0)
+        assert not fr.enabled
+        n = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fr.record_phase("x")
+        elapsed = time.perf_counter() - t0
+        # The disabled path is one attribute test before any clock read or
+        # tuple build: 200k calls must stay far under a second even on a
+        # loaded single-core box (measured ~40ms; 25x headroom).
+        assert elapsed < 1.0, f"disabled record path too slow: {elapsed:.3f}s"
+        assert len(fr) == 0
+        assert fr.snapshot() == []
+        # Typed recorders are no-ops too (and next_seq is not consumed).
+        assert fr.record_collective("broadcast", "WORLD", 10, 2) is None
+        fr.record_sync("b", "WORLD", 0)
+        fr.record_schedule("1f1b", [(0, 0, "fwd", 0)])
+        assert len(fr) == 0
+
+    def test_collective_seq_numbers_per_group(self):
+        fr = FlightRecorder(size=64)
+        assert fr.record_collective("broadcast", "WORLD", 10, 2) == 0
+        assert fr.record_collective("barrier", "WORLD", 0, 2) == 1
+        assert fr.record_collective("allgather", "PP_GROUP", 5, 2) == 0
+        events = fr.snapshot()
+        assert [(e["op"], e["group"], e["seq"]) for e in events] == [
+            ("broadcast", "WORLD", 0),
+            ("barrier", "WORLD", 1),
+            ("allgather", "PP_GROUP", 0),
+        ]
+
+    def test_schedule_recording_is_capped(self):
+        fr = FlightRecorder(size=4096)
+        fr.record_schedule(
+            "1f1b", ((t, 0, "fwd", t) for t in range(600)), cap=512
+        )
+        events = fr.snapshot()
+        assert len(events) == 513  # 512 slots + explicit truncation marker
+        assert events[-1]["direction"] == "truncated"
+
+    def test_dump_jsonl(self, tmp_path):
+        fr = FlightRecorder(size=32)
+        fr.record_collective("broadcast", "WORLD", 21, 1)
+        fr.record_phase("steady")
+        path = str(tmp_path / "ring.jsonl")
+        assert fr.dump(path) == path
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["kind"] == "meta"
+        assert lines[0]["size"] == 32
+        assert lines[0]["anchor_unix_us"] > 0
+        assert lines[0]["collective_seq"] == {"WORLD": 1}
+        assert [l["kind"] for l in lines[1:]] == ["collective", "phase"]
+        # No explicit path and no env var -> explicit no-op.
+        assert fr.dump() is None
+        # Atomicity: no temp file left behind.
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+
+    def test_framework_events_flow_into_the_ring(self):
+        smp.shutdown()
+        smp.init({"microbatches": 2})
+        fr = smp.flight_recorder
+        fr.clear()
+        smp.broadcast({"x": 1})
+        smp.barrier()
+        kinds = [e["kind"] for e in fr.snapshot()]
+        assert "collective" in kinds
+        assert "sync" in kinds  # the barrier's sync mark
+        colls = [e for e in fr.snapshot() if e["kind"] == "collective"]
+        assert [c["seq"] for c in colls] == list(range(len(colls)))
+        # Phase transitions ride the telemetry listener seam.
+        smp.telemetry.set_phase("fr_probe")
+        assert fr.snapshot()[-1] == {
+            k: v for k, v in fr.snapshot()[-1].items()
+        }  # well-formed dict
+        assert fr.snapshot()[-1]["phase"] == "fr_probe"
+
+    def test_watchdog_dump_includes_recorder_events(
+        self, tmp_path, monkeypatch
+    ):
+        dump_path = tmp_path / "watchdog.json"
+        monkeypatch.setenv("SMP_WATCHDOG_TIMEOUT", "0.5")
+        monkeypatch.setenv("SMP_WATCHDOG_PATH", str(dump_path))
+        fr = smp.flight_recorder
+        fr.clear()
+        fr.record_collective("recv_from", "WORLD", 0, 2)
+        with pytest.raises(SMPWatchdogTimeout):
+            smp.watchdog.wait(lambda: False, "stuck_recv", interval=0.01)
+        dump = json.load(open(dump_path))
+        ring = dump["flight_recorder"]
+        assert ring["meta"]["size"] == fr.size
+        kinds = [e["kind"] for e in ring["events"]]
+        assert "collective" in kinds
+        # The stall itself is marked in the ring before the snapshot.
+        assert kinds[-1] == "watchdog"
+        colls = [e for e in ring["events"] if e["kind"] == "collective"]
+        assert colls[0]["op"] == "recv_from"
+        assert colls[0]["seq"] == 0
+
+    def test_p2p_ops_do_not_consume_group_seq(self):
+        """send/recv streams are rank-local: if they bumped the group
+        counter, healthy asymmetric traffic (rank 0 sends twice, rank 1
+        receives once) would desync the barrier seqs and the cross-rank
+        ring diff would scream DIVERGED on a correct program."""
+        fr = smp.flight_recorder
+        fr.clear()
+        tel.record_comm("send", "WORLD", 10, 2)
+        tel.record_comm("recv_from", "WORLD", 10, 2)
+        tel.record_comm("broadcast", "WORLD", 10, 2)
+        events = [e for e in fr.snapshot() if e["kind"] == "collective"]
+        assert [(e["op"], e["seq"]) for e in events] == [
+            ("send", -1), ("recv_from", -1), ("broadcast", 0),
+        ]
+
+    def test_barrier_sync_seq_independent_of_recorder(
+        self, tmp_path, monkeypatch
+    ):
+        """Sync-mark identity must survive SMP_FLIGHT_RECORDER_SIZE=0:
+        trace_fuse matches barriers across ranks BY seq, so a constant
+        placeholder would align different physical barriers."""
+        from smdistributed_modelparallel_tpu.utils import flight_recorder as frm
+        from smdistributed_modelparallel_tpu.utils.timeline import Timeline
+
+        smp.shutdown()
+        smp.init({"microbatches": 2})
+        monkeypatch.setattr(
+            frm, "flight_recorder", frm.FlightRecorder(size=0)
+        )
+        path = str(tmp_path / "tl.json")
+        state.timeline = Timeline(path)
+        try:
+            smp.barrier()
+            smp.barrier()
+            state.timeline.flush()
+        finally:
+            state.timeline = None
+        names = [e["name"]
+                 for e in json.load(open(path))["traceEvents"]]
+        syncs = [n for n in names if n.startswith("smp_sync/")]
+        assert len(syncs) == 2
+        assert [int(n.rsplit("/", 1)[1]) for n in syncs] == [0, 1]
+
+    def test_crash_path_dumps_ring(self, tmp_path):
+        """An uncaught exception still leaves the JSONL post-mortem (the
+        atexit hook runs after sys.excepthook)."""
+        path = tmp_path / "crash_ring.jsonl"
+        code = (
+            "import smdistributed_modelparallel_tpu as smp\n"
+            "smp.flight_recorder.record_phase('about_to_die')\n"
+            "raise RuntimeError('boom')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=180,
+            env={**os.environ, "SMP_FLIGHT_RECORDER_PATH": str(path),
+                 "JAX_PLATFORMS": "cpu"},
+        )
+        assert out.returncode != 0  # it crashed...
+        lines = [json.loads(l) for l in open(path)]  # ...but dumped
+        assert lines[0]["kind"] == "meta"
+        assert any(e.get("phase") == "about_to_die" for e in lines[1:])
+
+
+# ----------------------------------------------------------------------
+# Timeline: multi-rank clobber fix + anchor/sync marks
+# ----------------------------------------------------------------------
+
+
+class TestTimelineMultiRank:
+    def test_rank_qualified_atomic_flush_with_anchor(
+        self, tmp_path, monkeypatch
+    ):
+        from smdistributed_modelparallel_tpu.utils.timeline import Timeline
+
+        monkeypatch.setattr(tel.telemetry, "process_index", 3)
+        monkeypatch.setattr(tel.telemetry, "process_count", 4)
+        path = str(tmp_path / "tl.json")
+        t = Timeline(path)
+        t.start_step(0)
+        t.sync_mark("b0", "WORLD", 7)
+        t.end_step(0)
+        t.flush()
+        # N processes pointed at one SMP_TIMELINE_PATH must not clobber.
+        rank_path = path + ".rank3"
+        assert os.path.exists(rank_path)
+        assert not os.path.exists(path)
+        # Atomic: no torn temp files visible after flush.
+        assert not [n for n in os.listdir(tmp_path) if ".tmp." in n]
+        payload = json.load(open(rank_path))
+        names = [e["name"] for e in payload["traceEvents"]]
+        anchors = [e for e in payload["traceEvents"]
+                   if e["name"].startswith("smp_clock_anchor/")]
+        assert len(anchors) == 1
+        # ts must be EXACTLY 0: the embedded wall time is the wall time
+        # of the monotonic origin, and native.load() in between must not
+        # skew the pairing (trace_fuse computes offsets from it).
+        assert anchors[0]["ts"] == 0.0
+        wall_us, rank = anchors[0]["name"].split("/")[1:]
+        assert int(rank) == 3
+        assert abs(int(wall_us) / 1e6 - time.time()) < 600
+        assert "smp_sync/b0/WORLD/7" in names
+        assert "step_0_begin" in names and "step_0_end" in names
+
+    def test_flush_is_idempotent_and_rewrites(self, tmp_path, monkeypatch):
+        from smdistributed_modelparallel_tpu.utils.timeline import Timeline
+
+        path = str(tmp_path / "tl.json")
+        t = Timeline(path)
+        t.record_instant("a")
+        t.flush()
+        n1 = len(json.load(open(path))["traceEvents"])
+        t.record_instant("b")
+        t.flush()
+        n2 = len(json.load(open(path))["traceEvents"])
+        assert n2 == n1 + 1
+
+
+# ----------------------------------------------------------------------
+# trace_fuse: synthetic two-rank golden test
+# ----------------------------------------------------------------------
+
+
+def _instant(name, ts, tid="pipeline"):
+    return {"name": name, "ph": "i", "ts": ts, "pid": 0, "tid": tid,
+            "s": "g"}
+
+
+def _synthetic_rank_dumps(tmp_path):
+    """Two ranks observing the same true events; rank 1's wall clock is
+    fast by exactly 2s. Both exit one barrier at true-time anchor+0.5s
+    (the sync mark); step 0 runs 100ms on rank 0, 200ms on rank 1."""
+    W = 10 ** 12  # true wall anchor, µs
+
+    def timeline(anchor_wall, rank, extra):
+        evs = [_instant(f"smp_clock_anchor/{anchor_wall}/{rank}", 0.0,
+                        "sync")]
+        evs += extra
+        return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+    r0 = timeline(W, 0, [
+        _instant("smp_sync/b/WORLD/0", 500000.0, "sync"),
+        _instant("step_0_begin", 600000.0),
+        _instant("step_0_end", 700000.0),
+        {"name": "work", "ph": "X", "ts": 610000.0, "dur": 80000.0,
+         "pid": 0, "tid": "host", "args": {}},
+    ])
+    r1 = timeline(W + 2_000_000, 1, [
+        _instant("smp_sync/b/WORLD/0", 500000.0, "sync"),
+        _instant("step_0_begin", 600000.0),
+        _instant("step_0_end", 800000.0),
+    ])
+    json.dump(r0, open(tmp_path / "timeline.json.rank0", "w"))
+    json.dump(r1, open(tmp_path / "timeline.json.rank1", "w"))
+
+
+class TestTraceFuse:
+    def _run(self, tmp_path, *args):
+        script = os.path.join(_SCRIPTS, "trace_fuse.py")
+        out_path = tmp_path / "fused.json"
+        out = subprocess.run(
+            [sys.executable, script, "-o", str(out_path), *map(str, args)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.load(open(out_path)), out.stdout
+
+    def test_two_rank_fusion_corrects_known_skew(self, tmp_path):
+        _synthetic_rank_dumps(tmp_path)
+        fused, report = self._run(
+            tmp_path,
+            tmp_path / "timeline.json.rank0",
+            tmp_path / "timeline.json.rank1",
+        )
+        events = fused["traceEvents"]
+        # One pid per rank, with process_name metadata.
+        assert {e["pid"] for e in events} == {0, 1}
+        pnames = {e["pid"]: e["args"]["name"] for e in events
+                  if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert pnames == {0: "rank 0", 1: "rank 1"}
+        # The 2s wall-clock error is corrected: both ranks' sync marks
+        # land on the same fused timestamp, and the step events align.
+        sync_ts = {e["pid"]: e["ts"] for e in events
+                   if e["name"].startswith("smp_sync/")}
+        assert sync_ts[0] == pytest.approx(sync_ts[1], abs=1.0)
+        begins = {e["pid"]: e["ts"] for e in events
+                  if e["name"] == "step_0_begin"}
+        assert begins[0] == pytest.approx(begins[1], abs=1.0)
+        # Duration spans survive fusion (dur untouched, ts shifted).
+        (work,) = [e for e in events if e["name"] == "work"]
+        assert work["dur"] == 80000.0
+        # Straggler report: rank 1 took 200ms vs rank 0's 100ms.
+        assert "slowest" in report
+        assert "100.000 ms" in report  # end-skew across ranks
+        assert "2,000,000" in report   # the sync correction, µs
+
+    def test_directory_input_and_recorder_fusion(self, tmp_path):
+        _synthetic_rank_dumps(tmp_path)
+        # A flight-recorder ring for rank 0 rides along as instants.
+        with open(tmp_path / "ring.jsonl.rank0", "w") as f:
+            f.write(json.dumps({
+                "kind": "meta", "rank": 0, "anchor_unix_us": 10 ** 12,
+            }) + "\n")
+            f.write(json.dumps({
+                "id": 0, "ts_us": 620000.0, "kind": "collective",
+                "op": "broadcast", "group": "WORLD", "nbytes": 21,
+                "group_size": 2, "seq": 0,
+            }) + "\n")
+        fused, _ = self._run(tmp_path, tmp_path)
+        fr_events = [e for e in fused["traceEvents"]
+                     if e.get("tid") == "flight_recorder"]
+        assert len(fr_events) == 1
+        assert fr_events[0]["name"] == "broadcast#0"
+        assert fr_events[0]["args"]["seq"] == 0
+        # Re-running with the output inside the dump dir must not
+        # re-ingest the previous fused.json as a bogus extra rank.
+        refused, _ = self._run(tmp_path, tmp_path)
+        assert {e["pid"] for e in refused["traceEvents"]} == {0, 1}
+
+    def test_desync_detection(self, tmp_path):
+        # Rank 0: broadcast, barrier. Rank 1: barrier, broadcast -> the
+        # streams diverge at seq 0.
+        for rank, ops in ((0, ["broadcast", "barrier"]),
+                          (1, ["barrier", "broadcast"])):
+            with open(tmp_path / f"ring.jsonl.rank{rank}", "w") as f:
+                f.write(json.dumps({
+                    "kind": "meta", "rank": rank,
+                    "anchor_unix_us": 10 ** 12,
+                }) + "\n")
+                for seq, op in enumerate(ops):
+                    f.write(json.dumps({
+                        "id": seq, "ts_us": 1000.0 * seq,
+                        "kind": "collective", "op": op, "group": "WORLD",
+                        "nbytes": 0, "group_size": 2, "seq": seq,
+                    }) + "\n")
+        _, report = self._run(tmp_path, tmp_path)
+        assert "DIVERGED" in report
+        assert "seq 0" in report
+
+
+# ----------------------------------------------------------------------
+# telemetry_report: cross-rank directory aggregate
+# ----------------------------------------------------------------------
+
+
+class TestCrossRankTelemetryReport:
+    def _rank_dump(self, rank, steps, sync_wall, hbm, seq=7):
+        return {
+            "meta": {"pid": 100 + rank, "rank": rank, "world": 2,
+                     "phase": "run/step", "phase_age_seconds": 1.0,
+                     "phase_history": []},
+            "metrics": {
+                "smp_step_total": {
+                    "kind": "counter", "help": "",
+                    "series": [{"labels": {}, "value": steps}],
+                },
+                "smp_sync_last_unix_seconds": {
+                    "kind": "gauge", "help": "",
+                    "series": [{"labels": {"group": "WORLD"},
+                                "value": sync_wall}],
+                },
+                "smp_sync_seq": {
+                    "kind": "gauge", "help": "",
+                    "series": [{"labels": {"group": "WORLD"},
+                                "value": seq}],
+                },
+                "smp_device_peak_hbm_bytes": {
+                    "kind": "gauge", "help": "",
+                    "series": [{"labels": {"device": "d0"}, "value": hbm}],
+                },
+            },
+        }
+
+    def test_directory_aggregate_and_skew_columns(self, tmp_path):
+        json.dump(self._rank_dump(0, 10, 1000.000, 5e9),
+                  open(tmp_path / "telemetry.json.rank0", "w"))
+        json.dump(self._rank_dump(1, 10, 1000.004, 7e9),
+                  open(tmp_path / "telemetry.json.rank1", "w"))
+        script = os.path.join(_SCRIPTS, "telemetry_report.py")
+        out = subprocess.run(
+            [sys.executable, script, str(tmp_path)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "cross-rank report (2 rank(s))" in out.stdout
+        assert "+4.000" in out.stdout      # rank 1's 4ms wall-clock skew
+        assert "steps: 20" in out.stdout   # counters summed
+        assert "6.5 GiB" in out.stdout     # peak HBM maxed, not summed
+
+    def test_skew_suppressed_across_different_barriers(self, tmp_path):
+        """A rank that died at an earlier barrier was stamped at a
+        DIFFERENT physical sync point: comparing its wall clock would
+        report inter-barrier elapsed time as skew, so it shows n/a."""
+        json.dump(self._rank_dump(0, 10, 1000.000, 5e9, seq=7),
+                  open(tmp_path / "telemetry.json.rank0", "w"))
+        json.dump(self._rank_dump(1, 10, 1000.004, 5e9, seq=7),
+                  open(tmp_path / "telemetry.json.rank1", "w"))
+        json.dump(self._rank_dump(2, 6, 990.000, 5e9, seq=5),
+                  open(tmp_path / "telemetry.json.rank2", "w"))
+        script = os.path.join(_SCRIPTS, "telemetry_report.py")
+        out = subprocess.run(
+            [sys.executable, script, str(tmp_path)],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "+4.000" in out.stdout           # ranks 0/1 still compared
+        rank2_row = [l for l in out.stdout.splitlines()
+                     if l.strip().startswith("2 ")][0]
+        assert "n/a" in rank2_row               # never -10000ms "skew"
+        assert "different barriers" in out.stdout
