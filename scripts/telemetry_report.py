@@ -11,7 +11,9 @@ Renders the run the way the reference's one-time Studio metrics upload was
 read: throughput (tokens/sec), pipeline bubble fraction (measured vs the
 (pp-1)/(mb+pp-1) bound), host comm volume by collective, compile-cache
 behavior and compile wall time, XLA-counted FLOPs/bytes of the compiled
-step, and peak HBM per device.
+step, training health (sentinel words, loss-scale events, grad/update
+norms, fault attributions, OOM post-mortems — utils/health.py), and peak
+HBM per device.
 
 Given a DIRECTORY, every telemetry dump in it (the per-rank
 ``path.rank<i>`` files N processes write for one ``SMP_TELEMETRY_PATH``)
@@ -142,6 +144,67 @@ def render(report, out=sys.stdout):
         tmp = _value(report, "smp_compiled_step_temp_bytes", step=name)
         w(f"compiled {name}: {_fmt_num(s['value'])} FLOPs, "
           f"{_fmt_bytes(ba)} accessed, {_fmt_bytes(tmp)} temp\n")
+
+    # -- health ---------------------------------------------------------
+    # Fed by utils/health.py (SMP_HEALTH_CHECK sentinel), the fp16 loss
+    # scaler, and the optimizer norm gauges; rendered identically for one
+    # dump and for the cross-rank aggregate (counters summed, gauges
+    # maxed, per-label fault series preserved).
+    checks = _value(report, "smp_health_checks_total")
+    trips = _series(report, "smp_health_trips_total")
+    bads = _series(report, "smp_health_bad_count")
+    faults = _series(report, "smp_health_fault_total")
+    scale = _value(report, "smp_loss_scale")
+    overflows = _value(report, "smp_loss_scale_events_total", event="overflow")
+    growths = _value(report, "smp_loss_scale_events_total", event="growth")
+    static_of = _value(
+        report, "smp_loss_scale_events_total", event="static_overflow"
+    )
+    gn = _value(report, "smp_grad_norm")
+    pn = _value(report, "smp_param_norm")
+    ur = _value(report, "smp_update_ratio")
+    ooms = _series(report, "smp_oom_total")
+    if any((checks, trips, faults, ooms)) or scale is not None or gn is not None:
+        w("\n-- health --\n")
+        if checks:
+            n_trips = int(sum(s["value"] for s in trips))
+            last = _value(report, "smp_health_last_checked_step")
+            w(f"sentinel: {int(checks)} health words checked"
+              + (f" (through step {int(last)})" if last is not None else "")
+              + f", {n_trips} trip(s)\n")
+        if bads:
+            w("last health word:\n")
+            for s in sorted(bads, key=lambda s: s["labels"].get("tag", "")):
+                tag = s["labels"].get("tag", "?")
+                absmax = _value(report, "smp_health_absmax", tag=tag)
+                first_mb = _value(
+                    report, "smp_health_first_microbatch", tag=tag
+                )
+                line = f"  {tag:<28} bad={int(s['value'])}"
+                if absmax is not None:
+                    line += f"  absmax={absmax:.4g}"
+                if s["value"] and first_mb is not None and first_mb >= 0:
+                    line += f"  first_mb={int(first_mb)}"
+                w(line + "\n")
+        if gn is not None or pn is not None:
+            w("grad norm: " + (f"{gn:.5g}" if gn is not None else "n/a")
+              + (f"   param norm: {pn:.5g}" if pn is not None else "")
+              + (f"   update ratio: {ur:.3g}" if ur is not None else "")
+              + "\n")
+        if scale is not None or overflows or growths or static_of:
+            w(f"loss scale: {scale:g}" if scale is not None else "loss scale:")
+            w(f"  ({int(overflows or 0)} overflow(s), "
+              f"{int(growths or 0)} growth(s)"
+              + (f", {int(static_of)} static overflow(s)" if static_of else "")
+              + ")\n")
+        for s in faults:
+            lab = s["labels"]
+            w(f"!! fault: layer={lab.get('layer')} "
+              f"microbatch={lab.get('microbatch')} tag={lab.get('tag')} "
+              f"x{int(s['value'])}\n")
+        for s in ooms:
+            w(f"!! OOM post-mortem dumped for {s['labels'].get('step', '?')} "
+              f"x{int(s['value'])}\n")
 
     # -- memory ---------------------------------------------------------
     peaks = _series(report, "smp_device_peak_hbm_bytes")

@@ -57,6 +57,7 @@ from smdistributed_modelparallel_tpu.utils.exceptions import (
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.utils.telemetry import telemetry, watchdog
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
+from smdistributed_modelparallel_tpu.utils import health
 from smdistributed_modelparallel_tpu.model import DistributedModel
 from smdistributed_modelparallel_tpu.optimizer import DistributedOptimizer
 from smdistributed_modelparallel_tpu.step import step
@@ -110,6 +111,13 @@ def is_initialized():
 
 
 def shutdown():
+    # Decode the last step's pending health word before the session dies:
+    # cheap mode is one step behind by design, and a run whose FINAL step
+    # went non-finite should still say so (utils/health.py).
+    try:
+        health.monitor.flush()
+    except Exception:
+        pass
     state.core.shutdown()
     state.reset()
 

@@ -114,6 +114,7 @@ class ModelParallelState:
 
     def reset(self):
         """Testing hook: drop model/optimizer registrations and counters."""
+        from smdistributed_modelparallel_tpu.utils import health
         from smdistributed_modelparallel_tpu.utils.flight_recorder import (
             flight_recorder,
         )
@@ -121,6 +122,7 @@ class ModelParallelState:
 
         telemetry.reset()
         flight_recorder.clear()
+        health.reset()
         if self._comm is not None:
             # Barrier ordinals restart with the session, like the metric
             # counters (a re-init resets them on every rank uniformly).

@@ -9,6 +9,7 @@ automatic (the "dynamic-loss-scale agreement" hard part of SURVEY §7).
 """
 
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import record_loss_scale
 
 logger = get_logger()
 
@@ -25,6 +26,10 @@ class LossScaler:
 
     def update(self, found_overflow):
         if found_overflow:
+            # Overflow/skip decisions are health events: counter + scale
+            # gauge + a flight-recorder entry (utils/health.py reads them
+            # back into step reports and post-mortems).
+            record_loss_scale("static_overflow", self._scale)
             logger.warning(
                 "Gradient overflow with static loss scale %.1f; step skipped.",
                 self._scale,
@@ -72,6 +77,7 @@ class DynamicLossScaler(LossScaler):
             else:
                 self.cur_hysteresis -= 1
             self._good_steps = 0
+            record_loss_scale("overflow", self._scale)
         else:
             if self.consecutive_hysteresis:
                 self.cur_hysteresis = self.delayed_shift
@@ -81,6 +87,7 @@ class DynamicLossScaler(LossScaler):
                     self.cur_hysteresis = self.delayed_shift
                 self._scale *= self.scale_factor
                 logger.info("Loss scale grown -> %.1f", self._scale)
+                record_loss_scale("growth", self._scale)
 
     def state_dict(self):
         return {

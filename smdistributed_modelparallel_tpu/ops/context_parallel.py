@@ -53,6 +53,12 @@ logger = get_logger()
 # rule the ring already uses (fwd) / additive accumulation (bwd).
 _RING_CHUNK = 8192
 
+# Chunk-length floor per dispatch mode: real-kernel calls need tileable
+# blocks; the interpret-mode CPU tier has no such constraint (kept as a
+# module constant so tests can exercise the padded ring path).
+_RING_MIN_LEN = 128
+_RING_MIN_LEN_INTERPRET = 1
+
 # One warning per distinct shape when the Pallas path is unavailable and
 # dispatch falls back to the score-materializing jnp body.
 _FALLBACK_WARNED = set()
@@ -93,13 +99,41 @@ def _finalize_merge(u, m_run, z, dtype):
 
 def _ring_chunks(Tl, chunk, min_len=128):
     """Smallest split count s with Tl % s == 0 and min_len <= Tl//s <=
-    chunk, or None if no such split exists (then dispatch falls back)."""
+    chunk, or None if no such split exists (then dispatch pads or falls
+    back)."""
     if Tl <= chunk:
         return 1 if Tl >= min_len else None
     for s in range(-(-Tl // chunk), Tl + 1):
         if Tl % s == 0 and Tl // s <= chunk:
             return s if Tl // s >= min_len else None
     return None
+
+
+def _pad_plan(Tl, chunk, min_len):
+    """Smallest padded per-shard length with a valid chunk split.
+
+    For per-shard lengths with no exact divisor in [min_len, chunk] (odd /
+    prime ``Tl``, ADVICE item), abandoning the flash path costs an O(T^2)
+    score-materializing fallback; a few rows of padding keeps it. Returns
+    ``(Tl_padded, n_sub)`` minimizing the padding, or None when even
+    padding cannot produce a valid split.
+    """
+    best = None
+    s_lo = max(1, -(-Tl // chunk))
+    s_hi = max(s_lo, -(-Tl // max(min_len, 1)))
+    for s in range(s_lo, s_hi + 1):
+        need = -(-Tl // s)
+        if need > chunk:
+            continue
+        block = max(min_len, need)
+        if block > chunk:
+            continue
+        cand = s * block
+        if cand < Tl:
+            continue
+        if best is None or cand < best[0]:
+            best = (cand, s)
+    return best
 
 
 def cp_size():
@@ -689,11 +723,50 @@ def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
     else:
         flash_ring = flash_uly = flash_cfg and _pk.FORCE_INTERPRET
         if flash_ring:
-            n_sub = _ring_chunks(T // n, _RING_CHUNK, min_len=1)
+            n_sub = _ring_chunks(
+                T // n, _RING_CHUNK, min_len=_RING_MIN_LEN_INTERPRET
+            )
             flash_ring = n_sub is not None
         if flash_uly:
             n_sub_uly = _ring_chunks(T, _RING_CHUNK, min_len=1)
             flash_uly = n_sub_uly is not None
+
+    # No exact chunk divisor (odd/prime per-shard lengths): PAD the
+    # sequence to the next chunkable multiple instead of dropping to the
+    # O(T^2) score-materializing body. Padded key columns are masked —
+    # by causality (their global ids exceed every real row) or by a
+    # NEG_INF key-padding bias — and padded query rows are sliced off the
+    # output. Dropout is the one exception: its counter hash strides by
+    # the total length, so padding would silently change the pattern —
+    # those shapes keep the warned fallback.
+    pad_rows = 0
+    if (impl == "ring" and flash_cfg and not flash_ring
+            and dropout_rate == 0.0 and hd <= 256
+            and (on_tpu or _pk.FORCE_INTERPRET)):
+        min_len = _RING_MIN_LEN if on_tpu else _RING_MIN_LEN_INTERPRET
+        # Only shards at least a kernel floor long: those pad by at most
+        # one chunk-granule (~1%). Sub-floor shards (Tl < min_len) would
+        # blow up many-fold — they keep the warned jnp fallback.
+        plan = (
+            _pad_plan(T // n, _RING_CHUNK, min_len)
+            if T // n >= min_len else None
+        )
+        if plan is not None and plan[0] > T // n:
+            Tl_pad, n_sub = plan
+            pad_rows = Tl_pad * n - T
+            flash_ring = True
+            if kpad is None and not causal:
+                kpad = jnp.zeros((q.shape[0], T), jnp.float32)
+            if kpad is not None:
+                kpad = jnp.pad(
+                    kpad, ((0, 0), (0, pad_rows)), constant_values=NEG_INF
+                )
+            q, k, v = (
+                jnp.pad(a, ((0, 0), (0, pad_rows), (0, 0), (0, 0)))
+                for a in (q, k, v)
+            )
+            T = T + pad_rows
+            zigzag = bool(causal) and (T // n) % 2 == 0 and n > 1
 
     if flash_cfg and on_tpu and (
         (impl == "ring" and not flash_ring)
@@ -742,7 +815,10 @@ def cp_attention(q, k, v, *, scale, causal, impl=None, kpad=None,
         body_fn, tuple(sorted(body_kw.items())), mesh, spec,
         kpad is not None, seed is not None,
     )
-    return jitted(*call_args)
+    out = jitted(*call_args)
+    if pad_rows:
+        out = out[:, :T - pad_rows]
+    return out
 
 
 @functools.lru_cache(maxsize=64)

@@ -21,6 +21,7 @@ import optax
 
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.module_manager import path_key
+from smdistributed_modelparallel_tpu.utils import health
 from smdistributed_modelparallel_tpu.utils.exceptions import (
     SMPValidationError,
     StepUsageError,
@@ -155,6 +156,13 @@ class DistributedOptimizer:
             ):
                 self.model.params = pending[1]
                 self._opt_state = pending[2]
+                if health.enabled():
+                    # Grad-norm / update-ratio gauges (before the grads
+                    # store is cleared). Under fused_step_donation the
+                    # pending tuple is self-referential (old params gone)
+                    # — the ratio is skipped there.
+                    old = pending[3] if pending[3] is not pending[1] else None
+                    health.record_update_stats(self.model, old, pending[1])
                 self.model._grads = None
                 self.model._grads_finite = None
                 return
@@ -176,6 +184,11 @@ class DistributedOptimizer:
                 self.model.params, self._opt_state, grads
             )
         self.model.params = new_params
+        if health.enabled():
+            # The pre-update params were donated into _update, so only the
+            # grad/param norms are recorded here; the update ratio comes
+            # from the fused path, which retains the old tree.
+            health.record_update_stats(self.model, None, new_params)
         self.model._grads = None
         self.model._grads_finite = None
         if scaler is not None:

@@ -531,6 +531,7 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     # are exactly num_mb per stage over num_mb + S - 1 ticks, so the
     # measured fraction coincides with the theoretical (pp-1)/(mb+pp-1);
     # recording both keeps the report honest when the executor changes.
+    from smdistributed_modelparallel_tpu.utils import health
     from smdistributed_modelparallel_tpu.utils.flight_recorder import (
         flight_recorder,
     )
@@ -571,11 +572,21 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     stage_keys = jax.random.split(rngs_key, S)
     stage_ids = jnp.arange(S)
 
+    # Health sentinel (SMP_HEALTH_CHECK != off while this trace runs):
+    # per-stage non-finite counts / finite abs-max of the stage-boundary
+    # activations, plus the first bad microbatch per stage, accumulate in
+    # the tick carry — one masked reduce per tick, no extra outputs until
+    # the collector fuses them into the step's health word.
+    hc = health.active()
+
     def tick(tick_carry, t):
         # Feed stage 0 with microbatch t (clamped; invalid ticks produce
         # garbage that is never collected — and whose aux loss is masked
         # out below).
-        buf, aux_acc = tick_carry
+        if hc is not None:
+            buf, aux_acc, (hbad, habs, hmb) = tick_carry
+        else:
+            buf, aux_acc = tick_carry
         mb_idx = jnp.minimum(t, num_mb - 1)
         feed = jax.tree_util.tree_map(
             lambda e, b: b.at[0].set(
@@ -617,11 +628,30 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
         nxt = jax.tree_util.tree_map(
             lambda o: jnp.roll(o, shift=1, axis=0), x_outs
         )
+        if hc is not None:
+            brow, arow = health.stage_row_stats(x_outs, S)
+            brow = jnp.where(valid, brow, 0.0)
+            arow = jnp.where(valid, arow, 0.0)
+            hmb_new = jnp.where(
+                (hmb < 0) & (brow > 0),
+                (t - stage_ids).astype(jnp.float32), hmb,
+            )
+            return (nxt, aux_acc,
+                    (hbad + brow, jnp.maximum(habs, arow), hmb_new)), tail
         return (nxt, aux_acc), tail
 
-    (_, aux_total), tails = jax.lax.scan(
-        tick, (buf0, jnp.zeros((), jnp.float32)), jnp.arange(n_ticks)
-    )
+    carry0 = (buf0, jnp.zeros((), jnp.float32))
+    if hc is not None:
+        carry0 = carry0 + ((
+            jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.float32),
+            jnp.full((S,), -1.0, jnp.float32),
+        ),)
+    carry_end, tails = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+    if hc is not None:
+        (_, aux_total, (hbad, habs, hmb)) = carry_end
+        hc.add_stage_stats("fill_drain", hbad, habs, hmb)
+    else:
+        (_, aux_total) = carry_end
     # tails[t] is microbatch t-(S-1); keep the last num_mb ticks.
     collected = jax.tree_util.tree_map(lambda x: x[S - 1:], tails)
 

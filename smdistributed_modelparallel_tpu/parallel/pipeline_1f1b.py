@@ -155,6 +155,7 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
 
     fwd_np, bwd_np = build_1f1b_schedule(S, M, W)
     n_ticks = fwd_np.shape[0]
+    from smdistributed_modelparallel_tpu.utils import health
     from smdistributed_modelparallel_tpu.utils.flight_recorder import (
         flight_recorder,
     )
@@ -408,9 +409,18 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
 
         return jax.tree_util.tree_map(upd, buf, val)
 
+    # Health sentinel (utils/health.py): per-stage boundary-activation
+    # stats accumulate in the tick carry; this scan runs in the step
+    # trace itself, so the totals feed the collector directly after it.
+    hc = health.active()
+
     def tick(carry, t):
-        (inbuf, stash, cotbuf, outbuf, dlay, drep, dembed, dsides,
-         losses, outs) = carry
+        if hc is not None:
+            (inbuf, stash, cotbuf, outbuf, dlay, drep, dembed, dsides,
+             losses, outs, (hbad, habs, hmb)) = carry
+        else:
+            (inbuf, stash, cotbuf, outbuf, dlay, drep, dembed, dsides,
+             losses, outs) = carry
 
         # ---------------- forward sub-step ----------------
         fm = fwd_sched[t]                       # [S]; -1 idle
@@ -430,6 +440,15 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
         )(staged_params, staged_xs, x_in, f_sides, stage_ids, fmc, active_rows)
         # Stash the consumed inputs for backward recompute.
         stash = set_ring(stash, f_slots, x_in, f_active)
+        if hc is not None:
+            brow, arow = health.stage_row_stats(outs_f, S)
+            brow = jnp.where(f_active, brow, 0.0)
+            arow = jnp.where(f_active, arow, 0.0)
+            hmb = jnp.where(
+                (hmb < 0) & (brow > 0), fmc.astype(jnp.float32), hmb
+            )
+            hbad = hbad + brow
+            habs = jnp.maximum(habs, arow)
         # Ship outputs forward one stage (collective-permute on pp): the
         # value produced by stage s lands in inbuf[s+1] at slot m % W1.
         shifted_vals = jax.tree_util.tree_map(
@@ -558,8 +577,11 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
         )
         outs = scatter_set_mb(outs, m_last, user_out, b_active[S - 1])
 
-        return (inbuf, stash, cotbuf, outbuf, dlay, drep, dembed, dsides,
-                losses, outs), None
+        new_carry = (inbuf, stash, cotbuf, outbuf, dlay, drep, dembed,
+                     dsides, losses, outs)
+        if hc is not None:
+            new_carry = new_carry + ((hbad, habs, hmb),)
+        return new_carry, None
 
     def _scatter_add_leaf(buf, m, val, active):
         cur = jax.lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
@@ -568,8 +590,18 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
 
     carry0 = (inbuf0, stash0, cotbuf0, outbuf0, dlay0, drep0, dembed0,
               dsides0, losses0, outs0)
+    if hc is not None:
+        carry0 = carry0 + ((
+            jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.float32),
+            jnp.full((S,), -1.0, jnp.float32),
+        ),)
     carry_end, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
-    (_, _, _, _, dlay, drep, dembed, dsides, losses, outs) = carry_end
+    if hc is not None:
+        (_, _, _, _, dlay, drep, dembed, dsides, losses, outs,
+         (hbad, habs, hmb)) = carry_end
+        hc.add_stage_stats("1f1b", hbad, habs, hmb)
+    else:
+        (_, _, _, _, dlay, drep, dembed, dsides, losses, outs) = carry_end
 
     # ---- embedding backward ------------------------------------------
 

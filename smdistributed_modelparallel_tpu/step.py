@@ -42,6 +42,7 @@ from smdistributed_modelparallel_tpu.backend.split import (
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.model import DistributedModel
 from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
+from smdistributed_modelparallel_tpu.utils import health
 from smdistributed_modelparallel_tpu.utils.exceptions import StepUsageError
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
@@ -253,7 +254,10 @@ class StepFunction:
 
         # state.generation pins the entry to the topology it was compiled
         # under: smp.reset()/re-init with a different cfg or mesh must not
-        # serve a stale program whose shapes/flags happen to collide.
+        # serve a stale program whose shapes/flags happen to collide. The
+        # health mode is part of the key: the sentinel reduces live inside
+        # the program, so flipping SMP_HEALTH_CHECK recompiles.
+        hmode = health.mode()
         key = (state.generation,
                treedef, tuple(scan_idx), tuple(bcast_idx),
                tuple((i, _static_key(v)) for i, v in sorted(static.items())),
@@ -262,7 +266,8 @@ class StepFunction:
                tuple((v.shape, str(v.dtype)) for v in bcast_vals),
                getattr(self, "_has_backward", True),
                fused, opt._serial if fused else None,
-               model.training if model is not None else None)
+               model.training if model is not None else None,
+               hmode)
         compiled = self._cache.get(key)
         cache_events = telemetry.counter(
             "smp_step_compile_cache_total",
@@ -348,10 +353,43 @@ class StepFunction:
                 model._params_at_step = model._params
                 model._pending_update = None
         in_params = model.params
-        grads, outputs, grads_finite, next_rng, fused_out = compiled(
-            in_params, opt_state, scan_vals, bcast_vals, rng, loss_scale
+        grads, outputs, grads_finite, next_rng, fused_out, health_word = (
+            compiled(in_params, opt_state, scan_vals, bcast_vals, rng,
+                     loss_scale)
         )
         state.step_rng = next_rng
+        schema = list(getattr(compiled, "health_schema", ()) or ())
+        if schema:
+            # Submit the still-on-device health word: the PREVIOUS step's
+            # word is decoded now (its step has finished — no sync on the
+            # step just dispatched). The bisector retains references to the
+            # exact dispatched inputs so a trip can re-run this step
+            # eagerly with per-module checkpoints.
+            bisect_fn = None
+            if model is not None and model._output_aval is not None:
+                reconstruct = self._make_reconstruct(
+                    model, treedef, scan_idx, bcast_idx, static
+                )
+
+                def mb_args(mb, _sv=tuple(scan_vals), _sm=tuple(scan_meta),
+                            _bv=tuple(bcast_vals), _rc=reconstruct):
+                    leaves = [
+                        stack_leaf(v, *m)[mb] for v, m in zip(_sv, _sm)
+                    ]
+                    return _rc(leaves, list(_bv))
+
+                # in_params: the exact tree this step consumed. Retaining
+                # it for one step keeps bisection honest when an optimizer
+                # update lands before the word is decoded (it is dropped
+                # with the pending entry; donated trees are detected and
+                # fall back to the live params).
+                bisect_fn = health.make_bisector(
+                    model, self.fn, mb_args, num_mb, rng, has_backward,
+                    step_params=in_params,
+                )
+            health.monitor.submit(
+                state.step_count, health_word, schema, hmode, bisect_fn
+            )
         if model is not None and has_backward:
             model._grads_finite = grads_finite
             if grads is not None:
@@ -436,6 +474,7 @@ class StepFunction:
         use_scaler = cfg.fp16
 
         def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
+            hc = health.active()
             keys = jax.random.split(rng, num_mb)
             # Half-cast hoisted out of the microbatch scan: the cast is
             # loop-invariant, and differentiating w.r.t. the half params is
@@ -453,16 +492,27 @@ class StepFunction:
 
                 def body(acc, xs):
                     mb_leaves, key = xs
-                    (_, out), grads = grad_fn(run_params, mb_leaves, bcast_leaves, key)
+                    (loss_v, out), grads = grad_fn(
+                        run_params, mb_leaves, bcast_leaves, key
+                    )
                     acc = jax.tree_util.tree_map(
                         lambda a, g: a + g.astype(a.dtype), acc, grads
                     )
-                    return acc, out
+                    # Health sentinel: the per-microbatch loss rides out of
+                    # the scan so the word records the FIRST bad microbatch.
+                    ys = (out, loss_v) if hc is not None else out
+                    return acc, ys
 
                 acc0 = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, _acc_dtype(p.dtype, cfg)), params
                 )
-                grads, outs = jax.lax.scan(body, acc0, (scan_leaves, keys))
+                grads, ys = jax.lax.scan(body, acc0, (scan_leaves, keys))
+                if hc is not None:
+                    outs, losses = ys
+                    hc.add_stacked("loss", losses / loss_scale)
+                    hc.add_stacked("outputs", outs)
+                else:
+                    outs = ys
                 if fused_update is not None:
                     # Fused mode: return the RAW accumulator (aliases the
                     # scan carry, no extra materialization); the averaging
@@ -486,6 +536,8 @@ class StepFunction:
                 return carry, out
 
             _, outs = jax.lax.scan(body, 0, (scan_leaves, keys))
+            if hc is not None:
+                hc.add_stacked("outputs", outs)
             return None, outs, None
 
         return _make_runner(step_impl, "step", scan_meta, fused_update, model,
@@ -573,6 +625,13 @@ class StepFunction:
                     model, params, stacked_inputs, rng, mb_loss_fn,
                     loss_scale / num_mb,
                 )
+                hc = health.active()
+                if hc is not None:
+                    # Stage-boundary entries were contributed inside
+                    # pipeline_1f1b (its tick scan is in THIS trace); the
+                    # per-microbatch losses/outputs are unscaled here.
+                    hc.add_stacked("loss", losses)
+                    hc.add_stacked("outputs", outs)
                 grads = jax.tree_util.tree_map(
                     lambda g, p: (g / loss_scale).astype(p.dtype), grads, params
                 )
@@ -586,8 +645,15 @@ class StepFunction:
         def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
             keys = jax.random.split(rng, num_mb)
             stacked_inputs = capture_inputs(scan_leaves, bcast_leaves, keys)
+            # Health entries added INSIDE forward_all belong to the
+            # value_and_grad inner trace; they leave through the aux output
+            # (names are static Python and escape via this box) and are
+            # restored into the step-trace collector afterwards.
+            health_names = []
 
             def forward_all(p):
+                hc = health.active()
+                hmark = hc.mark() if hc is not None else 0
                 run_p = half_cast_util(p, half)
                 outs, pipe_aux = pipeline_forward(model, run_p, stacked_inputs, rng)
 
@@ -615,20 +681,40 @@ class StepFunction:
                 _, (losses, user_outs) = jax.lax.scan(
                     post_body, 0, (scan_leaves, outs, keys)
                 )
+                if hc is not None:
+                    hc.add_stacked("loss", losses)
+                    hc.add_stacked("outputs", user_outs)
                 # MoE aux loss from the layer stack (0.0 for dense models);
                 # mean-over-microbatch semantics matching the task loss.
                 aux_w = float(getattr(cfg, "moe_aux_loss_weight", 1.0))
                 total = jnp.mean(losses) + aux_w * pipe_aux / num_mb
-                return total * loss_scale, user_outs
+                hvals = ()
+                if hc is not None:
+                    drained = hc.drain(hmark)
+                    health_names[:] = [n for n, _, _, _ in drained]
+                    hvals = tuple((b, a, m) for _, b, a, m in drained)
+                return total * loss_scale, (user_outs, hvals)
+
+            def restore_health(hvals):
+                hc = health.active()
+                if hc is not None:
+                    hc.restore([
+                        (n,) + tuple(v)
+                        for n, v in zip(health_names, hvals)
+                    ])
 
             if has_backward:
-                (_, outs), grads = jax.value_and_grad(forward_all, has_aux=True)(params)
+                (_, (outs, hvals)), grads = jax.value_and_grad(
+                    forward_all, has_aux=True
+                )(params)
+                restore_health(hvals)
                 grads = jax.tree_util.tree_map(
                     lambda g, p: (g / loss_scale).astype(p.dtype), grads, params
                 )
                 finite = _grads_finite(grads) if use_scaler else None
                 return grads, outs, finite
-            _, outs = forward_all(params)
+            _, (outs, hvals) = forward_all(params)
+            restore_health(hvals)
             return None, outs, None
 
         return _make_runner(step_impl, "step_pipeline", scan_meta, fused_update, model)
@@ -664,38 +750,58 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
         and bool(getattr(state.cfg, "fused_step_donation", False))
     )
 
+    # Health sentinel: the collector is live for exactly the span of each
+    # step-program trace; the tags it gathers fuse into one [K, 3] "health
+    # word" output. With SMP_HEALTH_CHECK=off the context yields None and
+    # the program is byte-identical to a build without the sentinel.
+    hmode = health.mode()
+    schema_box = []
+
     def full_impl(params, opt_state, raw_scan, bcast_vals, rng, loss_scale):
-        use_rng, next_rng = jax.random.split(rng)
-        scan_leaves = [
-            stack_leaf(v, *m) for v, m in zip(raw_scan, scan_meta)
-        ]
-        grads, outs, finite = step_impl(
-            params, scan_leaves, bcast_vals, use_rng, loss_scale
-        )
-        if fused_update is not None:
-            upd_grads = grads
-            if raw_divisor is not None:
-                # Average the raw accumulator on the way into the update —
-                # this divide fuses into the optimizer's elementwise kernels
-                # instead of materializing an averaged-grads output.
-                upd_grads = jax.tree_util.tree_map(
-                    lambda g, p: (g / raw_divisor).astype(p.dtype),
-                    grads, params,
-                )
-            new_params, new_opt = fused_update(params, opt_state, upd_grads)
-            if param_pin is not None:
-                new_params = jax.lax.with_sharding_constraint(new_params, param_pin)
-            if opt_pin is not None:
-                new_opt = jax.tree_util.tree_map(
-                    lambda l, s: jax.lax.with_sharding_constraint(l, s)
-                    if s is not None else l,
-                    new_opt, opt_pin,
-                    is_leaf=lambda x: x is None,
-                )
-            fused_out = (new_params, new_opt)
-        else:
-            fused_out = ()
-        return grads, outs, finite, next_rng, fused_out
+        with health.collecting(hmode) as hc:
+            if hc is not None and hc.mode == "full":
+                hc.add_tree("params", params)
+            use_rng, next_rng = jax.random.split(rng)
+            scan_leaves = [
+                stack_leaf(v, *m) for v, m in zip(raw_scan, scan_meta)
+            ]
+            grads, outs, finite = step_impl(
+                params, scan_leaves, bcast_vals, use_rng, loss_scale
+            )
+            if fused_update is not None:
+                upd_grads = grads
+                if raw_divisor is not None:
+                    # Average the raw accumulator on the way into the update —
+                    # this divide fuses into the optimizer's elementwise kernels
+                    # instead of materializing an averaged-grads output.
+                    upd_grads = jax.tree_util.tree_map(
+                        lambda g, p: (g / raw_divisor).astype(p.dtype),
+                        grads, params,
+                    )
+                new_params, new_opt = fused_update(params, opt_state, upd_grads)
+                if param_pin is not None:
+                    new_params = jax.lax.with_sharding_constraint(new_params, param_pin)
+                if opt_pin is not None:
+                    new_opt = jax.tree_util.tree_map(
+                        lambda l, s: jax.lax.with_sharding_constraint(l, s)
+                        if s is not None else l,
+                        new_opt, opt_pin,
+                        is_leaf=lambda x: x is None,
+                    )
+                fused_out = (new_params, new_opt)
+            else:
+                upd_grads = grads
+                fused_out = ()
+            if hc is not None and upd_grads is not None:
+                # Global (averaged) grads: one entry for the whole tree.
+                hc.add_tree("grads", upd_grads)
+            word = ()
+            if hc is not None:
+                packed, names = hc.pack()
+                if packed is not None:
+                    word = packed
+                    schema_box[:] = names
+        return grads, outs, finite, next_rng, fused_out, word
 
     # fused_step_donation: params/opt_state buffers alias into
     # new_params/new_opt (same shapes + pinned shardings), dropping the
@@ -719,6 +825,10 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                         name, compiled
                     )
                 except Exception as e:  # pragma: no cover - backend-specific
+                    # A compile-time RESOURCE_EXHAUSTED gets its post-mortem
+                    # here; the jit fallback below will hit it again and
+                    # raise through the guarded call path.
+                    health.maybe_oom_postmortem(name, None, e)
                     logger.debug("AOT compile report unavailable: %s", e)
                 t_compile = time.perf_counter() - t_compile
                 telemetry.histogram(
@@ -741,12 +851,24 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                         "falling back to jit dispatch.", e,
                     )
                     holder["compiled"] = None
-            return jitted(params, opt_state, scan_vals, bcast_vals, rng, loss_scale)
+                except Exception as e:
+                    # RESOURCE_EXHAUSTED: dump the executable's XLA memory
+                    # breakdown + live buffers + remat/offload config before
+                    # the error reaches the user (utils/health.py).
+                    health.maybe_oom_postmortem(name, c, e)
+                    raise
+            try:
+                return jitted(params, opt_state, scan_vals, bcast_vals, rng,
+                              loss_scale)
+            except Exception as e:
+                health.maybe_oom_postmortem(name, holder.get("compiled"), e)
+                raise
 
     run.jitted = jitted
     run.mesh = mesh
     run.holder = holder
     run.raw_divisor = raw_divisor if fused_update is not None else None
+    run.health_schema = schema_box
     return run
 
 

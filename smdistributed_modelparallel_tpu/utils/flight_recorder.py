@@ -65,6 +65,7 @@ PHASE = "phase"
 STEP = "step"
 COMPILE = "compile"
 WATCHDOG = "watchdog"
+HEALTH = "health"
 
 # Field names per kind, applied at dump time (the ring stores bare
 # tuples). Keeping the schema here — not at the record sites — is what
@@ -78,6 +79,7 @@ _FIELDS = {
     STEP: ("event", "step"),
     COMPILE: ("event", "name", "elapsed_us"),
     WATCHDOG: ("reason",),
+    HEALTH: ("event", "tag", "step", "value", "microbatch"),
 }
 
 
@@ -196,6 +198,14 @@ class FlightRecorder:
 
     def record_watchdog(self, reason):
         self.record(WATCHDOG, reason)
+
+    def record_health(self, event, tag, step=-1, value=0.0, microbatch=-1):
+        """Training-health events (utils/health.py): sentinel trips, fault
+        attributions, loss-scale overflow/growth, OOM post-mortems."""
+        if not self.enabled:
+            return
+        self.record(HEALTH, event, str(tag), int(step), float(value),
+                    int(microbatch))
 
     # -- export ---------------------------------------------------------
 

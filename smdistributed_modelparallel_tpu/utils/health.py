@@ -1,0 +1,807 @@
+"""Training-health monitor: in-graph numerics sentinel, fault bisection,
+and OOM post-mortem.
+
+The reference treats numerics health as first-class: its fp16 loss scaler
+allgathers an overflow flag across pp+tp every step
+(``torch/fp16/loss_scaler.py``) and its metrics upload includes memory
+accounting (§5.5). Under GSPMD the step is ONE compiled program, so health
+checks must live *inside that program* — a host-side assert would force a
+device sync per step and see only what the host already fetched. This
+module is that in-graph half plus the host machinery around it:
+
+- **Sentinel** (``SMP_HEALTH_CHECK=off|cheap|full``, default ``off``):
+  while the step program is being traced, tagged tensors (loss, outputs,
+  globally-averaged grads, per-pipeline-stage boundary activations, and —
+  under ``full`` — the parameters) each contribute one fused
+  finiteness-count / finite-abs-max reduce into a single small ``[K, 3]``
+  f32 "health word" output of the compiled step. ``off`` compiles to
+  NOTHING (``tag`` is identity, the collector is inactive — the step HLO
+  is byte-identical; ``tests/test_health.py`` asserts it).
+- **Asynchronous fetch**: the health word of step N is *submitted* to the
+  monitor without reading it; it is decoded when step N+1 is submitted —
+  by then the device has finished step N, so the host never blocks on the
+  step it just dispatched. ``full`` mode decodes synchronously every step
+  (a debug mode, one tiny device->host readback per step).
+- **Bisection**: when a sentinel trips, the monitor re-runs the faulting
+  step on the retained step inputs OUTSIDE the compiled program —
+  layer-by-layer through the model's ``PipelineSpec`` (so the first
+  non-finite value is attributed to ``<layer_path>#<i>`` + microbatch +
+  rank), or via flax ``capture_intermediates`` for non-pipelined modules,
+  falling back to a per-microbatch grad re-run for backward-only faults.
+  The attribution lands in telemetry (``smp_health_fault_total``), the
+  flight-recorder ring, and a JSON dump at ``SMP_HEALTH_PATH``.
+- **OOM post-mortem**: the step engine routes RESOURCE_EXHAUSTED failures
+  through :func:`oom_postmortem`, which dumps the executable's XLA
+  memory-analysis breakdown (argument/temp/output/alias bytes), a live-
+  buffer summary grouped by shape, per-device allocator stats, and the
+  active remat/offload configuration next to the flight-recorder ring.
+
+Import-hygiene contract: importing this module must never initialize an
+accelerator backend (jax/jnp imports are fine; no device arrays at
+import).
+"""
+
+import json
+import math
+import os
+import time
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+
+from smdistributed_modelparallel_tpu.utils import flight_recorder as _fr
+from smdistributed_modelparallel_tpu.utils import telemetry as _tel
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import (
+    _atomic_json_dump,
+    telemetry,
+)
+
+logger = get_logger()
+
+HEALTH_CHECK_ENV = "SMP_HEALTH_CHECK"
+HEALTH_PATH_ENV = "SMP_HEALTH_PATH"
+DEFAULT_HEALTH_PATH = "smp_health_dump.json"
+
+_MODES = ("off", "cheap", "full")
+_warned_mode = set()
+
+# Cheap mode samples the optimizer-update norm gauges every Nth
+# optimizer.step (the float readback is a host sync on the update's
+# completion); full mode records every step. The first step always
+# records so short runs/tests see the gauges.
+UPDATE_STATS_EVERY = 16
+_update_stats_calls = [0]
+
+
+def mode():
+    """Configured sentinel mode, read from the environment at call time
+    (the step cache keys on it, so flipping the env recompiles)."""
+    raw = os.environ.get(HEALTH_CHECK_ENV, "").strip().lower()
+    if raw in ("", "0", "false", "off", "no", "none"):
+        return "off"
+    if raw in ("1", "on", "true", "cheap"):
+        return "cheap"
+    if raw == "full":
+        return "full"
+    if raw not in _warned_mode:
+        _warned_mode.add(raw)
+        logger.warning(
+            "invalid %s=%r (want off|cheap|full); health checks disabled.",
+            HEALTH_CHECK_ENV, raw,
+        )
+    return "off"
+
+
+def enabled():
+    return mode() != "off"
+
+
+def _health_path():
+    path = os.environ.get(HEALTH_PATH_ENV) or DEFAULT_HEALTH_PATH
+    return telemetry._rank_path(path)
+
+
+# ----------------------------------------------------------------------
+# In-graph collector (active only while a step program is being traced)
+# ----------------------------------------------------------------------
+
+
+def _inexact_leaves(tree):
+    return [
+        l for l in jax.tree_util.tree_leaves(tree)
+        if hasattr(l, "dtype") and jnp.issubdtype(jnp.result_type(l), jnp.inexact)
+    ]
+
+
+class HealthCollector:
+    """Accumulates (name, bad_count, finite_abs_max, first_bad_microbatch)
+    entries during one step-program trace; ``pack()`` fuses them into the
+    ``[K, 3]`` health-word output. Entries hold tracers — a collector
+    never outlives the trace that filled it."""
+
+    def __init__(self, mode):
+        self.mode = mode
+        self.entries = []  # [(name, bad, absmax, microbatch)]
+
+    def add(self, name, bad, absmax, microbatch=None):
+        mb = -1.0 if microbatch is None else microbatch
+        self.entries.append((str(name), bad, absmax, mb))
+
+    def add_tree(self, name, tree):
+        """One entry for a whole pytree (no microbatch axis)."""
+        leaves = _inexact_leaves(tree)
+        if not leaves:
+            return
+        bad = jnp.zeros((), jnp.float32)
+        mx = jnp.zeros((), jnp.float32)
+        for l in leaves:
+            lf = l.astype(jnp.float32)
+            fin = jnp.isfinite(lf)
+            bad = bad + jnp.sum(~fin).astype(jnp.float32)
+            mx = jnp.maximum(mx, jnp.max(jnp.where(fin, jnp.abs(lf), 0.0)))
+        self.add(name, bad, mx)
+
+    def add_stacked(self, name, tree, num_mb=None):
+        """One entry for a pytree whose leaves lead with a microbatch axis;
+        also records the first microbatch index with a non-finite value."""
+        leaves = _inexact_leaves(tree)
+        if not leaves:
+            return
+        n = int(leaves[0].shape[0]) if num_mb is None else int(num_mb)
+        per = jnp.zeros((n,), jnp.float32)
+        mx = jnp.zeros((), jnp.float32)
+        for l in leaves:
+            lf = l.astype(jnp.float32).reshape((n, -1))
+            fin = jnp.isfinite(lf)
+            per = per + jnp.sum(~fin, axis=1).astype(jnp.float32)
+            mx = jnp.maximum(mx, jnp.max(jnp.where(fin, jnp.abs(lf), 0.0)))
+        bad = jnp.sum(per)
+        first = jnp.where(bad > 0, jnp.argmax(per > 0).astype(jnp.float32), -1.0)
+        self.add(name, bad, mx, first)
+
+    def add_stage_stats(self, schedule, bad, absmax, first_mb):
+        """Per-pipeline-stage entries from an executor's accumulated
+        boundary-activation stats ([S] vectors; static S)."""
+        num_stages = int(bad.shape[0])
+        for s in range(num_stages):
+            self.add(f"pp/{schedule}/stage{s}", bad[s], absmax[s], first_mb[s])
+
+    # Entries added inside an inner trace (e.g. under the fill-drain
+    # executor's value_and_grad) must travel OUT through that transform's
+    # aux outputs, not through this Python list — mark/drain inside the
+    # differentiated closure, restore from the aux values outside.
+
+    def mark(self):
+        return len(self.entries)
+
+    def drain(self, mark):
+        drained = self.entries[mark:]
+        del self.entries[mark:]
+        return drained
+
+    def restore(self, entries):
+        self.entries.extend(entries)
+
+    def pack(self):
+        """(word [K, 3] f32, [name, ...]) or (None, None) when empty."""
+        if not self.entries:
+            return None, None
+        rows = [
+            jnp.stack([
+                jnp.asarray(b, jnp.float32),
+                jnp.asarray(a, jnp.float32),
+                jnp.asarray(m, jnp.float32),
+            ])
+            for (_, b, a, m) in self.entries
+        ]
+        return jnp.stack(rows), [n for (n, _, _, _) in self.entries]
+
+
+_collector = None
+
+
+def active():
+    """The collector of the step trace in progress, or None (mode off /
+    not inside a step trace). Checked at TRACE time — the off path costs
+    one module-attribute read and compiles to nothing."""
+    return _collector
+
+
+@contextmanager
+def collecting(health_mode):
+    """Activate a fresh collector for one step-program trace."""
+    global _collector
+    prev = _collector
+    _collector = HealthCollector(health_mode) if health_mode != "off" else None
+    try:
+        yield _collector
+    finally:
+        _collector = prev
+
+
+def tag(name, x):
+    """Tag a tensor for the sentinel inside an ``@smp.step`` function:
+    ``loss = smp.health.tag("loss", loss)``. Identity always — with the
+    sentinel off (or outside a step trace) it compiles to nothing."""
+    hc = _collector
+    if hc is not None:
+        hc.add_tree(name, x)
+    return x
+
+
+def stage_row_stats(tree, num_stages):
+    """([S] non-finite counts, [S] finite abs-max) over a pytree whose
+    leaves lead with the stage axis — the executors' per-tick reduce."""
+    bad = jnp.zeros((num_stages,), jnp.float32)
+    mx = jnp.zeros((num_stages,), jnp.float32)
+    for l in _inexact_leaves(tree):
+        lf = l.astype(jnp.float32).reshape((num_stages, -1))
+        fin = jnp.isfinite(lf)
+        bad = bad + jnp.sum(~fin, axis=1).astype(jnp.float32)
+        mx = jnp.maximum(mx, jnp.max(jnp.where(fin, jnp.abs(lf), 0.0), axis=1))
+    return bad, mx
+
+
+# ----------------------------------------------------------------------
+# Host-side monitor (async fetch + trip handling)
+# ----------------------------------------------------------------------
+
+
+class HealthMonitor:
+    """Holds the pending (still-on-device) health word and decodes the
+    previous step's word on each submit — the device->host copy of step N
+    overlaps step N+1's execution, so cheap mode adds no per-step sync."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._pending = None
+        self.last_check = None       # {"step", "tags": {name: {...}}}
+        self.checked_steps = []      # decode order (test hook)
+        self.trips = []              # bounded trip records
+        self.max_bisections = 4
+        self._bisections = 0
+
+    @property
+    def pending_step(self):
+        return self._pending["step"] if self._pending else None
+
+    def submit(self, step, word, schema, health_mode, bisect_fn=None):
+        prev, self._pending = self._pending, {
+            "step": step, "word": word, "schema": list(schema),
+            "bisect": bisect_fn,
+        }
+        if prev is not None:
+            self._check(prev)
+        if health_mode == "full":
+            self.flush()
+
+    def flush(self):
+        """Decode the pending word now (blocks until its step finishes).
+        Called at smp.shutdown so the final step is never left unchecked."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            self._check(pending)
+
+    def _check(self, pending):
+        import numpy as np
+
+        try:
+            w = np.asarray(jax.device_get(pending["word"]), dtype=np.float64)
+        except Exception as e:  # the step itself failed; nothing to decode
+            logger.debug("health word fetch failed: %r", e)
+            return
+        step = pending["step"]
+        self.checked_steps.append(step)
+        tags = {}
+        for i, name in enumerate(pending["schema"]):
+            tags[name] = {
+                "bad": float(w[i, 0]),
+                "absmax": float(w[i, 1]),
+                "microbatch": int(w[i, 2]),
+            }
+        self.last_check = {"step": step, "tags": tags}
+        _tel.record_health_check(step, tags)
+        bad_tags = {
+            n: d for n, d in tags.items()
+            if d["bad"] > 0 or not math.isfinite(d["absmax"])
+        }
+        if bad_tags:
+            self._trip(pending, bad_tags)
+
+    def _trip(self, pending, bad_tags):
+        step = pending["step"]
+        for name, d in bad_tags.items():
+            _tel.record_health_trip(
+                name, step, d["bad"], d["absmax"], d["microbatch"]
+            )
+        logger.error(
+            "HEALTH SENTINEL TRIPPED at step %d: non-finite values in %s",
+            step, sorted(bad_tags),
+        )
+        attribution = None
+        bisect_fn = pending.get("bisect")
+        if bisect_fn is not None and self._bisections < self.max_bisections:
+            self._bisections += 1
+            logger.warning(
+                "health: bisecting step %d (re-running with per-module "
+                "checkpoints) ...", step,
+            )
+            try:
+                attribution = bisect_fn(bad_tags)
+            except Exception as e:  # diagnostics must not kill training
+                logger.error("health bisection failed: %r", e)
+                attribution = {"error": repr(e)}
+        if attribution and attribution.get("layer"):
+            _tel.record_health_fault(
+                attribution["layer"], attribution.get("microbatch", -1),
+                ",".join(sorted(bad_tags)), step,
+            )
+            logger.error(
+                "health: first non-finite value attributed to layer=%s "
+                "microbatch=%s rank=%s",
+                attribution["layer"], attribution.get("microbatch"),
+                attribution.get("rank"),
+            )
+        self.trips.append({
+            "kind": "health_trip",
+            "step": step,
+            "time": time.time(),
+            "rank": telemetry.process_index or 0,
+            "tags": bad_tags,
+            "attribution": attribution,
+        })
+        del self.trips[:-16]
+        self.dump()
+
+    def report(self):
+        return {
+            "mode": mode(),
+            "pending_step": self.pending_step,
+            "checked_steps": list(self.checked_steps[-64:]),
+            "last_check": self.last_check,
+            "trips": list(self.trips),
+        }
+
+    def dump(self, path=None):
+        """Write the monitor report (trips + last word) as JSON, atomically,
+        rank-qualified — same conventions as the telemetry dump."""
+        path = path or _health_path()
+        payload = {"kind": "health", **self.report()}
+        return _atomic_json_dump(payload, path, "health dump")
+
+
+monitor = HealthMonitor()
+
+
+def reset():
+    """Testing hook (smp.reset): drop pending words and trip history."""
+    monitor.reset()
+    _update_stats_calls[0] = 0
+
+
+def report():
+    """``smp.health.report()`` — monitor state as a plain dict."""
+    return monitor.report()
+
+
+# ----------------------------------------------------------------------
+# Bisection: attribute the first non-finite value to layer + microbatch
+# ----------------------------------------------------------------------
+
+
+def _first_bad_path(tree, prefix=""):
+    """'/'-joined path of the first leaf holding a non-finite value,
+    walking mappings in INSERTION order (module execution order for flax
+    intermediates), or None. Non-array leaves are skipped."""
+    if hasattr(tree, "items"):
+        for k, v in tree.items():
+            got = _first_bad_path(v, f"{prefix}/{k}" if prefix else str(k))
+            if got is not None:
+                return got
+        return None
+    if isinstance(tree, (tuple, list)):
+        for i, v in enumerate(tree):
+            got = _first_bad_path(v, f"{prefix}/{i}" if prefix else str(i))
+            if got is not None:
+                return got
+        return None
+    if not (hasattr(tree, "dtype")
+            and jnp.issubdtype(jnp.result_type(tree), jnp.inexact)):
+        return None
+    if bool(jnp.any(~jnp.isfinite(tree))):
+        return prefix or "<root>"
+    return None
+
+
+def _bisect_rngs(model, key):
+    return {
+        s: jax.random.fold_in(key, i)
+        for i, s in enumerate(model.rng_streams)
+    }
+
+
+def _tree_deleted(tree):
+    """True if any leaf's device buffer has been donated/deleted."""
+    for l in jax.tree_util.tree_leaves(tree):
+        try:
+            if isinstance(l, jax.Array) and l.is_deleted():
+                return True
+        except Exception:
+            return True
+    return False
+
+
+def _apply_layer(spec, lp, carry, layer_idx, rngs):
+    xs = None
+    if spec.layer_xs is not None:
+        xs = jax.tree_util.tree_map(
+            lambda v: jnp.asarray(v)[layer_idx], spec.layer_xs
+        )
+    if spec.carry_is_tuple:
+        x, cross, amask = carry
+        out = spec.layer_module.apply(
+            {"params": lp}, x, cross_states=cross, attention_mask=amask,
+            xs=xs, rngs=rngs,
+        )
+        return (out, cross, amask)
+    if xs is not None:
+        return spec.layer_module.apply({"params": lp}, carry, xs=xs, rngs=rngs)
+    return spec.layer_module.apply({"params": lp}, carry, rngs=rngs)
+
+
+def _captured_model_inputs(model, fn, args, kwargs):
+    """Re-run the user step fn with the model call intercepted to recover
+    the exact (args, kwargs) of its single ``model(...)`` call."""
+    if model._output_aval is None:
+        return None
+    model._begin_capture(model._output_aval)
+    try:
+        fn(*args, **kwargs)
+    finally:
+        model._end_step_trace()
+    captured = model._last_captured
+    if len(captured) != 1:
+        return None
+    return captured[0]
+
+
+def _bisect_forward(model, fn, params, args, kwargs, key):
+    """Eager layer-by-layer re-run of one microbatch's forward; returns
+    {"layer": <name>} for the first module producing a non-finite value,
+    or None if the forward is clean."""
+    captured = _captured_model_inputs(model, fn, args, kwargs)
+    if captured is None:
+        return None
+    cargs, ckwargs = captured
+    rngs = _bisect_rngs(model, key)
+    from smdistributed_modelparallel_tpu.nn.auto_distribute import unwrap_hooks
+
+    module = unwrap_hooks(model.module)
+    spec = model._pipeline_spec
+    if spec is not None:
+        from smdistributed_modelparallel_tpu.parallel.pipeline import _get_subtree
+
+        if spec.embed_method is not None:
+            carry = module.apply(
+                {"params": params}, *cargs, method=spec.embed_method,
+                rngs=rngs, **ckwargs,
+            )
+        else:
+            carry = cargs[0]
+        if _first_bad_path(carry) is not None:
+            return {"layer": "embed"}
+        layer_params = _get_subtree(params, spec.layer_path)
+        for l in range(spec.num_layers):
+            lp = jax.tree_util.tree_map(lambda x, _l=l: x[_l], layer_params)
+            carry = _apply_layer(spec, lp, carry, l, rngs)
+            if _first_bad_path(carry) is not None:
+                return {"layer": f"{spec.layer_path}#{l}"}
+        hidden = carry[0] if spec.carry_is_tuple else carry
+        if spec.head_method is not None:
+            out = module.apply(
+                {"params": params}, hidden, method=spec.head_method, rngs=rngs
+            )
+            if _first_bad_path(out) is not None:
+                return {"layer": "head"}
+        return None
+    out, mut = module.apply(
+        {"params": params}, *cargs, rngs=rngs,
+        capture_intermediates=True, mutable=["intermediates"], **ckwargs,
+    )
+    bad = _first_bad_path(mut.get("intermediates", {}))
+    if bad is not None:
+        return {"layer": bad}
+    if _first_bad_path(out) is not None:
+        return {"layer": "output"}
+    return None
+
+
+def _bisect_grads(model, fn, params, args, kwargs, key):
+    """Per-microbatch gradient re-run for backward-only faults: the first
+    parameter path whose gradient is non-finite."""
+    rngs = _bisect_rngs(model, key)
+
+    def loss_fn(p):
+        model._begin_step_trace(p, rngs)
+        try:
+            fn(*args, **kwargs)
+        finally:
+            loss = model._end_step_trace()
+        if loss is None:
+            return jnp.zeros(())
+        return jnp.asarray(loss, jnp.float32)
+
+    try:
+        grads = jax.grad(loss_fn)(params)
+    except Exception as e:
+        logger.debug("health grad bisection failed: %r", e)
+        return None
+    bad = _first_bad_path(grads)
+    if bad is not None:
+        return {"layer": "grad:" + bad}
+    return None
+
+
+def bisect_step(model, fn, mb_args_fn, num_mb, rng, has_backward, bad_tags,
+                step_params=None):
+    """Attribute a tripped step: first non-finite value -> (layer name,
+    microbatch, rank). ``mb_args_fn(mb)`` rebuilds one microbatch's user-fn
+    arguments from the retained step inputs; ``step_params`` is the exact
+    parameter tree the faulting step was dispatched with — without it the
+    re-run would use post-update params, and a grad-induced NaN that
+    poisoned the whole tree would mis-attribute to the first layer."""
+    from smdistributed_modelparallel_tpu.backend.state import state
+
+    rank = telemetry.process_index or 0
+    params = step_params
+    params_source = "dispatch"
+    if params is None or _tree_deleted(params):
+        # Donated buffers (fused_step_donation / the standalone update)
+        # cannot be read back; fall back to the live tree and say so.
+        params = model.params
+        params_source = "current"
+    result = {"rank": rank, "microbatch": -1, "layer": None,
+              "params_source": params_source}
+    bad_param = _first_bad_path(params)
+    if bad_param is not None:
+        result["param"] = bad_param
+    grads_suspect = any(t == "grads" or t.startswith("grad") for t in bad_tags)
+    # The compiled step derives keys as split(rng) -> use_rng, then
+    # split(use_rng, num_mb) per microbatch (step.py full_impl/step_impl);
+    # reproduce that exactly so RNG-dependent faults (dropout) re-trigger.
+    use_rng = jax.random.split(rng)[0]
+    mb_keys = jax.random.split(use_rng, num_mb)
+    with jax.set_mesh(state.mesh):
+        for mb in range(num_mb):
+            args, kwargs = mb_args_fn(mb)
+            bad_input = _first_bad_path((args, kwargs))
+            if bad_input is not None:
+                return {**result, "layer": "input:" + bad_input,
+                        "microbatch": mb}
+            key = mb_keys[mb]
+            att = _bisect_forward(model, fn, params, args, kwargs, key)
+            if att is None and has_backward and grads_suspect:
+                att = _bisect_grads(model, fn, params, args, kwargs, key)
+            if att is not None:
+                return {**result, **att, "microbatch": mb}
+    if bad_param is not None:
+        # Nothing re-triggered (e.g. a poisoned but unused parameter):
+        # the parameter itself is still the attribution.
+        result["layer"] = "param:" + bad_param
+    else:
+        result["note"] = "re-run found no non-finite value (transient?)"
+    return result
+
+
+def make_bisector(model, fn, mb_args_fn, num_mb, rng, has_backward,
+                  step_params=None):
+    def bisect(bad_tags):
+        return bisect_step(
+            model, fn, mb_args_fn, num_mb, rng, has_backward, bad_tags,
+            step_params=step_params,
+        )
+
+    return bisect
+
+
+# ----------------------------------------------------------------------
+# Gradient / update-ratio gauges (optimizer.step wiring)
+# ----------------------------------------------------------------------
+
+
+@jax.jit
+def _sq_sum(tree):
+    total = jnp.zeros((), jnp.float32)
+    for l in _inexact_leaves(tree):
+        total = total + jnp.sum(jnp.square(l.astype(jnp.float32)))
+    return total
+
+
+@jax.jit
+def _diff_sq_sum(new, old):
+    total = jnp.zeros((), jnp.float32)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(new), jax.tree_util.tree_leaves(old)
+    ):
+        if jnp.issubdtype(jnp.result_type(a), jnp.inexact):
+            total = total + jnp.sum(
+                jnp.square(a.astype(jnp.float32) - b.astype(jnp.float32))
+            )
+    return total
+
+
+def record_update_stats(model, old_params, new_params):
+    """Grad-norm / param-norm / update-ratio gauges around one optimizer
+    step. Rate-limited in cheap mode (the float readback syncs on the
+    update's completion); ``old_params=None`` (donated buffers) skips the
+    update-ratio. Never raises."""
+    n = _update_stats_calls[0]
+    _update_stats_calls[0] = n + 1
+    if mode() != "full" and n % UPDATE_STATS_EVERY != 0:
+        return
+    try:
+        grad_norm = None
+        store = model._grads_store
+        if store is not None:
+            if store[0] == "avg":
+                grad_norm = float(jnp.sqrt(_sq_sum(store[1])))
+            else:
+                # Raw microbatch-sum accumulator: the norm is homogeneous,
+                # so divide the norm instead of materializing the average.
+                grad_norm = float(jnp.sqrt(_sq_sum(store[1]))) / float(store[2])
+        param_norm = float(jnp.sqrt(_sq_sum(new_params)))
+        update_norm = None
+        if old_params is not None:
+            update_norm = float(jnp.sqrt(_diff_sq_sum(new_params, old_params)))
+        _tel.record_update_stats(grad_norm, param_norm, update_norm)
+    except Exception as e:  # diagnostics must not break the update path
+        logger.debug("health update stats failed: %r", e)
+
+
+# ----------------------------------------------------------------------
+# OOM post-mortem
+# ----------------------------------------------------------------------
+
+
+def is_resource_exhausted(err):
+    msg = str(err)
+    return (
+        "RESOURCE_EXHAUSTED" in msg
+        or "Out of memory" in msg
+        or "out of memory" in msg
+    )
+
+
+def _live_buffer_summary(top=20):
+    groups = {}
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        return None
+    total = 0
+    for a in arrays:
+        try:
+            nbytes = int(a.nbytes)
+            key = (str(a.dtype), tuple(int(d) for d in a.shape))
+        except Exception:
+            continue
+        total += nbytes
+        cnt, byt = groups.get(key, (0, 0))
+        groups[key] = (cnt + 1, byt + nbytes)
+    ranked = sorted(groups.items(), key=lambda kv: -kv[1][1])[:top]
+    return {
+        "count": len(arrays),
+        "total_bytes": total,
+        "top_by_bytes": [
+            {"dtype": dt, "shape": list(shape), "count": cnt, "bytes": byt}
+            for (dt, shape), (cnt, byt) in ranked
+        ],
+    }
+
+
+def _memory_config_summary():
+    from smdistributed_modelparallel_tpu.backend.state import state
+
+    cfg = state.cfg
+    out = {}
+    if cfg is not None:
+        for k in ("microbatches", "active_microbatches", "offload_activations",
+                  "fused_optimizer_step", "fused_step_donation",
+                  "pipeline_parallel_degree", "tensor_parallel_degree"):
+            out[k] = getattr(cfg, k, None)
+    try:
+        from smdistributed_modelparallel_tpu.parallel.memory import (
+            offload_supported,
+        )
+
+        out["offload_supported"] = bool(offload_supported())
+    except Exception:
+        pass
+    mm = state.module_manager
+    if mm is not None:
+        out["checkpoint_configs"] = sorted(mm.checkpoint_configs)
+    model = state.model
+    if model is not None and model._pipeline_spec is not None:
+        out["pipeline_carry_remat"] = bool(model._pipeline_spec.carry_remat)
+    return out
+
+
+def oom_postmortem(name, compiled, err, path=None):
+    """Dump an OOM breakdown next to the flight-recorder ring and record
+    the event in telemetry + the ring. Returns the dump path (or None).
+
+    ``compiled``: the failing AOT executable when available — its XLA
+    ``memory_analysis`` is the authoritative argument/temp/output/alias
+    byte breakdown of the program that exhausted HBM.
+    """
+    payload = {
+        "kind": "oom_postmortem",
+        "name": name,
+        "time": time.time(),
+        "rank": telemetry.process_index or 0,
+        "error": str(err)[:4000],
+    }
+    mem = {}
+    if compiled is not None:
+        try:
+            ma = compiled.memory_analysis()
+            for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "alias_size_in_bytes",
+                      "generated_code_size_in_bytes",
+                      "host_argument_size_in_bytes",
+                      "host_output_size_in_bytes", "host_temp_size_in_bytes"):
+                v = getattr(ma, k, None)
+                if v is not None:
+                    mem[k] = int(v)
+        except Exception as e:
+            mem["error"] = repr(e)
+    payload["memory_analysis"] = mem or None
+    payload["live_buffers"] = _live_buffer_summary()
+    devices = {}
+    try:
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats() or {}
+            except Exception:
+                continue
+            devices[str(d)] = {
+                k: ms.get(k)
+                for k in ("bytes_in_use", "peak_bytes_in_use",
+                          "largest_alloc_size", "bytes_limit")
+                if k in ms
+            }
+    except Exception:
+        pass
+    payload["device_memory_stats"] = devices or None
+    try:
+        payload["memory_config"] = _memory_config_summary()
+    except Exception as e:
+        payload["memory_config"] = {"error": repr(e)}
+    _tel.record_oom(name)
+    out_path = _atomic_json_dump(payload, path or _health_path(),
+                                 "OOM post-mortem")
+    logger.error(
+        "RESOURCE_EXHAUSTED in %s: post-mortem (XLA memory breakdown, live "
+        "buffers, remat/offload config) written to %s", name, out_path,
+    )
+    # Put the ring on disk too (no-op without SMP_FLIGHT_RECORDER_PATH):
+    # the events before the OOM are the context the breakdown lacks.
+    try:
+        _fr.flight_recorder.dump()
+    except Exception:
+        pass
+    return out_path
+
+
+def maybe_oom_postmortem(name, compiled, err):
+    """Postmortem iff ``err`` is a RESOURCE_EXHAUSTED; the caller re-raises
+    either way."""
+    if is_resource_exhausted(err):
+        try:
+            oom_postmortem(name, compiled, err)
+        except Exception as e:  # pragma: no cover - diagnostics must not mask
+            logger.error("OOM post-mortem itself failed: %r", e)

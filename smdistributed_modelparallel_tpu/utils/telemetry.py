@@ -608,6 +608,91 @@ def record_pipeline_occupancy(schedule, num_stages, num_microbatches,
     return measured
 
 
+def record_loss_scale(event, scale):
+    """One fp16 loss-scale event ("overflow" | "growth" | "static_overflow"):
+    counter + current-scale gauge + a flight-recorder health event — the
+    scaler's backoff history becomes part of every post-mortem."""
+    telemetry.counter(
+        "smp_loss_scale_events_total", "fp16 loss-scale events by kind"
+    ).labels(event=event).inc()
+    telemetry.gauge(
+        "smp_loss_scale", "current fp16 loss scale"
+    ).set(float(scale))
+    _flight().record_health("loss_scale", event, value=float(scale))
+
+
+def record_update_stats(grad_norm, param_norm, update_norm):
+    """Optimizer-step norm gauges (health modes only; see utils/health.py).
+    ``update_ratio`` is ||new - old|| / ||new|| — the classic silent-LR
+    pathology signal (~1e-3 healthy; ~1 = divergence, ~0 = frozen)."""
+    if grad_norm is not None:
+        telemetry.gauge(
+            "smp_grad_norm", "global L2 norm of the last consumed gradients"
+        ).set(grad_norm)
+    telemetry.gauge(
+        "smp_param_norm", "global L2 norm of the parameters after the update"
+    ).set(param_norm)
+    if update_norm is not None:
+        telemetry.gauge(
+            "smp_update_norm", "global L2 norm of the last parameter update"
+        ).set(update_norm)
+        telemetry.gauge(
+            "smp_update_ratio",
+            "update-to-parameter norm ratio of the last optimizer step",
+        ).set(update_norm / (param_norm + 1e-12))
+
+
+def record_health_check(step, tags):
+    """One decoded health word: per-tag gauges + the checks counter."""
+    telemetry.counter(
+        "smp_health_checks_total", "health words decoded"
+    ).inc()
+    telemetry.gauge(
+        "smp_health_last_checked_step", "most recent step whose word was read"
+    ).set(step)
+    for name, d in tags.items():
+        telemetry.gauge(
+            "smp_health_bad_count", "non-finite elements per sentinel tag"
+        ).labels(tag=name).set(d["bad"])
+        telemetry.gauge(
+            "smp_health_absmax", "largest finite magnitude per sentinel tag"
+        ).labels(tag=name).set(d["absmax"])
+        telemetry.gauge(
+            "smp_health_first_microbatch",
+            "first microbatch with a non-finite value (-1 = none)",
+        ).labels(tag=name).set(d["microbatch"])
+
+
+def record_health_trip(tag, step, bad, absmax, microbatch):
+    telemetry.counter(
+        "smp_health_trips_total", "tripped sentinel tags"
+    ).labels(tag=tag).inc()
+    telemetry.gauge(
+        "smp_health_last_trip_step", "step of the most recent sentinel trip"
+    ).set(step)
+    _flight().record_health(
+        "trip", tag, step=step, value=bad, microbatch=microbatch
+    )
+
+
+def record_health_fault(layer, microbatch, tag, step):
+    """Bisection attribution: the first non-finite value's layer."""
+    telemetry.counter(
+        "smp_health_fault_total",
+        "bisection fault attributions (layer of the first non-finite value)",
+    ).labels(layer=str(layer), microbatch=str(microbatch), tag=tag).inc()
+    _flight().record_health(
+        "fault", str(layer), step=step, microbatch=microbatch
+    )
+
+
+def record_oom(name):
+    telemetry.counter(
+        "smp_oom_total", "RESOURCE_EXHAUSTED failures with a post-mortem dump"
+    ).labels(step=str(name)).inc()
+    _flight().record_health("oom", str(name))
+
+
 def _atexit_dump():  # pragma: no cover - exercised via subprocess test
     try:
         # An empty registry must not clobber the dump smp.shutdown already
