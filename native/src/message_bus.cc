@@ -139,6 +139,28 @@ class MessageBus {
       return 0;
     }
     if (!connected_ || dest < 0 || dest >= world_) return -1;
+    if (send_queues_[dest].dead.load()) {
+      // Report the loss (-2), and after a cool-down allow one revival: a
+      // fresh SendLoop with a full connect budget. The cool-down is
+      // longer than the Python send_bytes retry burst, so a single send's
+      // bounded retries still fail typed (SMPPeerLost) — but a LATER send
+      // (peer restarted, operator retry) gets a genuine reconnect instead
+      // of a permanently wedged link.
+      auto& q = send_queues_[dest];
+      std::lock_guard<std::mutex> lk(q.mu);
+      if (q.dead.load() && NowMs() - q.death_ms.load() > 2000) {
+        if (send_threads_[dest].joinable()) send_threads_[dest].join();
+        // Frames queued before the link died were acked to their callers
+        // but never delivered; replaying them to a RESTARTED peer would
+        // inject stale protocol state (e.g. a pre-restart preemption
+        // notice on tx -2 retriggering an emergency save). The revived
+        // link starts empty — callers that cared got SMPPeerLost.
+        q.frames.clear();
+        q.thread_started = false;
+        q.dead.store(false);
+      }
+      return -2;
+    }
     {
       std::lock_guard<std::mutex> lk(send_queues_[dest].mu);
       send_queues_[dest].frames.push_back(
@@ -206,7 +228,12 @@ class MessageBus {
       seq = ++barrier_seq_[GroupHash(group)];
     }
     // tx = -(2*(hash*K + seq)) for arrive, -1 offset for release.
-    int64_t base = -((GroupHash(group) % 100003) * 1000003 + seq) * 2;
+    // +16 reserves tx -1..-33 for control messages outside the barrier
+    // namespace (exit-status relay -1, preemption notice -2,
+    // backend/core.py / resilience/preemption.py): without the offset,
+    // k = hash%100003 == 0 makes the first barriers produce -2/-3.
+    int64_t base =
+        -(((GroupHash(group) % 100003) * 1000003 + seq) + 16) * 2;
     uint8_t token = 1;
     if (rank_ == root) {
       for (int r : group) {
@@ -266,7 +293,25 @@ class MessageBus {
     std::deque<Frame> frames;
     int fd = -1;
     bool thread_started = false;
+    // Set by SendLoop when it gives up on this link (connect budget
+    // exhausted or a write failed): the peer is unreachable and the
+    // sender thread has exited, so further enqueues can never deliver.
+    // AsyncSend revives the link (fresh thread, fresh connect budget)
+    // once `death_ms` is old enough — see the cool-down there.
+    std::atomic<bool> dead{false};
+    std::atomic<int64_t> death_ms{0};
   };
+
+  static int64_t NowMs() {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  static void MarkDead(SendQueue& q) {
+    q.death_ms.store(NowMs());
+    q.dead.store(true);
+  }
 
   static uint64_t Key(int src, int64_t tx) {
     return (static_cast<uint64_t>(static_cast<uint32_t>(src)) << 48) ^
@@ -346,10 +391,17 @@ class MessageBus {
         break;
       if (fd >= 0) ::close(fd);
       fd = -1;
-      if (shut_.load() || attempt == 599) return;
+      if (shut_.load()) return;
+      if (attempt == 599) {
+        MarkDead(q);  // peer never came up: link unrecoverable (for now)
+        return;
+      }
       std::this_thread::sleep_for(std::chrono::milliseconds(50));
     }
-    if (fd < 0) return;
+    if (fd < 0) {
+      MarkDead(q);
+      return;
+    }
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     while (true) {
@@ -368,10 +420,15 @@ class MessageBus {
       }
       FrameHeader h{kMagic, f.src, f.tx,
                     static_cast<int64_t>(f.payload.size())};
-      if (!write_exact(fd, &h, sizeof(h))) break;
-      if (!f.payload.empty() &&
-          !write_exact(fd, f.payload.data(), f.payload.size()))
+      if (!write_exact(fd, &h, sizeof(h))) {
+        if (!shut_.load()) MarkDead(q);  // peer died mid-stream
         break;
+      }
+      if (!f.payload.empty() &&
+          !write_exact(fd, f.payload.data(), f.payload.size())) {
+        if (!shut_.load()) MarkDead(q);
+        break;
+      }
     }
     ::close(fd);  // sender-owned; not in conn_fds_
   }

@@ -35,6 +35,7 @@ import numpy as np
 import jax
 
 from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.resilience.chaos import chaos
 from smdistributed_modelparallel_tpu.utils.exceptions import SMPRuntimeError
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
@@ -253,6 +254,7 @@ class CollectiveCommunicator:
         """Broadcast a picklable object from member `src` of `group` to the
         group's processes. Full-world broadcasts ride multihost_utils;
         proper subgroups ride the native bus (only members may call)."""
+        chaos.on_collective("broadcast", getattr(group, "name", group))
         if not self._multi():
             record_comm("broadcast", group, _payload_size(obj), 1)
             return obj
@@ -279,7 +281,12 @@ class CollectiveCommunicator:
                 buf, is_source=jax.process_index() == src
             )
         record_comm("broadcast", group, int(n[0]), len(procs))
-        return pickle.loads(np.asarray(out).tobytes()[: int(n[0])])
+        # astype: psum-based broadcast_one_to_all widens uint8 to uint32
+        # under the gloo CPU collectives (values preserved) — tobytes() on
+        # the widened array would interleave three zero bytes per real one.
+        return pickle.loads(
+            np.asarray(out).astype(np.uint8, copy=False).tobytes()[: int(n[0])]
+        )
 
     def allgather(self, obj, group=CommGroup.WORLD):
         """Gather a picklable object from every process of `group`; returns
@@ -288,6 +295,7 @@ class CollectiveCommunicator:
         Full-world gathers are TWO collectives (max-length exchange, then
         one padded uint8 process_allgather) — not P sequential broadcasts.
         """
+        chaos.on_collective("allgather", getattr(group, "name", group))
         if not self._multi():
             record_comm("allgather", group, _payload_size(obj), 1)
             return [obj]
@@ -392,8 +400,9 @@ class CollectiveCommunicator:
         subgroup barriers raise when the bus is down rather than silently
         widening."""
         procs = self.group_processes(group)
-        record_comm("barrier", group, 0, len(procs))
         gname = getattr(group, "name", None) or str(group)
+        chaos.on_collective("barrier", gname)
+        record_comm("barrier", group, 0, len(procs))
         seq = self._barrier_seq.get(gname, 0)
         self._barrier_seq[gname] = seq + 1
         if len(procs) > 1:

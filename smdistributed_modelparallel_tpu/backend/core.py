@@ -107,6 +107,21 @@ class ModelParallelCore:
                 self.exit_hook.exit_code, self.exit_hook.exception,
             )
         self._relay_exit_status(success)
+        # Drain pending async checkpoint saves BEFORE the shutdown dumps:
+        # the dumps below are the post-mortem record of this process, and
+        # on a crash-exit they must not race (or misrepresent) a
+        # half-written checkpoint — once they run, every submitted save has
+        # either committed or surfaced its error here.
+        from smdistributed_modelparallel_tpu.checkpoint import (
+            wait_for_checkpoints,
+        )
+
+        try:
+            wait_for_checkpoints()
+        except Exception as e:
+            logger.error(
+                "pending async checkpoint save failed during shutdown: %s", e
+            )
         # The session timeline (state.timeline, fed by the step engine and
         # the barrier sync marks) flushes here: events recorded after the
         # last step's flush — the final barrier's sync mark above all —

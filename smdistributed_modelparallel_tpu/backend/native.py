@@ -22,7 +22,11 @@ import subprocess
 import threading
 import time
 
-from smdistributed_modelparallel_tpu.utils.exceptions import SMPWatchdogTimeout
+from smdistributed_modelparallel_tpu.resilience.chaos import chaos
+from smdistributed_modelparallel_tpu.utils.exceptions import (
+    SMPPeerLost,
+    SMPWatchdogTimeout,
+)
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.utils.telemetry import watchdog
@@ -187,9 +191,63 @@ class MessageBus:
         self._connected = True
 
     def send_bytes(self, dest, payload, tx):
-        rc = self._lib.smp_async_send(dest, payload, len(payload), tx)
-        if rc != 0:
-            raise OSError(f"smp_async_send to {dest} failed ({rc})")
+        """Enqueue one message, with dead-link detection + bounded retry.
+
+        The C side reports two failures: ``-1`` (bus not connected / bad
+        destination — caller misuse, raised as OSError immediately, as
+        before) and ``-2`` (the sender thread for this link gave up:
+        connect budget exhausted or the peer died mid-stream —
+        ``message_bus.cc`` ``SendQueue.dead``). A dead link retries
+        ``SMP_BUS_SEND_RETRIES`` times (default 3) with exponential
+        backoff, then raises a structured ``SMPPeerLost`` carrying the
+        peer index: a typed, attributable failure instead of frames
+        silently queueing forever while the receiver hangs until the
+        watchdog fires. The C side keeps a dead link marked for a ~2s
+        cool-down — longer than the default backoff burst, so one send's
+        retries fail typed and fast — and then revives it (fresh sender
+        thread, fresh connect budget) on the next attempt, which is what
+        lets a send to a RESTARTED peer eventually go through.
+        """
+        injected = chaos.on_bus_send(dest)
+        if injected == "drop":
+            flight_recorder.record_wait("bus_send", dest, tx, "chaos_drop", 0.0)
+            return
+        try:
+            retries = max(int(os.environ.get("SMP_BUS_SEND_RETRIES", "3")), 0)
+        except ValueError:
+            logger.warning(
+                "ignoring non-integer SMP_BUS_SEND_RETRIES=%r; using 3.",
+                os.environ.get("SMP_BUS_SEND_RETRIES"),
+            )
+            retries = 3
+        delay = 0.05
+        for attempt in range(retries + 1):
+            rc = (
+                -2 if injected == "error" and attempt == 0
+                else self._lib.smp_async_send(dest, payload, len(payload), tx)
+            )
+            if rc == 0:
+                if attempt:
+                    logger.warning(
+                        "bus send to process %d succeeded after %d retr%s.",
+                        dest, attempt, "y" if attempt == 1 else "ies",
+                    )
+                return
+            if rc == -1:
+                raise OSError(f"smp_async_send to {dest} failed ({rc})")
+            if attempt < retries:
+                flight_recorder.record_wait(
+                    "bus_send", dest, tx, "retry", delay
+                )
+                time.sleep(delay)
+                delay *= 2
+        flight_recorder.record_wait("bus_send", dest, tx, "peer_lost", 0.0)
+        raise SMPPeerLost(
+            dest,
+            f"native-bus link to process {dest} is dead (sender gave up "
+            f"delivering; rc={rc}) after {retries} "
+            f"retr{'y' if retries == 1 else 'ies'}.",
+        )
 
     def poll(self, src, tx):
         return bool(self._lib.smp_poll_recv(src, tx))

@@ -97,6 +97,11 @@ class ModelParallelState:
             # allgather) and so must happen HERE, where every process is
             # known to participate — not lazily from a subgroup op.
             self.comm.initialize_bus()
+        from smdistributed_modelparallel_tpu.resilience.preemption import (
+            preemption,
+        )
+
+        preemption.install()
 
     def _check(self):
         if not self.initialized:
@@ -123,6 +128,11 @@ class ModelParallelState:
         telemetry.reset()
         flight_recorder.clear()
         health.reset()
+        from smdistributed_modelparallel_tpu.resilience import (
+            reset as resilience_reset,
+        )
+
+        resilience_reset()
         if self._comm is not None:
             # Barrier ordinals restart with the session, like the metric
             # counters (a re-init resets them on every rank uniformly).

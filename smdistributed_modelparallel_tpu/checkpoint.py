@@ -22,6 +22,7 @@ import os
 import pickle
 import re
 import shutil
+import time
 
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.utils.exceptions import (
@@ -33,6 +34,26 @@ from smdistributed_modelparallel_tpu.utils.logger import get_logger
 logger = get_logger()
 
 _PARTIAL_RE = re.compile(r"^(?P<stem>.*)_(?P<pp>\d+)_(?P<tp>\d+)(_(?P<rdp>\d+))?$")
+
+# Save ordinals (_SAVE_SEQ) restart at 0 in every process incarnation, but
+# marker files survive on disk — so ordinal comparisons are only
+# meaningful against markers THIS run wrote. Anything with an mtime before
+# the process started is debris of a dead incarnation: without this
+# anchor, a stale `.inflight_s37` would outrank every fresh save's ordinal
+# forever (blocking `.committed` on a perfectly good re-save), and a stale
+# `.done_p1` holding 37 would satisfy a fresh commit's `>= 2` wait before
+# the peer's shards actually landed. 2s of slack absorbs coarse filesystem
+# timestamp granularity; a dead incarnation's files predate the crash and
+# therefore this process by far more than that.
+_RUN_START = time.time() - 2.0
+
+
+def _fresh(path_):
+    """True when `path_` was written by THIS process incarnation."""
+    try:
+        return os.path.getmtime(path_) >= _RUN_START
+    except OSError:
+        return False
 
 
 def _coords():
@@ -81,7 +102,17 @@ def load(f, partial=True):
 
 def _smp_config_snapshot():
     cfg = state.cfg
-    return dict(cfg.as_dict()) if cfg is not None else {}
+    if cfg is None:
+        return {}
+    snapshot = dict(cfg.as_dict())
+    # Writer census: bounds-based coverage cannot see a missing TAIL shard
+    # file (the inferred global extent shrinks with it), so the number of
+    # writer processes is the one reliable completeness check a reader
+    # has. Consumed by ShardCatalog.verify_complete and
+    # scripts/resilience_probe.py; present on the RESUME side too so
+    # elastic.classify_mismatches can report a world-size change.
+    snapshot["num_processes"] = _process_count()
+    return snapshot
 
 
 def verify_smp_config(saved):
@@ -170,13 +201,80 @@ def save_checkpoint(path, tag=None, model=None, optimizer=None,
             state.loss_scaler.state_dict() if state.loss_scaler else None
         )
         cfg_snapshot = _smp_config_snapshot()
+        import smdistributed_modelparallel_tpu as smp
+
+        live_degrees = (smp.pp_size(), smp.tp_size(), smp.rdp_size())
 
         def job():
             import numpy as np
 
             ckpt_dir = os.path.join(path, f"{tag}_partial")
             os.makedirs(ckpt_dir, exist_ok=True)
+            # In-flight marker before the first shard write: it is the
+            # positive evidence the GC orphan sweep requires, so dirs from
+            # versions that predate the marker protocol (no markers at
+            # all) are never mistaken for interrupted saves. The save
+            # ordinal is in the NAME: markers are immutable facts, so a
+            # concurrent commit of save N can never delete or mistake
+            # save N+1's stamp (see _finish_checkpoint).
+            _write_atomic(os.path.join(ckpt_dir, f".inflight_s{seq}"), str(seq))
+            # A re-save of an already-committed tag overwrites its shard
+            # files IN PLACE; drop the stale .committed so a crash
+            # mid-overwrite classifies as an interrupted save (orphan),
+            # not a committed checkpoint full of half-written files. Safe
+            # under multi-process: every rank runs this before any shard
+            # write, and the commit rendezvous (which rewrites .committed)
+            # only completes after all ranks' shards land.
+            try:
+                os.unlink(os.path.join(ckpt_dir, ".committed"))
+            except OSError:
+                pass
             me = _process_index()
+            world = _process_count()
+            if me == 0:
+                # An elastic re-save of the same tag from a SMALLER world
+                # (preempt at 4 processes, resume+save at 2) overwrites
+                # p0..p{world-1} in place but would leave the old world's
+                # higher-indexed shard files as stale overlap that makes
+                # every later load fail coverage; no live rank writes
+                # those indexes, so deleting them here cannot race the
+                # peers' writers.
+                for fname in os.listdir(ckpt_dir):
+                    for comp in ("model_shards_p", "optimizer_shards_p"):
+                        if fname.startswith(comp) and fname.endswith(".npz"):
+                            try:
+                                idx = int(fname[len(comp):-4])
+                            except ValueError:
+                                continue
+                            if idx >= world:
+                                try:
+                                    os.unlink(os.path.join(ckpt_dir, fname))
+                                except OSError:
+                                    pass
+                # Same hazard for the per-(pp,tp,rdp)-coordinate scaler
+                # files: a re-save under a different topology leaves the
+                # old coordinates' copies (with an outdated loss scale)
+                # that the elastic fallback glob in resume could pick.
+                # Only coordinates OUTSIDE the live degree ranges are
+                # stale — no current rank writes those — plus every copy
+                # when this save carries no scaler at all.
+                for fname in os.listdir(ckpt_dir):
+                    if not (fname.startswith("fp16_states_")
+                            and fname.endswith(".pt")):
+                        continue
+                    parts = fname[len("fp16_states_"):-3].split("_")
+                    try:
+                        coords = [int(p) for p in parts]
+                    except ValueError:
+                        continue
+                    stale = scaler_sd is None or len(coords) != 3 or any(
+                        c >= d for c, d in zip(coords, live_degrees)
+                    )
+                    if stale:
+                        try:
+                            os.unlink(os.path.join(ckpt_dir, fname))
+                        except OSError:
+                            pass
             if model_payload is not None:
                 # True per-rank shards (reference: per-rank partial files,
                 # torch/checkpoint.py:124-165): each process writes only
@@ -234,6 +332,12 @@ def _process_index():
     return jax.process_index()
 
 
+def _process_count():
+    import jax
+
+    return jax.process_count()
+
+
 _SAVE_SEQ = 0
 
 
@@ -244,10 +348,40 @@ def _commit_timeout():
 
 
 def _write_atomic(path, text):
-    tmp = path + ".tmp"
+    # pid-qualified tmp name: several processes write SOME of these paths
+    # concurrently into a shared checkpoint dir (the .inflight stamp, most
+    # directly) — with a fixed tmp name, one rank's os.replace deletes the
+    # tmp another rank is about to rename and the second rename raises.
+    tmp = f"{path}.tmp{os.getpid()}"
     with open(tmp, "w") as fh:
         fh.write(text)
     os.replace(tmp, path)
+
+
+def _inflight_seqs(ckpt_dir):
+    """Map of in-flight marker filename -> save ordinal for `ckpt_dir`.
+    Seq-named markers (``.inflight_s{seq}``) are immutable facts a
+    concurrent commit can reason about without read-then-delete races; a
+    legacy literal ``.inflight`` (earlier protocol, hand-built test dirs)
+    counts with its numeric content, or 0."""
+    out = {}
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return out
+    for n in names:
+        if n.startswith(".inflight_s"):
+            try:
+                out[n] = int(n[len(".inflight_s"):])
+            except ValueError:
+                out[n] = 0
+        elif n == ".inflight":
+            try:
+                with open(os.path.join(ckpt_dir, n)) as fh:
+                    out[n] = int(fh.read().strip() or 0)
+            except (OSError, ValueError):
+                out[n] = 0
+    return out
 
 
 def _commit_checkpoint(path, ckpt_dir, tag, num_kept, seq):
@@ -279,8 +413,15 @@ def _commit_checkpoint(path, ckpt_dir, tag, num_kept, seq):
             marker = os.path.join(ckpt_dir, f".done_p{p}")
             while True:
                 try:
+                    # Freshness gate: a dead incarnation's .done (its seq
+                    # counter ran higher than this run's) would satisfy
+                    # the ordinal check instantly, committing before the
+                    # peer's shards of THIS save actually landed.
                     with open(marker) as fh:
-                        if int(fh.read().strip() or 0) >= seq:
+                        if (
+                            int(fh.read().strip() or 0) >= seq
+                            and _fresh(marker)
+                        ):
                             break
                 except (FileNotFoundError, ValueError):
                     pass
@@ -290,10 +431,56 @@ def _commit_checkpoint(path, ckpt_dir, tag, num_kept, seq):
                         f"{p}'s shards under {ckpt_dir} (> {timeout}s)."
                     )
                 time.sleep(0.05)
-    _finish_checkpoint(path, tag, True, num_kept)
+    _finish_checkpoint(path, tag, True, num_kept, seq=seq)
 
 
-def _finish_checkpoint(path, tag, partial, num_kept):
+def _finish_checkpoint(path, tag, partial, num_kept, seq=None):
+    if partial:
+        # Commit marker INSIDE the dir, before `newest` moves: GC (and the
+        # resilience probe) can tell a completed checkpoint from the debris
+        # of a rank killed mid-save without consulting `newest` history.
+        # EXCEPT when a NEWER save of the same tag has already stamped its
+        # in-flight marker (back-to-back async re-saves: a non-committer
+        # rank can start save N+1's job while the committer is still in
+        # save N's commit): its job is overwriting the shard files in
+        # place, so publishing .committed now would bless half-written
+        # files if the process died before the newer commit. The markers
+        # are seq-NAMED and immutable, so this commit can only ever skip
+        # or unlink stamps of its own save or older — never a newer one —
+        # and the post-write re-check below repairs the one interleaving
+        # the pre-check cannot see (newer stamp landing between the check
+        # and the .committed write; the newer save's own .committed unlink
+        # covers stamps landing after the re-check).
+        ckpt_dir = os.path.join(path, f"{tag}_partial")
+        my_seq = float("inf") if seq is None else seq
+
+        def newer_live(stamps):
+            # Only stamps THIS run wrote can outrank this commit: ordinals
+            # restart every incarnation, so a dead run's high-seq stamp
+            # must not block .committed forever (see _RUN_START).
+            return any(
+                s > my_seq and _fresh(os.path.join(ckpt_dir, n))
+                for n, s in stamps.items()
+            )
+
+        marker = os.path.join(ckpt_dir, ".committed")
+        if not newer_live(_inflight_seqs(ckpt_dir)):
+            _write_atomic(marker, tag)
+            stamps = _inflight_seqs(ckpt_dir)
+            if newer_live(stamps):
+                try:
+                    os.unlink(marker)
+                except OSError:
+                    pass
+            else:
+                # Clear this save's stamps AND any dead incarnation's:
+                # once committed, the dir's contents are exactly this
+                # save's output — stale stamps are no longer evidence.
+                for name in stamps:
+                    try:
+                        os.unlink(os.path.join(ckpt_dir, name))
+                    except OSError:
+                        pass
     _write_atomic(os.path.join(path, "newest"), tag)
     logger.info("Saved %s checkpoint '%s' under %s.",
                 "partial" if partial else "full", tag, path)
@@ -336,25 +523,69 @@ def wait_for_checkpoints():
 
 
 def _gc_partial_checkpoints(path, keep):
-    """Parity: reference retention GC (``torch/checkpoint.py:270-298``)."""
+    """Parity: reference retention GC (``torch/checkpoint.py:270-298``),
+    plus crash hygiene: a rank killed mid-save leaves an uncommitted
+    ``{tag}_partial/`` dir that the retention pass used to count (and keep)
+    forever. A dir is swept as an orphan only on POSITIVE evidence of an
+    interrupted save — the ``.inflight`` marker (stamped at save start,
+    removed at commit) without ``.committed`` — and only once older than
+    the commit timeout (younger ones may be a peer's in-flight save).
+    Dirs with neither marker predate the marker protocol and count as
+    committed, so an upgrade can never sweep previously valid
+    checkpoints."""
+    import time
+
     if keep <= 0:
         return
     dirs = [
         d for d in os.listdir(path)
         if d.endswith("_partial") and os.path.isdir(os.path.join(path, d))
     ]
-    dirs.sort(key=lambda d: os.path.getmtime(os.path.join(path, d)))
-    for d in dirs[:-keep]:
+    committed, orphans = [], []
+    now = time.time()
+    stale_after = _commit_timeout()
+    for d in dirs:
+        full = os.path.join(path, d)
+        if os.path.exists(os.path.join(full, ".committed")):
+            committed.append(d)
+            continue
+        # Positive interruption evidence only: an in-flight stamp without
+        # .committed. (.done_p* is NOT evidence — committed pre-marker
+        # multi-process dirs retain theirs.)
+        if not _inflight_seqs(full):
+            committed.append(d)  # legacy (pre-marker) dir
+            continue
+        try:
+            age = now - os.path.getmtime(full)
+        except OSError:
+            continue  # swept by a concurrent GC
+        if age > stale_after:
+            orphans.append(d)
+    for d in orphans:
+        shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+        logger.warning(
+            "Swept orphaned (uncommitted, > %.0fs old) checkpoint dir %s — "
+            "debris of an interrupted save.", stale_after, d,
+        )
+    committed.sort(key=lambda d: os.path.getmtime(os.path.join(path, d)))
+    for d in committed[:-keep]:
         shutil.rmtree(os.path.join(path, d), ignore_errors=True)
         logger.info("Removed old partial checkpoint %s.", d)
 
 
 def resume_from_checkpoint(path, tag=None, partial=True, strict=True,
-                           load_optimizer=True, load_sharded_optimizer_state=True):
+                           load_optimizer=True, load_sharded_optimizer_state=True,
+                           elastic=True):
     """Load a checkpoint; defer application until model/optimizer exist.
 
     Parity: reference ``smp.resume_from_checkpoint``
-    (``torch/checkpoint.py:381+``).
+    (``torch/checkpoint.py:381+``), EXCEPT that a parallelism-layout
+    mismatch is no longer fatal by default: with ``elastic=True`` a
+    checkpoint saved under a different (pp, tp, rdp) degree layout is
+    resharded on load — each leaf reassembles from its logical shard
+    bounds and re-slices per the resuming mesh's shardings
+    (``resilience/elastic.py``; the reference's ``verify_smp_config``
+    hard-fail is restored with ``elastic=False``).
     Returns the saved user_content.
     """
     if tag is None:
@@ -364,6 +595,22 @@ def resume_from_checkpoint(path, tag=None, partial=True, strict=True,
         with open(newest) as fh:
             tag = fh.read().strip()
 
+    def _verify(saved_cfg, shard_format, what):
+        try:
+            verify_smp_config(saved_cfg)
+        except SMPValidationError:
+            # Elastic downgrades topology mismatches only — resuming
+            # before smp.init stays an error either way.
+            if not elastic or state.cfg is None:
+                raise
+            from smdistributed_modelparallel_tpu.resilience.elastic import (
+                begin_elastic_resume,
+            )
+
+            begin_elastic_resume(
+                saved_cfg, _smp_config_snapshot(), shard_format, what=what
+            )
+
     if partial:
         import glob as _glob
 
@@ -372,33 +619,76 @@ def resume_from_checkpoint(path, tag=None, partial=True, strict=True,
         ckpt_dir = os.path.join(path, f"{tag}_partial")
         if not os.path.isdir(ckpt_dir):
             raise SMPRuntimeError(f"Partial checkpoint dir not found: {ckpt_dir}")
+        if (
+            not os.path.exists(os.path.join(ckpt_dir, ".committed"))
+            and _inflight_seqs(ckpt_dir)
+        ):
+            # An in-flight stamp without the commit marker means a save
+            # (possibly an in-place RE-save of a previously good tag) was
+            # interrupted: the shard files may be half-overwritten, and
+            # every per-file check would still pass — bounds and census
+            # don't change when only the tensor BYTES are torn. Refuse
+            # rather than resume from silently inconsistent state.
+            raise SMPRuntimeError(
+                f"Checkpoint '{tag}' under {path} was interrupted mid-save "
+                "(in-flight markers present, no commit marker): its shard "
+                "files may be half-written. Resume an older committed tag "
+                "(scripts/resilience_probe.py lists them), or remove the "
+                "in-flight markers only if you are certain every rank's "
+                "save completed."
+            )
         with open(os.path.join(ckpt_dir, "smp_config.pt"), "rb") as fh:
             saved_cfg = pickle.load(fh)
-        verify_smp_config(saved_cfg)
-        if _glob.glob(os.path.join(ckpt_dir, "model_shards_p*.npz")):
+        shard_format = bool(
+            _glob.glob(os.path.join(ckpt_dir, "model_shards_p*.npz"))
+        )
+        _verify(saved_cfg, shard_format, what=f"of '{tag}'")
+        if shard_format:
             model_sd = ShardCatalog(ckpt_dir, "model")
+            # Coverage pre-flight: gaps (a peer's file missing from this
+            # filesystem) must fail HERE, not inside the deferred apply at
+            # the first training step. The writer census catches what
+            # bounds coverage cannot: a missing TAIL shard file.
+            model_sd.verify_complete(
+                what=f"model checkpoint '{tag}'",
+                expected_files=saved_cfg.get("num_processes"),
+            )
         else:  # legacy gathered-pickle layout
             model_sd = load(os.path.join(ckpt_dir, "model.pt"))
         opt_sd = None
         if load_optimizer:
             if _glob.glob(os.path.join(ckpt_dir, "optimizer_shards_p*.npz")):
                 opt_sd = ShardCatalog(ckpt_dir, "optimizer")
+                opt_sd.verify_complete(
+                    what=f"optimizer checkpoint '{tag}'",
+                    expected_files=saved_cfg.get("num_processes"),
+                )
             else:
                 try:
                     opt_sd = load(os.path.join(ckpt_dir, "optimizer.pt"))
                 except SMPRuntimeError:
                     opt_sd = None
-        fp16_path = os.path.join(ckpt_dir, "fp16_states.pt")
-        if state.loss_scaler is not None and os.path.exists(
-            _partial_name(fp16_path)
-        ):
-            state.loss_scaler.load_state_dict(load(fp16_path))
+        if state.loss_scaler is not None:
+            fp16_path = os.path.join(ckpt_dir, "fp16_states.pt")
+            if os.path.exists(_partial_name(fp16_path)):
+                state.loss_scaler.load_state_dict(load(fp16_path))
+            else:
+                # Elastic resume: the saved rank coordinates differ from
+                # ours, so the exact per-coord name misses. Scaler state is
+                # one replicated scalar struct — any saved copy is THE copy.
+                stem, ext = os.path.splitext(fp16_path)
+                any_fp16 = sorted(_glob.glob(f"{stem}_*{ext}"))
+                if any_fp16:
+                    with open(any_fp16[0], "rb") as fh:
+                        state.loss_scaler.load_state_dict(pickle.load(fh))
         with open(os.path.join(ckpt_dir, "user_content.pt"), "rb") as fh:
             user_content = pickle.load(fh)
     else:
         with open(os.path.join(path, tag), "rb") as fh:
             payload = pickle.load(fh)
-        verify_smp_config(payload.get("smp_config", {}))
+        # A full checkpoint is a gathered logical state dict — always
+        # reshardable, so elastic resume only needs the record/log.
+        _verify(payload.get("smp_config", {}), True, what=f"of full '{tag}'")
         model_sd = payload.get("model")
         opt_sd = payload.get("optimizer") if load_optimizer else None
         user_content = payload.get("user_content")

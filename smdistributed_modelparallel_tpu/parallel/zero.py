@@ -101,6 +101,31 @@ def maybe_register_zero2d(model):
         )
 
 
+def describe_state_layout(cfg_like):
+    """Compact description of where optimizer/parameter state lives under a
+    config — works on a live ``ModelParallelConfig`` or a saved checkpoint's
+    plain-dict snapshot, so elastic resume (``resilience/elastic.py``) and
+    ``scripts/resilience_probe.py`` can describe the layout transition a
+    reshard performs. All three modes are PartitionSpec-only in this
+    framework (module docstring), which is precisely why a checkpoint's
+    logical arrays reshard freely across them: the rdp axis placement is
+    re-derived from the resuming config, never read from the files."""
+    if hasattr(cfg_like, "get"):
+        get = cfg_like.get
+    else:
+        def get(k, d=None):
+            return getattr(cfg_like, k, d)
+
+    rdp = int(get("sharded_data_parallel_degree", 0) or 0)
+    return {
+        "zero1": bool(get("shard_optimizer_state", False)),
+        "zero2d": rdp > 1,
+        "sharded_data_parallel_degree": rdp,
+        "pipeline_parallel_degree": int(get("pipeline_parallel_degree", 1) or 1),
+        "tensor_parallel_degree": int(get("tensor_parallel_degree", 1) or 1),
+    }
+
+
 def opt_state_shardings(opt_state, model):
     """Shardings for the optimizer-state pytree.
 

@@ -1,0 +1,33 @@
+"""smp.resilience — preemption-aware checkpointing, elastic resume, chaos.
+
+Three cooperating pieces (each in its own module):
+
+- ``preemption``: SIGTERM / ``SMP_PREEMPTION_FILE`` listener whose flag
+  the step engine checks at every step edge; a trigger leads to a
+  coordinated, committed emergency partial checkpoint within the
+  ``SMP_PREEMPTION_GRACE_SECONDS`` budget (``preemption.py``).
+- elastic reshard-on-resume: ``smp.resume_from_checkpoint`` loads a
+  checkpoint saved under a *different* (pp, tp, rdp) layout by
+  reassembling each leaf from logical shard bounds and re-slicing it per
+  the resuming mesh (``elastic.py``; policy consumed by ``checkpoint.py``).
+- ``chaos``: the ``SMP_CHAOS`` deterministic fault injector (SIGTERM at a
+  step edge, dropped/failed bus sends, delayed collectives) that the
+  resilience tests use to prove the recovery paths recover (``chaos.py``).
+"""
+
+from smdistributed_modelparallel_tpu.resilience.chaos import chaos
+from smdistributed_modelparallel_tpu.resilience.elastic import (
+    classify_mismatches,
+)
+from smdistributed_modelparallel_tpu.resilience.preemption import preemption
+
+
+def reset():
+    """Session-teardown hook (``state.reset`` / ``smp.shutdown``): clear
+    preemption triggers and chaos rule state, and give SIGTERM back its
+    previous disposition — ``smp.init`` installs the deferring handler, so
+    a process that has shut the session down must die normally on TERM
+    instead of flagging an edge no step loop will ever reach."""
+    preemption.reset()
+    preemption.uninstall()
+    chaos.reset()

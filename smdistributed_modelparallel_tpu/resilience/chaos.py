@@ -1,0 +1,242 @@
+"""Deterministic chaos / fault-injection harness.
+
+Production resilience claims are worthless untested: "we recover from a
+preempted rank" has to be demonstrated against an *actual* preempted rank.
+This module is the one switchboard for injecting those faults, driven by
+the ``SMP_CHAOS`` environment variable so a chaos run needs no code
+changes — the same training script, plus a fault spec.
+
+Spec grammar (comma-separated rules; each ``fault@key=value[:key=value...]``)::
+
+    SMP_CHAOS="sigterm@step=3:rank=0,delay_collective@group=pp:ms=200"
+
+Faults:
+
+- ``sigterm@step=N[:rank=R]`` — deliver SIGTERM to this process at the end
+  of step ``N`` (the step-engine edge in ``step.py``). With the preemption
+  listener installed (``resilience/preemption.py``) this exercises the full
+  emergency-checkpoint path; without it the process dies like a real
+  preemption with no grace handling.
+- ``bus_drop@seq=N[:rank=R][:dest=D]`` — silently drop this process's
+  ``N``-th native-bus send (0-based ordinal over all sends). The receiver
+  never sees the message: exercises watchdog/timeout recovery.
+- ``bus_error@seq=N[:rank=R][:dest=D]`` — fail the ``N``-th send at the
+  enqueue edge: exercises the bounded retry/backoff and ``SMPPeerLost``
+  path in ``backend/native.py``.
+- ``delay_collective@group=G:ms=M[:count=C]`` — sleep ``M`` ms before each
+  host collective whose group name starts with ``G`` (case-insensitive;
+  e.g. ``pp`` matches ``PP_GROUP``), at most ``C`` times (default
+  unlimited): manufactures stragglers for the observability stack.
+
+``rank=R`` restricts a rule to process index ``R`` (default: every
+process). Rules are deterministic — ordinals and step numbers are exact,
+never sampled — so a chaos failure reproduces byte-for-byte.
+
+Seams live in ``step.py`` (``on_step_edge``), ``backend/native.py``
+(``on_bus_send``) and ``backend/collectives.py`` (``on_collective``). Every
+seam's disabled path is one ``os.environ.get`` — a run without ``SMP_CHAOS``
+pays nothing. Injections are counted in ``smp_chaos_injected_total`` and
+recorded as flight-recorder ``chaos`` events so a post-mortem ring always
+shows which faults were synthetic.
+
+Import-hygiene contract: stdlib + the package logger/telemetry only.
+"""
+
+import os
+import signal
+import time
+
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import (
+    record_chaos,
+    telemetry,
+)
+
+logger = get_logger()
+
+CHAOS_ENV = "SMP_CHAOS"
+
+_KNOWN_FAULTS = ("sigterm", "bus_drop", "bus_error", "delay_collective")
+
+# Argument value parsers: validated at PARSE time so a typo degrades to a
+# skipped rule with a warning — never a ValueError at a seam mid-run.
+_NUMERIC_KEYS = {
+    "step": int, "rank": int, "seq": int, "dest": int, "count": int,
+    "ms": float,
+}
+
+
+class _Rule:
+    __slots__ = ("fault", "kv", "fired")
+
+    def __init__(self, fault, kv):
+        self.fault = fault
+        self.kv = kv
+        self.fired = 0
+
+    def rank_matches(self):
+        r = self.kv.get("rank")
+        return r is None or int(r) == int(telemetry.process_index or 0)
+
+    def __repr__(self):
+        return f"_Rule({self.fault}, {self.kv}, fired={self.fired})"
+
+
+def parse_spec(spec):
+    """Parse an ``SMP_CHAOS`` spec string into rules. Malformed rules are
+    skipped with a warning — a typo in a chaos spec must degrade to "no
+    fault", never crash the training run it was meant to probe."""
+    rules = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        fault, _, args = raw.partition("@")
+        fault = fault.strip()
+        if fault not in _KNOWN_FAULTS:
+            logger.warning(
+                "%s: unknown fault %r in rule %r (known: %s); skipping.",
+                CHAOS_ENV, fault, raw, ", ".join(_KNOWN_FAULTS),
+            )
+            continue
+        kv = {}
+        ok = True
+        for part in filter(None, args.split(":")):
+            k, sep, v = part.partition("=")
+            if not sep or not k or not v:
+                logger.warning(
+                    "%s: malformed argument %r in rule %r; skipping rule.",
+                    CHAOS_ENV, part, raw,
+                )
+                ok = False
+                break
+            k, v = k.strip(), v.strip()
+            conv = _NUMERIC_KEYS.get(k)
+            if conv is not None:
+                try:
+                    conv(v)
+                except ValueError:
+                    logger.warning(
+                        "%s: non-numeric %s=%r in rule %r; skipping rule.",
+                        CHAOS_ENV, k, v, raw,
+                    )
+                    ok = False
+                    break
+            kv[k] = v
+        if ok:
+            rules.append(_Rule(fault, kv))
+    return rules
+
+
+class ChaosInjector:
+    """Singleton switchboard; seams call the ``on_*`` hooks.
+
+    The spec is re-read lazily (one env lookup + string compare per seam
+    call) so tests and operators can arm/disarm faults mid-process; rule
+    fire-counters and the bus-send ordinal reset when the spec changes.
+    """
+
+    def __init__(self):
+        self._spec = ""
+        self._rules = []
+        self._bus_send_ordinal = 0
+
+    def _sync(self):
+        spec = os.environ.get(CHAOS_ENV, "")
+        if spec != self._spec:
+            self._spec = spec
+            self._rules = parse_spec(spec) if spec else []
+            self._bus_send_ordinal = 0
+            if self._rules:
+                logger.warning(
+                    "chaos harness ARMED: %d rule(s) from %s=%r",
+                    len(self._rules), CHAOS_ENV, spec,
+                )
+        return self._rules
+
+    @property
+    def enabled(self):
+        return bool(self._sync())
+
+    @property
+    def rules(self):
+        return list(self._sync())
+
+    # -- seams ----------------------------------------------------------
+
+    def on_step_edge(self, step):
+        """step.py seam: called once per completed step with the step
+        count. May deliver SIGTERM to this process (rule ``sigterm``)."""
+        if not os.environ.get(CHAOS_ENV):
+            return
+        for r in self._sync():
+            if (
+                r.fault == "sigterm"
+                and not r.fired
+                and r.rank_matches()
+                and int(r.kv.get("step", -1)) == int(step)
+            ):
+                r.fired += 1
+                record_chaos("sigterm", f"step={step}")
+                logger.warning(
+                    "chaos: delivering SIGTERM to pid %d at step %s",
+                    os.getpid(), step,
+                )
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    def on_bus_send(self, dest):
+        """native.py seam: called once per bus send (consumes one send
+        ordinal). Returns ``"drop"`` (silently discard the payload),
+        ``"error"`` (force the enqueue to fail) or None (send normally)."""
+        if not os.environ.get(CHAOS_ENV):
+            return None
+        rules = self._sync()
+        ordinal = self._bus_send_ordinal
+        self._bus_send_ordinal += 1
+        for r in rules:
+            if r.fault not in ("bus_drop", "bus_error") or r.fired:
+                continue
+            if not r.rank_matches():
+                continue
+            if int(r.kv.get("seq", -1)) != ordinal:
+                continue
+            if "dest" in r.kv and int(r.kv["dest"]) != int(dest):
+                continue
+            r.fired += 1
+            record_chaos(r.fault, f"dest={dest} seq={ordinal}")
+            logger.warning(
+                "chaos: %s of bus send #%d to process %d",
+                r.fault, ordinal, dest,
+            )
+            return "drop" if r.fault == "bus_drop" else "error"
+        return None
+
+    def on_collective(self, op, group_name):
+        """collectives.py seam: called before a host collective executes.
+        May sleep (rule ``delay_collective``) to manufacture a straggler."""
+        if not os.environ.get(CHAOS_ENV):
+            return
+        for r in self._sync():
+            if r.fault != "delay_collective" or not r.rank_matches():
+                continue
+            count = int(r.kv.get("count", 0) or 0)
+            if count and r.fired >= count:
+                continue
+            g = r.kv.get("group")
+            if g and not str(group_name).lower().startswith(g.lower()):
+                continue
+            ms = float(r.kv.get("ms", 0))
+            if ms <= 0:
+                continue
+            r.fired += 1
+            record_chaos("delay_collective", f"op={op} group={group_name}")
+            time.sleep(ms / 1000.0)
+
+    def reset(self):
+        """Testing hook: forget the cached spec, counters and ordinals."""
+        self._spec = ""
+        self._rules = []
+        self._bus_send_ordinal = 0
+
+
+chaos = ChaosInjector()

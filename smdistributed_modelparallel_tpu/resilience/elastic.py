@@ -1,0 +1,124 @@
+"""Elastic topology reshard-on-resume.
+
+The reference library welds a partial checkpoint to the exact degree
+layout it was saved under: ``verify_smp_config`` hard-fails on any
+mismatch (reference ``torch/checkpoint.py:381+,487+``), because its
+per-rank files hold rank-local *tensor fragments* whose meaning depends on
+the saved (pp, tp, rdp) assignment. Under this framework's SPMD design
+that weld is unnecessary — GSPMD-style sharding is an *annotation*, not a
+data layout:
+
+- parameter/optimizer trees have topology-invariant structure and logical
+  shapes (pipeline stages shard the stacked layer axis over ``pp``; TP
+  shards inner dims; ZeRO adds an ``rdp`` axis — all PartitionSpecs over
+  the same logical arrays, see ``parallel/zero.py``);
+- shard checkpoint files (``shard_io.py``) key every piece by logical
+  path + **global element bounds**, not by rank coordinates.
+
+So a checkpoint saved under (pp=2, tp=1) is, byte-for-byte, a catalog of
+logical array regions — and resuming under (pp=1, tp=2) (or plain dp, or a
+different world size) is exactly the existing
+``ShardCatalog.load_tree``: each resuming process assembles the pieces
+overlapping *its* addressable shards under the *new* mesh's shardings.
+
+This module supplies the policy layer ``resume_from_checkpoint`` uses to
+downgrade the reference's fatal mismatch into that reshard: classify the
+mismatches, verify the checkpoint format can reshard, log/record the
+transition. Genuine incompatibilities still fail loudly — at assembly
+time, with the missing key/region named — rather than silently loading
+garbage.
+"""
+
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import (
+    record_elastic_resume,
+)
+
+logger = get_logger()
+
+# Degree/layout keys: a mismatch here changes WHERE state lives (which is
+# exactly what the reshard path re-derives from the new topology).
+LAYOUT_KEYS = (
+    "pipeline_parallel_degree",
+    "tensor_parallel_degree",
+    "sharded_data_parallel_degree",
+    "shard_optimizer_state",
+)
+
+# Soft keys: verified by the reference because its runtime couples them to
+# the saved partition; here they affect neither tree structure nor logical
+# shapes, so a mismatch is informational.
+SOFT_KEYS = (
+    "microbatches",
+    "optimize",
+    "prescaled_batch",
+    # Writer census (checkpoint.py snapshot, not a user config key): a
+    # different world size is the NORMAL elastic case, and the count's
+    # real consumer is the shard-file completeness check, not layout.
+    "num_processes",
+)
+
+
+def classify_mismatches(saved, current):
+    """Split saved-vs-current config mismatches into (layout, soft, other)
+    dicts of ``key -> (saved_value, current_value)``."""
+    layout, soft, other = {}, {}, {}
+    keys = set(saved) | set(current)
+    for k in keys:
+        if k not in saved or k not in current:
+            continue
+        if saved[k] == current[k]:
+            continue
+        entry = (saved[k], current[k])
+        if k in LAYOUT_KEYS:
+            layout[k] = entry
+        elif k in SOFT_KEYS:
+            soft[k] = entry
+        else:
+            other[k] = entry
+    return layout, soft, other
+
+
+def begin_elastic_resume(saved_cfg, current_cfg, shard_format, what=""):
+    """Authorize a topology-mismatched resume.
+
+    Called by ``resume_from_checkpoint`` when ``verify_smp_config`` would
+    have raised. Validates that the checkpoint format supports resharding
+    (per-leaf shard catalogs, or a full gathered state dict — both are
+    logical-layout representations), then logs and records the transition.
+    Raises ``SMPValidationError`` only when the format genuinely cannot
+    reshard (the legacy rank-coordinate pickle layout).
+    """
+    from smdistributed_modelparallel_tpu.parallel.zero import (
+        describe_state_layout,
+    )
+    from smdistributed_modelparallel_tpu.utils.exceptions import (
+        SMPValidationError,
+    )
+
+    layout, soft, other = classify_mismatches(saved_cfg, current_cfg)
+    if not shard_format:
+        raise SMPValidationError(
+            "Elastic resume needs a reshardable checkpoint format (per-leaf "
+            "shard catalogs or a full gathered state dict); this checkpoint "
+            "uses the legacy per-rank pickle layout, whose fragments are "
+            f"welded to the saved topology. Mismatches: {dict(layout, **soft)}"
+        )
+    saved_layout = describe_state_layout(saved_cfg)
+    live_layout = describe_state_layout(current_cfg)
+    detail = f"layout={layout} soft={soft}"
+    logger.warning(
+        "ELASTIC RESUME %s: checkpoint topology differs from the live "
+        "config — resharding per-leaf from logical bounds. Degree/layout "
+        "mismatches: %s; soft mismatches: %s; optimizer-state layout: "
+        "%s -> %s.",
+        what, layout or "{}", soft or "{}", saved_layout, live_layout,
+    )
+    if other:
+        logger.warning(
+            "elastic resume: non-topology config keys also differ (not "
+            "verified, not resharded — make sure this is intended): %s",
+            other,
+        )
+    record_elastic_resume(len(layout), len(soft), detail=detail)
+    return layout, soft

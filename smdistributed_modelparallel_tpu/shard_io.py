@@ -148,6 +148,112 @@ class _CatalogBase:
             )
         return out
 
+    def coverage(self):
+        """Metadata-only coverage report: ``{key: (covered, total)}``
+        element counts per logical array, computed from the stored bounds
+        without decompressing any piece. Checkpoint payloads store each
+        global element exactly once (replica-0 dedupe), so
+        ``covered < total`` means an interior hole (a rank's file never
+        landed / is absent from this filesystem) and ``covered > total``
+        means overlapping pieces (mixed checkpoints in one directory).
+        The global extent is inferred as the max stored stop per dim, so a
+        missing TAIL (beyond every stored bound) is undetectable here and
+        is instead caught at ``assemble`` time against the target shape.
+        Full (unbounded) pieces trivially cover their array."""
+        out = {}
+        for key, entries in self.entries.items():
+            if any(b is None for _, _, b in entries):
+                out[key] = (1, 1)
+                continue
+            ndim = max(len(b) for _, _, b in entries)
+            dims = [0] * ndim
+            vol = 0
+            for _, _, bounds in entries:
+                for i, (_, stop) in enumerate(bounds):
+                    dims[i] = max(dims[i], stop)
+                v = 1
+                for a, b in bounds:
+                    v *= b - a
+                vol += v
+            total = 1
+            for d in dims:
+                total *= d
+            out[key] = (vol, total)
+        return out
+
+    def verify_complete(self, what="checkpoint", expected_files=None):
+        """Raise (before any deferred load is stashed) when coverage is
+        wrong — resume-time is the moment to learn a peer's shard file is
+        missing, not the first training step.
+
+        Overlaps are as fatal as gaps: checkpoint payloads are disjoint by
+        construction (replica-0 dedupe), so overlapping pieces mean mixed
+        checkpoints in one directory — and because coverage is a volume
+        SUM, an undetected overlap could exactly cancel a gap elsewhere,
+        letting assembly fill that region with whichever save's bytes it
+        read last.
+
+        ``expected_files`` (the writer-process census saved in
+        ``smp_config.pt``) closes the one hole bounds coverage has: a
+        missing TAIL shard file shrinks the inferred global extent instead
+        of showing a gap, so only the file count can prove it absent."""
+        if expected_files is not None:
+            nfiles = len(getattr(self, "paths", ()))
+            if nfiles < expected_files:
+                raise SMPRuntimeError(
+                    f"{what}: found {nfiles} shard file(s) but the "
+                    f"checkpoint was written by {expected_files} "
+                    "process(es) — a peer's file is missing (never landed "
+                    "on this filesystem, or lost). Bounds coverage cannot "
+                    "see a missing tail shard, so the file census is "
+                    "authoritative."
+                )
+        cov = self.coverage()
+        bad = {k: c for k, c in cov.items() if c[0] != c[1]}
+        # Duplicate bounds are overlap evidence even when the volume sum
+        # balances (a duplicated piece can exactly cancel a gap in the
+        # SAME key): two saves under the same sharding produce identical
+        # bounds, which is the realistic mixed-checkpoint signature.
+        dup = set()
+        for key, entries in self.entries.items():
+            seen = set()
+            for _, _, bounds in entries:
+                if bounds is None:
+                    # 'full' pieces are replicated by design: shard_payload
+                    # writes non-jax.Array leaves whole into EVERY
+                    # process's file (no replica-0 dedupe on that branch),
+                    # so N identical full entries are a healthy
+                    # multiprocess checkpoint, not an overlap.
+                    continue
+                sig = tuple(map(tuple, bounds))
+                if sig in seen:
+                    dup.add(key)
+                    break
+                seen.add(sig)
+        for k in dup:
+            bad.setdefault(k, (cov[k][0] + 1, cov[k][1]))
+        if bad:
+            gaps = sorted(k for k, c in bad.items() if c[0] < c[1])
+            overlaps = sorted(k for k, c in bad.items() if c[0] > c[1])
+            parts = []
+            if gaps:
+                parts.append(
+                    "missing pieces (a rank's shard file is absent or was "
+                    "never written) for: " + ", ".join(
+                        f"'{k}' ({cov[k][0]}/{cov[k][1]} elements)"
+                        for k in gaps
+                    )
+                )
+            if overlaps:
+                parts.append(
+                    "overlapping pieces (mixed checkpoints in one "
+                    "directory?) for: " + ", ".join(
+                        f"'{k}' ({cov[k][0]}/{cov[k][1]} elements)"
+                        for k in overlaps
+                    )
+                )
+            raise SMPRuntimeError(f"{what}: " + "; ".join(parts))
+
     def load_tree(self, target_tree, shardings):
         """Build jax.Arrays matching ``target_tree``'s structure/shapes,
         sharded per ``shardings``; each process reads only the pieces its

@@ -42,6 +42,8 @@ from smdistributed_modelparallel_tpu.backend.split import (
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.model import DistributedModel
 from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
+from smdistributed_modelparallel_tpu.resilience.chaos import chaos
+from smdistributed_modelparallel_tpu.resilience.preemption import preemption
 from smdistributed_modelparallel_tpu.utils import health
 from smdistributed_modelparallel_tpu.utils.exceptions import StepUsageError
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
@@ -140,6 +142,14 @@ class StepFunction:
 
         record_device_memory_telemetry()
         state.step_count += 1
+        # Step edge: the only point where every rank is at a known,
+        # identical position in the program — chaos faults land here
+        # deterministically, and a pending preemption (SIGTERM, sentinel
+        # file, peer notice) turns into the coordinated emergency
+        # checkpoint before the next step's work begins. Both are
+        # single-flag no-ops when disarmed.
+        chaos.on_step_edge(state.step_count)
+        preemption.maybe_emergency_save()
         return StepOutput(outputs)
 
     # ------------------------------------------------------------------

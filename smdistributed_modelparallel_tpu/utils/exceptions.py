@@ -68,6 +68,19 @@ class SMPWatchdogTimeout(SMPRuntimeError):
     SMP_WATCHDOG_TIMEOUT; diagnostics were dumped (utils/telemetry.py)."""
 
 
+class SMPPeerLost(SMPRuntimeError):
+    """A native-bus peer is unreachable: the send path exhausted its
+    bounded retry/backoff budget (``SMP_BUS_SEND_RETRIES``,
+    ``backend/native.py``). Carries ``peer`` (process index) so recovery
+    logic can exclude the dead rank instead of parsing the message."""
+
+    def __init__(self, peer, message=None):
+        self.peer = int(peer)
+        super().__init__(
+            message or f"native-bus peer (process {peer}) is unreachable."
+        )
+
+
 class DelayedParamError(SMPRuntimeError):
     """Materialization of delayed-initialized parameters failed."""
 

@@ -66,6 +66,8 @@ STEP = "step"
 COMPILE = "compile"
 WATCHDOG = "watchdog"
 HEALTH = "health"
+PREEMPT = "preempt"
+CHAOS = "chaos"
 
 # Field names per kind, applied at dump time (the ring stores bare
 # tuples). Keeping the schema here — not at the record sites — is what
@@ -80,6 +82,8 @@ _FIELDS = {
     COMPILE: ("event", "name", "elapsed_us"),
     WATCHDOG: ("reason",),
     HEALTH: ("event", "tag", "step", "value", "microbatch"),
+    PREEMPT: ("event", "step", "detail"),
+    CHAOS: ("fault", "detail"),
 }
 
 
@@ -206,6 +210,20 @@ class FlightRecorder:
             return
         self.record(HEALTH, event, str(tag), int(step), float(value),
                     int(microbatch))
+
+    def record_preempt(self, event, step=-1, detail=""):
+        """Resilience events (resilience/): preemption request/rendezvous/
+        emergency-save edges and elastic-resume markers."""
+        if not self.enabled:
+            return
+        self.record(PREEMPT, event, int(step), str(detail))
+
+    def record_chaos(self, fault, detail=""):
+        """An injected fault (resilience/chaos.py) — so post-mortem rings
+        distinguish synthetic failures from real ones."""
+        if not self.enabled:
+            return
+        self.record(CHAOS, str(fault), str(detail))
 
     # -- export ---------------------------------------------------------
 

@@ -693,6 +693,43 @@ def record_oom(name):
     _flight().record_health("oom", str(name))
 
 
+def record_preemption(event, step=-1, detail=""):
+    """Preemption lifecycle (resilience/preemption.py): ``requested`` when
+    the signal/sentinel fires, ``rendezvous``/``saved``/``failed`` along
+    the emergency-checkpoint path."""
+    telemetry.counter(
+        "smp_preemption_total", "preemption lifecycle events"
+    ).labels(event=event).inc()
+    _flight().record_preempt(event, step=step, detail=detail)
+
+
+def record_chaos(fault, detail=""):
+    """One injected fault (resilience/chaos.py) — counted and ring-recorded
+    so a post-mortem always shows which failures were synthetic."""
+    telemetry.counter(
+        "smp_chaos_injected_total", "chaos faults injected"
+    ).labels(fault=fault).inc()
+    _flight().record_chaos(fault, detail)
+
+
+def record_elastic_resume(n_layout, n_soft, detail=""):
+    """One elastic (topology-mismatched) checkpoint resume
+    (resilience/elastic.py): counts of layout-relevant and soft config
+    mismatches that were downgraded from fatal to a reshard."""
+    telemetry.counter(
+        "smp_elastic_resume_total", "elastic reshard-on-resume events"
+    ).inc()
+    telemetry.gauge(
+        "smp_elastic_resume_mismatches",
+        "config mismatches downgraded by the last elastic resume",
+    ).labels(kind="layout").set(n_layout)
+    telemetry.gauge(
+        "smp_elastic_resume_mismatches",
+        "config mismatches downgraded by the last elastic resume",
+    ).labels(kind="soft").set(n_soft)
+    _flight().record_preempt("elastic_resume", detail=detail)
+
+
 def _atexit_dump():  # pragma: no cover - exercised via subprocess test
     try:
         # An empty registry must not clobber the dump smp.shutdown already

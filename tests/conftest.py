@@ -69,6 +69,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavy multi-compile tests (deselect with -m 'not slow')"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (SIGTERM/bus faults via SMP_CHAOS); "
+        "run with -m chaos",
+    )
 
 
 # Known-heavy tests (>=10s single-core, dominated by XLA pipeline compiles),

@@ -32,6 +32,16 @@ def _worker(rank, world, coord_port, ckpt_dir, conn):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        # The CPU backend's cross-process collectives default to "none",
+        # which makes ANY multi-process jit (even multihost_utils'
+        # process_allgather) fail with "Multiprocess computations aren't
+        # implemented on the CPU backend" — gloo is compiled into this
+        # jaxlib and turns them on. Async dispatch must go with it: two
+        # in-flight executables can issue their gloo ops in different
+        # orders on different ranks, which tears the transport
+        # (gloo::EnforceNotMet preamble.length mismatches).
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
         jax.distributed.initialize(
             coordinator_address=f"127.0.0.1:{coord_port}",
             num_processes=world,
@@ -108,7 +118,9 @@ def _run_world(coord_port, world=2, target=None, extra_args=()):
             procs.append(p)
         results = []
         for rank, (parent, p) in enumerate(zip(parents, procs)):
-            assert parent.poll(300), "worker timed out"
+            # 420s: the elastic-resume leg adds one more step compile per
+            # worker on this compile-bound CPU image.
+            assert parent.poll(420), "worker timed out"
             try:
                 results.append(parent.recv())
             except EOFError:
@@ -191,6 +203,39 @@ def _ckpt_body(rank, world, ckpt_dir):
     f_restored = fingerprint()
     np.testing.assert_allclose(f_restored, f_saved, rtol=1e-6)
 
+    # Elastic leg: re-initialize the SAME 2-process world as plain dp
+    # (tp 2 -> 1) and resume the tp2-saved checkpoint — the reshard path
+    # reassembles each leaf across BOTH processes' shard files under the
+    # new mesh (tests/test_resilience.py covers the single-process matrix;
+    # this is the true multi-process case). Values are compared by the
+    # same jit fingerprint as above: state_dict() would gather
+    # non-addressable shards in a multi-process world.
+    smp.init({"ddp": True, "microbatches": 1})
+    model2 = smp.DistributedModel(TransformerLM(
+        vocab_size=16, max_len=8, d_model=8, n_layers=1, n_heads=2,
+    ))
+
+    @smp.step
+    def fwd_step(model, ids):
+        logits = model(ids)
+        loss = jnp.mean(logits.astype(jnp.float32) ** 2)
+        model.backward(loss)
+        return loss
+
+    smp.resume_from_checkpoint(ckpt_dir, partial=True,
+                               load_optimizer=False)
+    fwd_step(model2, ids)  # materializes params -> deferred elastic apply
+
+    def fingerprint2():
+        with jax.set_mesh(state.mesh):
+            s = jax.jit(lambda p: sum(
+                jnp.sum(jnp.abs(l)) for l in jax.tree_util.tree_leaves(p)
+            ))(model2.params)
+        return float(jax.device_get(s))
+
+    np.testing.assert_allclose(fingerprint2(), f_saved, rtol=1e-6)
+    smp.barrier()
+
 
 def _worker_subgroup(rank, world, coord_port, conn):
     try:
@@ -200,6 +245,8 @@ def _worker_subgroup(rank, world, coord_port, conn):
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
         jax.distributed.initialize(
             coordinator_address=f"127.0.0.1:{coord_port}",
             num_processes=world,

@@ -1,0 +1,997 @@
+"""Resilience subsystem tests: preemption-aware emergency checkpointing,
+elastic topology reshard-on-resume, chaos fault injection, native-bus
+hardening, checkpoint crash hygiene, and shutdown ordering.
+
+The chaos-marked end-to-end test (SIGTERM a real training process, resume
+from its emergency checkpoint) lives in the slow tier; everything else is
+tier-1 and compile-free except the elastic round trip, which is the PR's
+acceptance criterion and stays fast-tier on a tiny model.
+"""
+
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.resilience.chaos import chaos, parse_spec
+from smdistributed_modelparallel_tpu.resilience.elastic import (
+    classify_mismatches,
+)
+from smdistributed_modelparallel_tpu.resilience.preemption import preemption
+from smdistributed_modelparallel_tpu.utils.exceptions import (
+    SMPPeerLost,
+    SMPValidationError,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _metric_value(name, **labels):
+    fam = smp.telemetry.report()["metrics"].get(name)
+    if fam is None:
+        return 0.0
+    for s in fam["series"]:
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return 0.0
+
+
+def _ring_events(kind):
+    return [e for e in smp.flight_recorder.snapshot() if e["kind"] == kind]
+
+
+# ----------------------------------------------------------------------
+# Chaos spec / injector
+# ----------------------------------------------------------------------
+
+
+class TestChaosSpec:
+    def test_parse_rules(self):
+        rules = parse_spec(
+            "sigterm@step=3:rank=0, bus_drop@seq=5:dest=1,"
+            "delay_collective@group=pp:ms=200:count=2"
+        )
+        assert [r.fault for r in rules] == [
+            "sigterm", "bus_drop", "delay_collective"
+        ]
+        assert rules[0].kv == {"step": "3", "rank": "0"}
+        assert rules[1].kv == {"seq": "5", "dest": "1"}
+        assert rules[2].kv == {"group": "pp", "ms": "200", "count": "2"}
+
+    def test_malformed_rules_skipped_not_fatal(self):
+        rules = parse_spec("bogus@x=1,sigterm@step,sigterm@step=2")
+        assert len(rules) == 1 and rules[0].kv == {"step": "2"}
+
+    def test_non_numeric_values_skipped_at_parse_time(self, monkeypatch):
+        """A numeric-key typo must degrade to no-fault at PARSE time, not
+        ValueError at a seam mid-run."""
+        rules = parse_spec(
+            "sigterm@step=three,bus_drop@seq=x,delay_collective@group=pp"
+            ":ms=fast,sigterm@step=4"
+        )
+        assert len(rules) == 1 and rules[0].kv == {"step": "4"}
+        # And the armed seams survive a fully-bad spec.
+        monkeypatch.setenv("SMP_CHAOS", "sigterm@step=three:rank=x")
+        chaos.reset()
+        chaos.on_step_edge(3)          # no raise, no signal
+        assert chaos.on_bus_send(0) is None
+
+    def test_disarmed_is_noop(self, monkeypatch):
+        monkeypatch.delenv("SMP_CHAOS", raising=False)
+        chaos.reset()
+        assert not chaos.enabled
+        assert chaos.on_bus_send(0) is None
+        chaos.on_step_edge(3)  # must not raise / signal
+
+    def test_rank_filter(self, monkeypatch):
+        monkeypatch.setenv("SMP_CHAOS", "bus_drop@seq=0:rank=7")
+        chaos.reset()
+        # This process is rank 0 (or None): rule must not fire.
+        assert chaos.on_bus_send(0) is None
+
+    def test_spec_change_rearms(self, monkeypatch):
+        monkeypatch.setenv("SMP_CHAOS", "bus_drop@seq=0")
+        chaos.reset()
+        assert chaos.on_bus_send(0) == "drop"
+        assert chaos.on_bus_send(0) is None  # one-shot
+        monkeypatch.setenv("SMP_CHAOS", "bus_drop@seq=1")
+        assert chaos.on_bus_send(0) is None   # ordinal 0 after re-arm
+        assert chaos.on_bus_send(0) == "drop"
+
+    def test_delay_collective_sleeps_and_counts(self, monkeypatch):
+        monkeypatch.setenv(
+            "SMP_CHAOS", "delay_collective@group=pp:ms=30:count=1"
+        )
+        chaos.reset()
+        before = _metric_value(
+            "smp_chaos_injected_total", fault="delay_collective"
+        )
+        t0 = time.perf_counter()
+        chaos.on_collective("barrier", "PP_GROUP")
+        elapsed = time.perf_counter() - t0
+        assert elapsed >= 0.025
+        chaos.on_collective("barrier", "WORLD")     # group mismatch
+        chaos.on_collective("barrier", "PP_GROUP")  # count exhausted
+        after = _metric_value(
+            "smp_chaos_injected_total", fault="delay_collective"
+        )
+        assert after == before + 1
+
+    def test_sigterm_rule_fires_once_at_step(self, monkeypatch):
+        """In-process: the injected SIGTERM lands in the preemption
+        listener's deferred handler, not the default (fatal) one."""
+        smp.shutdown()
+        smp.init({"microbatches": 1})  # installs the listener
+        preemption.reset()
+        monkeypatch.setenv("SMP_CHAOS", "sigterm@step=2")
+        chaos.reset()
+        chaos.on_step_edge(1)
+        assert preemption.check() is None
+        chaos.on_step_edge(2)
+        assert preemption.check() == "sigterm"
+        preemption.reset()
+        chaos.on_step_edge(2)  # one-shot: does not re-fire
+        assert preemption.check() is None
+
+
+# ----------------------------------------------------------------------
+# Native bus hardening
+# ----------------------------------------------------------------------
+
+
+class _FakeLib:
+    """smp_async_send stub: fails the first ``fail`` calls with ``rc``
+    (default -2 = dead link), then succeeds."""
+
+    def __init__(self, fail=0, rc=-2):
+        self.fail = fail
+        self.rc = rc
+        self.calls = []
+
+    def smp_async_send(self, dest, payload, n, tx):
+        self.calls.append((dest, tx))
+        return self.rc if len(self.calls) <= self.fail else 0
+
+
+def _bus(lib):
+    from smdistributed_modelparallel_tpu.backend.native import MessageBus
+
+    return MessageBus(lib)
+
+
+class TestBusSendHardening:
+    def test_transient_failure_retries_then_succeeds(self, monkeypatch):
+        monkeypatch.delenv("SMP_CHAOS", raising=False)
+        monkeypatch.setenv("SMP_BUS_SEND_RETRIES", "3")
+        chaos.reset()
+        lib = _FakeLib(fail=2)
+        _bus(lib).send_bytes(1, b"x", 7)
+        assert len(lib.calls) == 3  # 2 failures + 1 success
+
+    def test_exhausted_retries_raise_structured_peer_lost(self, monkeypatch):
+        monkeypatch.delenv("SMP_CHAOS", raising=False)
+        monkeypatch.setenv("SMP_BUS_SEND_RETRIES", "2")
+        chaos.reset()
+        lib = _FakeLib(fail=99)
+        with pytest.raises(SMPPeerLost) as exc:
+            _bus(lib).send_bytes(3, b"x", 7)
+        assert exc.value.peer == 3
+        assert len(lib.calls) == 3  # initial + 2 retries, then typed failure
+
+    def test_local_misuse_raises_oserror_without_retry(self, monkeypatch):
+        """rc=-1 (not connected / bad dest) is permanent caller misuse:
+        no retry burn, and the plain OSError existing callers handle."""
+        monkeypatch.delenv("SMP_CHAOS", raising=False)
+        monkeypatch.setenv("SMP_BUS_SEND_RETRIES", "3")
+        chaos.reset()
+        lib = _FakeLib(fail=99, rc=-1)
+        with pytest.raises(OSError):
+            _bus(lib).send_bytes(1, b"x", 7)
+        assert len(lib.calls) == 1
+
+    def test_malformed_retry_env_falls_back_to_default(self, monkeypatch):
+        monkeypatch.delenv("SMP_CHAOS", raising=False)
+        monkeypatch.setenv("SMP_BUS_SEND_RETRIES", "3s")
+        chaos.reset()
+        lib = _FakeLib(fail=99)
+        with pytest.raises(SMPPeerLost):
+            _bus(lib).send_bytes(1, b"x", 7)
+        assert len(lib.calls) == 4  # default budget (3), not a ValueError
+
+    def test_chaos_bus_drop_never_reaches_the_wire(self, monkeypatch):
+        monkeypatch.setenv("SMP_CHAOS", "bus_drop@seq=0")
+        chaos.reset()
+        lib = _FakeLib()
+        _bus(lib).send_bytes(1, b"x", 7)  # silently dropped
+        assert lib.calls == []
+        assert _metric_value("smp_chaos_injected_total", fault="bus_drop") >= 1
+
+    def test_chaos_bus_error_exercises_retry_path(self, monkeypatch):
+        monkeypatch.setenv("SMP_CHAOS", "bus_error@seq=0")
+        monkeypatch.setenv("SMP_BUS_SEND_RETRIES", "2")
+        chaos.reset()
+        lib = _FakeLib()  # healthy lib: only the injected failure
+        _bus(lib).send_bytes(1, b"x", 7)
+        assert len(lib.calls) == 1  # attempt 0 injected, attempt 1 real
+
+
+# ----------------------------------------------------------------------
+# Checkpoint crash hygiene (GC of orphaned uncommitted dirs)
+# ----------------------------------------------------------------------
+
+
+class TestCheckpointGcHygiene:
+    def _mkdir(self, root, name, markers=(), age_s=0.0):
+        """markers: subset of {"committed", "inflight"} — none = a legacy
+        dir saved by a pre-marker version."""
+        d = root / name
+        d.mkdir()
+        (d / "model_shards_p0.npz").write_bytes(b"")
+        if "committed" in markers:
+            (d / ".committed").write_text(name)
+        if "inflight" in markers:
+            (d / ".inflight").write_text(name)
+        if age_s:
+            old = time.time() - age_s
+            os.utime(d, (old, old))
+        return d
+
+    def test_stale_interrupted_swept_fresh_kept(self, tmp_path, monkeypatch):
+        from smdistributed_modelparallel_tpu.checkpoint import (
+            _gc_partial_checkpoints,
+        )
+
+        monkeypatch.setenv("SMP_CKPT_COMMIT_TIMEOUT", "100")
+        self._mkdir(tmp_path, "dead_partial", markers=("inflight",),
+                    age_s=1000)
+        self._mkdir(tmp_path, "inflight_partial", markers=("inflight",),
+                    age_s=1)
+        for i in range(3):
+            self._mkdir(tmp_path, f"t{i}_partial", markers=("committed",),
+                        age_s=500 - i)
+        _gc_partial_checkpoints(str(tmp_path), keep=2)
+        left = sorted(d.name for d in tmp_path.iterdir())
+        # Stale interrupted save swept; young in-flight kept; retention
+        # keeps the newest 2 committed dirs and is NOT confused by the
+        # uncommitted ones.
+        assert left == ["inflight_partial", "t1_partial", "t2_partial"]
+
+    def test_retention_counts_only_committed(self, tmp_path, monkeypatch):
+        from smdistributed_modelparallel_tpu.checkpoint import (
+            _gc_partial_checkpoints,
+        )
+
+        monkeypatch.setenv("SMP_CKPT_COMMIT_TIMEOUT", "3600")
+        # 2 committed + 2 young in-flight: with keep=2 both committed
+        # dirs survive — in-flight dirs must not occupy retention slots.
+        self._mkdir(tmp_path, "u0_partial", markers=("inflight",), age_s=10)
+        self._mkdir(tmp_path, "u1_partial", markers=("inflight",), age_s=5)
+        self._mkdir(tmp_path, "c0_partial", markers=("committed",), age_s=100)
+        self._mkdir(tmp_path, "c1_partial", markers=("committed",), age_s=50)
+        _gc_partial_checkpoints(str(tmp_path), keep=2)
+        left = sorted(d.name for d in tmp_path.iterdir())
+        assert left == [
+            "c0_partial", "c1_partial", "u0_partial", "u1_partial"
+        ]
+
+    def test_seq_named_inflight_is_orphan_evidence(self, tmp_path,
+                                                   monkeypatch):
+        """The save job stamps seq-NAMED markers (.inflight_s{N}); GC must
+        treat them exactly like the legacy literal .inflight."""
+        from smdistributed_modelparallel_tpu.checkpoint import (
+            _gc_partial_checkpoints,
+        )
+
+        monkeypatch.setenv("SMP_CKPT_COMMIT_TIMEOUT", "100")
+        d = self._mkdir(tmp_path, "dead_partial")
+        (d / ".inflight_s7").write_text("7")
+        old = time.time() - 1000  # re-age AFTER the marker write touched it
+        os.utime(d, (old, old))
+        self._mkdir(tmp_path, "ok_partial", markers=("committed",), age_s=10)
+        _gc_partial_checkpoints(str(tmp_path), keep=2)
+        assert sorted(p.name for p in tmp_path.iterdir()) == ["ok_partial"]
+
+    def test_commit_skips_when_newer_save_inflight(self, tmp_path):
+        """A commit of save N must not publish .committed over shards a
+        queued re-save N+1 has already started overwriting in place — the
+        newer save's own commit will publish (or its crash classifies the
+        dir as an orphan)."""
+        from smdistributed_modelparallel_tpu.checkpoint import (
+            _finish_checkpoint,
+        )
+
+        d = tmp_path / "t_partial"
+        d.mkdir()
+        (d / ".inflight_s1").write_text("1")
+        (d / ".inflight_s2").write_text("2")
+        _finish_checkpoint(str(tmp_path), "t", True, 0, seq=1)
+        assert not (d / ".committed").exists()
+        assert (d / ".inflight_s2").exists()  # newer stamp untouched
+        # `newest` still points at the tag (same tag either way).
+        assert (tmp_path / "newest").read_text() == "t"
+        # The newer save's commit publishes and clears its own stamp.
+        _finish_checkpoint(str(tmp_path), "t", True, 0, seq=2)
+        assert (d / ".committed").exists()
+        assert not (d / ".inflight_s1").exists()
+        assert not (d / ".inflight_s2").exists()
+
+    def test_dead_incarnation_stamp_does_not_block_commit(self, tmp_path):
+        """Save ordinals restart at 0 every process incarnation, so a
+        stale high-seq stamp left by a crashed run must not outrank a
+        fresh re-save's commit (it would block .committed forever while
+        `newest` still moves — resume then refuses a good checkpoint and
+        GC eventually sweeps it)."""
+        from smdistributed_modelparallel_tpu.checkpoint import (
+            _finish_checkpoint,
+        )
+
+        d = tmp_path / "t_partial"
+        d.mkdir()
+        stale = d / ".inflight_s37"
+        stale.write_text("37")
+        old = time.time() - 3600
+        os.utime(stale, (old, old))
+        (d / ".inflight_s2").write_text("2")  # this run's own stamp
+        _finish_checkpoint(str(tmp_path), "t", True, 0, seq=2)
+        assert (d / ".committed").exists()
+        assert not stale.exists()  # dead incarnation's debris swept
+        assert not (d / ".inflight_s2").exists()
+
+    def test_resume_refuses_interrupted_dir(self, tmp_path):
+        """resume_from_checkpoint must refuse a dir whose save was
+        interrupted (in-flight stamp, no .committed): bounds and census
+        cannot detect half-overwritten tensor BYTES."""
+        import smdistributed_modelparallel_tpu as smp
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            SMPRuntimeError,
+        )
+
+        d = tmp_path / "t_partial"
+        d.mkdir()
+        (d / ".inflight_s3").write_text("3")
+        (d / "model_shards_p0.npz").write_bytes(b"")
+        (tmp_path / "newest").write_text("t")
+        with pytest.raises(SMPRuntimeError, match="interrupted mid-save"):
+            smp.resume_from_checkpoint(str(tmp_path))
+
+    def test_legacy_premarker_dirs_never_swept(self, tmp_path, monkeypatch):
+        """Dirs saved before the marker protocol (no .committed AND no
+        .inflight) must count as committed — an upgrade must never sweep
+        previously valid checkpoints as orphans."""
+        from smdistributed_modelparallel_tpu.checkpoint import (
+            _gc_partial_checkpoints,
+        )
+
+        monkeypatch.setenv("SMP_CKPT_COMMIT_TIMEOUT", "100")
+        self._mkdir(tmp_path, "old0_partial", markers=(), age_s=50000)
+        self._mkdir(tmp_path, "old1_partial", markers=(), age_s=40000)
+        self._mkdir(tmp_path, "new_partial", markers=("committed",),
+                    age_s=100)
+        _gc_partial_checkpoints(str(tmp_path), keep=2)
+        left = sorted(d.name for d in tmp_path.iterdir())
+        # Oldest legacy dir rotated out by RETENTION (keep=2), not the
+        # orphan sweep; the newer legacy dir survives as committed.
+        assert left == ["new_partial", "old1_partial"]
+
+    def test_save_checkpoint_stamps_committed_marker(self, tmp_path):
+        smp.shutdown()
+        smp.init({"microbatches": 1})
+        smp.save_checkpoint(str(tmp_path), tag="m1", model=None,
+                            optimizer=None, user_content={"step": 1})
+        assert (tmp_path / "m1_partial" / ".committed").exists()
+        assert (tmp_path / "newest").read_text() == "m1"
+        # All in-flight stamps are cleared by the commit.
+        assert not [
+            n for n in os.listdir(tmp_path / "m1_partial")
+            if n.startswith(".inflight")
+        ]
+
+    def test_resave_sweeps_stale_higher_rank_shards(self, tmp_path):
+        """An elastic re-save of the same tag from a SMALLER world must
+        remove the old world's higher-indexed shard files — stale pieces
+        would make coverage overlap and every later load fail."""
+        smp.shutdown()
+        smp.init({"microbatches": 1})
+        d = tmp_path / "t_partial"
+        d.mkdir()
+        (d / "model_shards_p3.npz").write_bytes(b"stale")
+        (d / "optimizer_shards_p2.npz").write_bytes(b"stale")
+        # Old-topology scaler copies: this save has no scaler, so every
+        # coordinate-named fp16 file is stale (the elastic fallback glob
+        # in resume would otherwise pick one).
+        (d / "fp16_states_1_0_0.pt").write_bytes(b"stale")
+        (d / "fp16_states_0_0.pt").write_bytes(b"stale")  # legacy v2 name
+        smp.save_checkpoint(str(tmp_path), tag="t", model=None,
+                            optimizer=None, user_content={"step": 1})
+        assert not (d / "model_shards_p3.npz").exists()
+        assert not (d / "optimizer_shards_p2.npz").exists()
+        assert not (d / "fp16_states_1_0_0.pt").exists()
+        assert not (d / "fp16_states_0_0.pt").exists()
+        assert (d / ".committed").exists()
+
+
+# ----------------------------------------------------------------------
+# Shutdown ordering: drain async saves BEFORE the observability dumps
+# ----------------------------------------------------------------------
+
+
+class TestShutdownOrdering:
+    def test_drain_runs_before_dumps(self, monkeypatch):
+        import importlib
+
+        ckpt_mod = importlib.import_module(
+            "smdistributed_modelparallel_tpu.checkpoint"
+        )
+        from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+            flight_recorder,
+        )
+        from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+
+        smp.shutdown()
+        smp.init({"microbatches": 1})
+        order = []
+        monkeypatch.setattr(
+            ckpt_mod, "wait_for_checkpoints", lambda: order.append("drain")
+        )
+        monkeypatch.setattr(
+            telemetry, "dump", lambda *a, **k: order.append("telemetry")
+        )
+        monkeypatch.setattr(
+            flight_recorder, "dump", lambda *a, **k: order.append("ring")
+        )
+        state.core.shutdown()
+        assert order == ["drain", "telemetry", "ring"]
+
+    def test_drain_failure_does_not_abort_dumps(self, monkeypatch):
+        import importlib
+
+        ckpt_mod = importlib.import_module(
+            "smdistributed_modelparallel_tpu.checkpoint"
+        )
+        from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+            flight_recorder,
+        )
+        from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+
+        smp.shutdown()
+        smp.init({"microbatches": 1})
+        order = []
+
+        def boom():
+            order.append("drain")
+            raise RuntimeError("saved failed")
+
+        monkeypatch.setattr(ckpt_mod, "wait_for_checkpoints", boom)
+        monkeypatch.setattr(
+            telemetry, "dump", lambda *a, **k: order.append("telemetry")
+        )
+        monkeypatch.setattr(
+            flight_recorder, "dump", lambda *a, **k: order.append("ring")
+        )
+        state.core.shutdown()  # must not raise
+        assert order == ["drain", "telemetry", "ring"]
+
+
+# ----------------------------------------------------------------------
+# Preemption listener + emergency save (model-less fast path)
+# ----------------------------------------------------------------------
+
+
+class TestPreemption:
+    def test_sentinel_file_triggers(self, tmp_path, monkeypatch):
+        smp.shutdown()
+        smp.init({"microbatches": 1})
+        preemption.reset()
+        sentinel = tmp_path / "preempt_me"
+        monkeypatch.setenv("SMP_PREEMPTION_FILE", str(sentinel))
+        assert preemption.check() is None
+        sentinel.touch()
+        assert preemption.check() == "sentinel_file"
+
+    def test_sigterm_is_deferred_not_fatal(self):
+        smp.shutdown()
+        smp.init({"microbatches": 1})
+        preemption.reset()
+        assert preemption._installed
+        os.kill(os.getpid(), signal.SIGTERM)
+        # Survived; the flag flipped instead.
+        assert preemption.check() == "sigterm"
+
+    def test_emergency_save_commits_and_records(self, tmp_path, monkeypatch):
+        smp.shutdown()
+        smp.init({"microbatches": 1})
+        preemption.reset()
+        preemption.exit_after_save = False
+        monkeypatch.setenv("SMP_EMERGENCY_CKPT_PATH", str(tmp_path / "eck"))
+        preemption.trigger("test")
+        out = preemption.maybe_emergency_save()
+        assert out is not None
+        path, tag = out
+        assert (tmp_path / "eck" / f"{tag}_partial" / ".committed").exists()
+        assert (tmp_path / "eck" / "newest").read_text() == tag
+        events = [e["event"] for e in _ring_events("preempt")]
+        assert events[-3:] == ["requested", "rendezvous", "saved"]
+        assert _metric_value("smp_preemption_total", event="saved") == 1
+        # One-shot: the next step edge does nothing.
+        assert preemption.maybe_emergency_save() is None
+
+    def test_rendezvous_skew_defers_to_max_step(self, tmp_path, monkeypatch):
+        """A rank that triggered at an EARLIER step edge than its
+        slowest-to-know peer must not abort (or save mixed-step shards):
+        it defers, keeps training, and writes at the agreed max edge."""
+        smp.shutdown()
+        smp.init({"microbatches": 1})
+        preemption.reset()
+        preemption.exit_after_save = False
+        monkeypatch.setenv("SMP_EMERGENCY_CKPT_PATH", str(tmp_path / "eck"))
+        # Fake a 2-process world whose peer is one step edge ahead (the
+        # rendezvous runs over the host bus; its seam returns the
+        # exchanged per-process step edges).
+        monkeypatch.setattr(preemption, "_world_size", lambda: 2)
+        monkeypatch.setattr(
+            preemption, "_bus_rendezvous",
+            lambda deadline: [state.step_count, state.step_count + 1],
+        )
+        state.step_count = 3
+        preemption.trigger("test")
+        assert preemption.maybe_emergency_save() is None  # deferred
+        assert preemption._save_at_step == 4
+        events = [e["event"] for e in _ring_events("preempt")]
+        assert events[-1] == "deferred"
+        # Still behind the target: edges stay no-ops (no abort loop).
+        assert preemption.maybe_emergency_save() is None
+        assert preemption.emergency_saved is None
+        # Trained to the agreed edge: the deferred shards land and the
+        # checkpoint commits under the TARGET step's tag.
+        state.step_count = 4
+        out = preemption.maybe_emergency_save()
+        assert out is not None
+        path, tag = out
+        assert tag == "preempt_step_4"
+        assert (tmp_path / "eck" / f"{tag}_partial" / ".committed").exists()
+        assert preemption.maybe_emergency_save() is None  # one-shot
+
+    def test_second_sigterm_terminates(self, tmp_path):
+        """Deferral must not swallow TERM forever: a second SIGTERM
+        restores the previous disposition and re-raises, so an insisting
+        sender actually kills the process."""
+        code = (
+            "import os, signal, time\n"
+            "from smdistributed_modelparallel_tpu.resilience.preemption "
+            "import preemption\n"
+            "preemption.install()\n"
+            "os.kill(os.getpid(), signal.SIGTERM)\n"
+            "assert preemption.check() == 'sigterm'\n"
+            "os.kill(os.getpid(), signal.SIGTERM)\n"
+            "time.sleep(5)\n"
+            "raise SystemExit(99)  # unreachable: the 2nd TERM killed us\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], cwd=_REPO,
+            capture_output=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert r.returncode == -signal.SIGTERM, (
+            r.returncode, r.stderr.decode(errors="replace")[-800:],
+        )
+
+    def test_shutdown_uninstalls_sigterm_handler(self):
+        smp.shutdown()
+        smp.init({"microbatches": 1})
+        assert preemption._installed
+        assert signal.getsignal(signal.SIGTERM) == preemption._on_sigterm
+        smp.shutdown()
+        assert not preemption._installed
+        assert signal.getsignal(signal.SIGTERM) != preemption._on_sigterm
+
+    def test_grace_seconds_bounds_commit_timeout(self, tmp_path, monkeypatch):
+        from smdistributed_modelparallel_tpu import checkpoint as _  # noqa: F401
+        import importlib
+
+        ckpt_mod = importlib.import_module(
+            "smdistributed_modelparallel_tpu.checkpoint"
+        )
+
+        smp.shutdown()
+        smp.init({"microbatches": 1})
+        preemption.reset()
+        preemption.exit_after_save = False
+        monkeypatch.setenv("SMP_PREEMPTION_GRACE_SECONDS", "7")
+        seen = {}
+        orig = ckpt_mod.save_checkpoint
+
+        def spy(*a, **k):
+            seen["commit_timeout"] = os.environ.get("SMP_CKPT_COMMIT_TIMEOUT")
+            return orig(*a, **k)
+
+        monkeypatch.setattr(ckpt_mod, "save_checkpoint", spy)
+        preemption.trigger("test")
+        preemption.emergency_save(path=str(tmp_path / "g"), reason="test")
+        # The commit wait gets the REMAINING grace (elapsed since the
+        # trigger already subtracted), floored at 5s.
+        assert 5.0 <= float(seen["commit_timeout"]) <= 7.0
+        # The override is scoped to the emergency save.
+        assert os.environ.get("SMP_CKPT_COMMIT_TIMEOUT") is None
+
+
+# ----------------------------------------------------------------------
+# Elastic reshard-on-resume
+# ----------------------------------------------------------------------
+
+
+TINY = dict(
+    num_layers=2, num_attention_heads=2, attention_head_size=8,
+    hidden_size=16, intermediate_size=32, vocab_size=64, num_positions=32,
+    causal_mask_size=32, pre_layernorm=True, post_layernorm=False,
+    final_layernorm=True, attention_dropout_prob=0.0,
+    hidden_dropout_prob=0.0, embedding_dropout_prob=0.0,
+)
+
+
+def _setup_model(cfg):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+        vocab_parallel_cross_entropy,
+    )
+    from smdistributed_modelparallel_tpu.nn.transformer import (
+        DistributedTransformerLMHead,
+    )
+
+    smp.reset()
+    smp.init(cfg)
+    model = smp.DistributedModel(DistributedTransformerLMHead(**TINY))
+    opt = smp.DistributedOptimizer(optax.adamw(1e-3), model)
+
+    @smp.step
+    def train_step(model, ids):
+        logits = model(ids)
+        loss = jnp.mean(
+            vocab_parallel_cross_entropy(logits[:, :-1], ids[:, 1:])
+        )
+        model.backward(loss)
+        return loss
+
+    ids = jax.random.randint(jax.random.key(0), (4, 16), 0, 64)
+    return model, opt, train_step, ids
+
+
+class TestElasticResume:
+    def test_classify_mismatches(self):
+        layout, soft, other = classify_mismatches(
+            {"pipeline_parallel_degree": 2, "microbatches": 4, "x": 1},
+            {"pipeline_parallel_degree": 1, "microbatches": 2, "x": 2},
+        )
+        assert layout == {"pipeline_parallel_degree": (2, 1)}
+        assert soft == {"microbatches": (4, 2)}
+        assert other == {"x": (1, 2)}
+
+    def test_legacy_layout_cannot_reshard(self, tmp_path):
+        """A gathered-pickle partial dir (legacy layout) under a
+        mismatched topology must still fail loudly — its fragments are
+        welded to the saved rank coordinates."""
+        smp.shutdown()
+        smp.init({"microbatches": 2})
+        d = tmp_path / "old_partial"
+        d.mkdir()
+        with open(d / "smp_config.pt", "wb") as fh:
+            pickle.dump({"tensor_parallel_degree": 4}, fh)
+        with open(d / "model.pt", "wb") as fh:
+            pickle.dump({"w": np.zeros(2)}, fh)
+        with open(d / "user_content.pt", "wb") as fh:
+            pickle.dump(None, fh)
+        with pytest.raises(SMPValidationError):
+            smp.resume_from_checkpoint(str(tmp_path), tag="old")
+
+    def _synthetic_shard_ckpt(self, tmp_path):
+        """A shard-format partial dir whose saved topology (tp=4) cannot
+        match any single-process test config — compile-free mismatch."""
+        d = tmp_path / "s_partial"
+        d.mkdir()
+        np.savez(d / "model_shards_p0.npz",
+                 **{"w|full": np.arange(6, dtype=np.float32)})
+        with open(d / "smp_config.pt", "wb") as fh:
+            pickle.dump({"tensor_parallel_degree": 4,
+                         "pipeline_parallel_degree": 1}, fh)
+        with open(d / "user_content.pt", "wb") as fh:
+            pickle.dump({"epoch": 9}, fh)
+        (tmp_path / "newest").write_text("s")
+
+    def test_elastic_false_restores_fatal_mismatch(self, tmp_path):
+        self._synthetic_shard_ckpt(tmp_path)
+        smp.shutdown()
+        smp.init({"microbatches": 2})
+        with pytest.raises(SMPValidationError):
+            smp.resume_from_checkpoint(str(tmp_path), tag="s", elastic=False)
+
+    def test_coverage_gap_fails_at_resume_not_first_step(self, tmp_path):
+        """A checkpoint missing a rank's shard file must fail AT RESUME
+        with the gap named — not stash a torn catalog for the deferred
+        apply to trip over mid-training."""
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            SMPRuntimeError,
+        )
+
+        d = tmp_path / "torn_partial"
+        d.mkdir()
+        # Rows [0,2) and [4,6) of a [6, 6] array: the middle rank's file
+        # never landed — an interior hole the bounds metadata exposes.
+        np.savez(d / "model_shards_p0.npz", **{
+            "a/w|[[0, 2], [0, 6]]": np.zeros((2, 6), np.float32),
+        })
+        np.savez(d / "model_shards_p2.npz", **{
+            "a/w|[[4, 6], [0, 6]]": np.ones((2, 6), np.float32),
+        })
+        with open(d / "smp_config.pt", "wb") as fh:
+            pickle.dump({"pipeline_parallel_degree": 1,
+                         "tensor_parallel_degree": 1}, fh)
+        with open(d / "user_content.pt", "wb") as fh:
+            pickle.dump(None, fh)
+        smp.shutdown()
+        smp.init({"microbatches": 2})
+        with pytest.raises(SMPRuntimeError, match="a/w"):
+            smp.resume_from_checkpoint(str(tmp_path), tag="torn")
+
+    def test_duplicate_pieces_fail_preflight_even_when_sums_cancel(self):
+        """Mixed-checkpoint overlap must not slip through by volume-sum
+        cancellation: a duplicated piece that exactly offsets a hole in
+        the same key is caught by the duplicate-bounds check."""
+        from smdistributed_modelparallel_tpu.shard_io import InMemoryCatalog
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            SMPRuntimeError,
+        )
+
+        cat = InMemoryCatalog({
+            # [6]-array: [0,2) twice + [4,6) — volume 6 == inferred total
+            # 6, but rows [2,4) are a hole.
+            "w|[[0, 2]]": np.zeros(2, np.float32),
+            "w|[[4, 6]]": np.ones(2, np.float32),
+        })
+        # InMemoryCatalog keys are unique per dict, so inject the
+        # duplicate entry the way two shard FILES would produce it.
+        cat.entries["w"].append((0, "w|[[0, 2]]", [[0, 2]]))
+        with pytest.raises(SMPRuntimeError, match="overlap"):
+            cat.verify_complete(what="mixed")
+
+    def test_elastic_default_downgrades_to_reshard(self, tmp_path):
+        from smdistributed_modelparallel_tpu.shard_io import ShardCatalog
+
+        self._synthetic_shard_ckpt(tmp_path)
+        smp.shutdown()
+        smp.init({"microbatches": 2})
+        user = smp.resume_from_checkpoint(str(tmp_path))  # tag via newest
+        assert user == {"epoch": 9}
+        # No model yet: the catalog is stashed for deferred application.
+        assert isinstance(state.loaded_model_state, ShardCatalog)
+        assert _metric_value("smp_elastic_resume_total") == 1
+        assert any(
+            e["event"] == "elastic_resume" for e in _ring_events("preempt")
+        )
+
+    def test_pp2_checkpoint_resumes_under_tp2_and_dp(self, tmp_path):
+        """The acceptance round trip: save at (pp=2, tp=1), resume at
+        (pp=1, tp=2) and at plain dp — reassembled model AND optimizer
+        trees bitwise-equal to the originals, training continues."""
+        model, opt, step_fn, ids = _setup_model(
+            {"pipeline_parallel_degree": 2, "microbatches": 2}
+        )
+        step_fn(model, ids)
+        opt.step()
+        want = model.state_dict()
+        want_opt = opt.state_dict()
+        smp.save_checkpoint(str(tmp_path), tag="el", model=model,
+                            optimizer=opt)
+
+        for cfg in (
+            {"tensor_parallel_degree": 2, "ddp": True, "microbatches": 2},
+            {"microbatches": 2, "ddp": True},
+        ):
+            model2, opt2, step_fn2, _ = _setup_model(cfg)
+            smp.resume_from_checkpoint(str(tmp_path), tag="el")
+            out = step_fn2(model2, ids)  # materializes -> deferred apply
+            got = model2.state_dict()
+            assert set(got) == set(want)
+            for k in want:
+                np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+            opt2._ensure_state()
+            got_opt = opt2.state_dict()
+            for k in want_opt:
+                np.testing.assert_array_equal(
+                    got_opt[k], want_opt[k], err_msg=k
+                )
+            assert _metric_value("smp_elastic_resume_total") >= 1
+            # Training continues under the new topology.
+            assert np.isfinite(float(out.reduce_mean()))
+            opt2.step()
+
+
+# ----------------------------------------------------------------------
+# resilience_probe CLI
+# ----------------------------------------------------------------------
+
+
+class TestResilienceProbe:
+    def _build(self, root):
+        d = root / "t1_partial"
+        d.mkdir(parents=True)
+        np.savez(d / "model_shards_p0.npz", **{
+            "a/w|[[0, 2], [0, 6]]": np.zeros((2, 6), np.float32),
+            "a/b|full": np.zeros((6,), np.float32),
+        })
+        np.savez(d / "model_shards_p1.npz", **{
+            "a/w|[[2, 4], [0, 6]]": np.ones((2, 6), np.float32),
+        })
+        with open(d / "smp_config.pt", "wb") as fh:
+            pickle.dump({"pipeline_parallel_degree": 2,
+                         "tensor_parallel_degree": 1}, fh)
+        (d / ".committed").write_text("t1")
+        (root / "newest").write_text("t1")
+        # An orphaned (interrupted: .inflight, no .committed) dir with a
+        # coverage gap.
+        d2 = root / "bad_partial"
+        d2.mkdir()
+        np.savez(d2 / "model_shards_p0.npz", **{
+            "a/w|[[0, 2], [0, 6]]": np.zeros((2, 6), np.float32),
+        })
+        (d2 / ".inflight").write_text("bad")
+
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "scripts", "resilience_probe.py"), *args],
+            capture_output=True, text=True, timeout=120,
+        )
+
+    def test_complete_checkpoint_loadable_any_layout(self, tmp_path):
+        self._build(tmp_path)
+        out = self._run(str(tmp_path), "--pp", "4", "--tp", "2", "--json")
+        assert out.returncode == 0, out.stderr
+        import json
+
+        report = json.loads(out.stdout)
+        assert report["loadable"] is True
+        assert report["selected_tag"] == "t1"
+        by_name = {
+            os.path.basename(c["dir"]): c for c in report["checkpoints"]
+        }
+        assert by_name["t1_partial"]["committed"] is True
+        assert by_name["bad_partial"]["committed"] is False
+        assert by_name["t1_partial"]["topology"][
+            "pipeline_parallel_degree"] == 2
+        model = by_name["t1_partial"]["components"]["model"]
+        assert model["keys"] == 2 and not model["incomplete"]
+
+    def test_gap_is_not_loadable(self, tmp_path):
+        self._build(tmp_path)
+        out = self._run(str(tmp_path), "--tag", "bad")
+        assert out.returncode == 2
+        assert "NOT loadable" in out.stdout
+
+    def test_human_output_lists_orphans(self, tmp_path):
+        self._build(tmp_path)
+        out = self._run(str(tmp_path))
+        assert out.returncode == 0
+        assert "ORPHANED" in out.stdout
+        assert "committed" in out.stdout
+
+
+# ----------------------------------------------------------------------
+# Chaos end-to-end: SIGTERM a real training run, resume from the
+# emergency checkpoint (slow tier: two subprocess compiles)
+# ----------------------------------------------------------------------
+
+
+_TRAIN_SCRIPT = r"""
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import jax.numpy as jnp
+import numpy as np
+import optax
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.models.transformer_lm import TransformerLM
+
+smp.init({{"microbatches": 1}})
+model = smp.DistributedModel(TransformerLM(
+    vocab_size=16, max_len=8, d_model=8, n_layers=1, n_heads=2))
+opt = smp.DistributedOptimizer(optax.sgd(0.1), model)
+
+@smp.step
+def train_step(model, ids):
+    logits = model(ids)
+    loss = jnp.mean(logits.astype(jnp.float32) ** 2)
+    model.backward(loss)
+    return loss
+
+resume = os.environ.get("RESUME_FROM")
+if resume:
+    user = smp.resume_from_checkpoint(resume)
+    print("RESUMED_AT", user["step_count"], user["preemption_reason"],
+          flush=True)
+ids = jnp.zeros((2, 8), jnp.int32)
+losses = []
+for i in range(6):
+    out = train_step(model, ids)
+    opt.step()
+    losses.append(float(out.reduce_mean()))
+    print("STEP", i, losses[-1], flush=True)
+print("DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChaosEndToEnd:
+    def _run(self, script, env):
+        full_env = dict(os.environ)
+        full_env.update(env)
+        return subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=560, env=full_env,
+        )
+
+    def test_sigterm_at_step3_emergency_ckpt_then_resume(self, tmp_path):
+        eck = str(tmp_path / "eck")
+        script = _TRAIN_SCRIPT.format(repo=_REPO)
+        # Run 1: chaos SIGTERMs the process at step 3; the preemption
+        # listener writes the emergency checkpoint and exits 0.
+        out = self._run(script, {
+            "SMP_CHAOS": "sigterm@step=3",
+            "SMP_EMERGENCY_CKPT_PATH": eck,
+            "SMP_PREEMPTION_GRACE_SECONDS": "120",
+        })
+        assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+        # The SIGTERM fires INSIDE the third train_step call (step edge 3),
+        # so "STEP 1" is the last loop iteration that printed.
+        assert "STEP 1" in out.stdout
+        assert "DONE" not in out.stdout  # preempted before finishing
+        tag = open(os.path.join(eck, "newest")).read().strip()
+        assert tag == "preempt_step_3"
+        ckpt_dir = os.path.join(eck, f"{tag}_partial")
+        assert os.path.exists(os.path.join(ckpt_dir, ".committed"))
+        assert os.path.exists(
+            os.path.join(ckpt_dir, "model_shards_p0.npz")
+        )
+        losses1 = self._losses(out.stdout)
+        assert len(losses1) == 2  # steps 0..1 printed before the axe
+
+        # The probe agrees it is loadable.
+        probe = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "scripts", "resilience_probe.py"), eck],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert probe.returncode == 0, probe.stdout
+
+        # Run 2: restart resumes the emergency checkpoint (no config
+        # hacks) and the loss continues the trajectory — its first loss
+        # matches what an uninterrupted run would see at step 3 (strictly
+        # below the preempted run's last recorded loss for this convex
+        # toy objective).
+        out2 = self._run(script, {"RESUME_FROM": eck})
+        assert out2.returncode == 0, (out2.stdout[-2000:], out2.stderr[-2000:])
+        assert "RESUMED_AT 3 sigterm" in out2.stdout
+        assert "DONE" in out2.stdout
+        losses2 = self._losses(out2.stdout)
+        assert len(losses2) == 6
+        assert losses2[0] < losses1[-1], (losses1, losses2)
+
+    @staticmethod
+    def _losses(stdout):
+        return [
+            float(line.split()[2])
+            for line in stdout.splitlines()
+            if line.startswith("STEP ")
+        ]
