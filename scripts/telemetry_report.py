@@ -111,10 +111,16 @@ def render(report, out=sys.stdout):
             )
             pp = _value(report, "smp_pipeline_stages", schedule=sched)
             mb = _value(report, "smp_pipeline_microbatches", schedule=sched)
+            virt = _value(
+                report, "smp_pipeline_virtual_stages", schedule=sched
+            )
+            shape = ""
+            if pp and mb:
+                shape = f"  (pp={int(pp)}, mb={int(mb)}"
+                shape += f", v={int(virt)})" if virt and virt > 1 else ")"
             w(f"{sched}: bubble {100 * s['value']:.1f}% measured"
-              + (f" vs {100 * theo:.1f}% fill-drain bound" if theo is not None else "")
-              + (f"  (pp={int(pp)}, mb={int(mb)})" if pp and mb else "")
-              + "\n")
+              + (f" vs {100 * theo:.1f}% schedule bound" if theo is not None else "")
+              + shape + "\n")
 
     # -- comm volume ----------------------------------------------------
     ops = _series(report, "smp_comm_ops_total")

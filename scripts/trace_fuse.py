@@ -269,6 +269,8 @@ def fuse(streams):
                 elif kind == "slot":
                     name = (f"{ev.get('direction')}:mb"
                             f"{ev.get('microbatch')}@s{ev.get('stage')}")
+                    if ev.get("chunk") is not None:
+                        name += f"/c{ev['chunk']}"
                 args = {k: v for k, v in ev.items()
                         if k not in ("ts_us", "id")}
                 out.append({

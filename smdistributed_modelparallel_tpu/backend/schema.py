@@ -49,6 +49,21 @@ SCHEMA = {
         "schedule in the compiled microbatch loop; 'simple' to all-forward-"
         "then-all-backward.",
     },
+    "virtual_pipeline_degree": {
+        "type": int,
+        "default": 1,
+        "lower_bound": 1,
+        "alias": "virtual_pipeline_parallel_degree",
+        "requires": {"pipeline": "interleaved"},
+        "dependencies": ["pipeline"],
+        "description": "Megatron-style interleaved virtual pipeline stages: "
+        "each pipeline rank owns this many non-contiguous model chunks "
+        "(chunk c runs on stage c mod pp), shrinking the 1F1B bubble floor "
+        "from (pp-1)/(mb+pp-1) to (pp-1)/(v*mb+pp-1) at the cost of v x "
+        "more stage-boundary collective-permutes per microbatch. Requires "
+        "the 1F1B ('interleaved') schedule; no effect at "
+        "pipeline_parallel_degree 1.",
+    },
     "horovod": {
         "advisory": "SPMD collectives replace horovod",
         "type": bool,

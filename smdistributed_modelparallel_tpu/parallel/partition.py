@@ -122,6 +122,16 @@ def _maybe_save_partition(assignment):
     if jax.process_index() != 0:
         # One writer on shared filesystems (multi-host runs).
         return
+    if int(getattr(cfg, "virtual_pipeline_degree", 1) or 1) > 1:
+        # The chunked assignment (chunk c -> stage c % pp) is not a
+        # contiguous stage order: a saved file could never be re-installed
+        # (load_partition is rejected under virtual stages), so don't
+        # write one that only fails later.
+        logger.info(
+            "partition_file not written: virtual_pipeline_degree > 1 "
+            "assignments are derived, not loadable."
+        )
+        return
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     num_layers = None
     if assignment:

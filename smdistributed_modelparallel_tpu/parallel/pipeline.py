@@ -76,8 +76,12 @@ class PipelineSpec:
     layer_xs: Any = None
     carry_is_tuple: bool = False
     layer_costs: Optional[list] = None   # per-layer relative time costs
-    boundaries: Optional[list] = None    # [(start, end)] per stage (filled
-                                         # by partition_for_pipeline)
+    boundaries: Optional[list] = None    # [(start, end)] per chunk (filled
+                                         # by partition_for_pipeline; one
+                                         # entry per stage at v=1, pp*v
+                                         # entries under virtual stages)
+    virtual_degree: int = 1              # chunks per stage (Megatron-style
+                                         # interleaved virtual pipeline)
 
 
 def get_pipeline_spec(module):
@@ -100,6 +104,7 @@ def partition_for_pipeline(model):
     """
     cfg = state.cfg
     pp = cfg.pipeline_parallel_degree
+    virtual = int(getattr(cfg, "virtual_pipeline_degree", 1) or 1)
     from smdistributed_modelparallel_tpu.nn.auto_distribute import unwrap_hooks
 
     root = unwrap_hooks(model.module)
@@ -112,11 +117,30 @@ def partition_for_pipeline(model):
             "under SPMD."
         )
     L = spec.num_layers
-    if L < pp:
+    nchunks = pp * virtual
+    if L < nchunks:
         raise PartitionError(
-            f"num_layers={L} < pipeline_parallel_degree={pp}: at least one "
-            "layer per stage is required."
+            f"num_layers={L} < pipeline_parallel_degree * "
+            f"virtual_pipeline_degree = {pp} * {virtual} = {nchunks}: at "
+            "least one layer per chunk is required."
         )
+    if virtual > 1:
+        # Chunked stage assignments are non-contiguous along the layer
+        # sequence (chunk c -> stage c % pp), which the manual-partition
+        # surfaces cannot express: each would silently produce a layout
+        # the executor rejects, so fail with intent up front.
+        mm = model.module_manager
+        pinned = [
+            p for p in mm.get_manual_partitions()
+            if p.startswith(spec.layer_path + "#")
+        ]
+        if pinned or not cfg.auto_partition or cfg.load_partition:
+            raise PartitionError(
+                "virtual_pipeline_degree > 1 is incompatible with manual "
+                "layer pins, auto_partition: False, and load_partition: the "
+                "interleaved chunk placement (chunk c on stage c % pp) is "
+                "not a contiguous stage assignment."
+            )
     # Honor activation-checkpoint configs inside the pipeline: the stacked
     # executor applies layers directly (not via the module's own scan), so
     # the remat lives on the executor's layer application.
@@ -130,19 +154,29 @@ def partition_for_pipeline(model):
                     spec.carry_remat = True
                     break
 
-    spec.boundaries = _choose_boundaries(model, spec, pp)
+    # One contiguous cost-balanced range per CHUNK; chunk c executes on
+    # stage c % pp (at v=1 a chunk IS a stage, so this is the old layout).
+    spec.virtual_degree = virtual
+    spec.boundaries = _choose_boundaries(model, spec, nchunks)
     assignment = {}
-    for s, (a, b) in enumerate(spec.boundaries):
+    for c, (a, b) in enumerate(spec.boundaries):
         for layer in range(a, b):
-            assignment[f"{spec.layer_path}#{layer}"] = s
+            assignment[f"{spec.layer_path}#{layer}"] = c % pp
     model._pipeline_spec = spec
     model.module_manager.register_spec_provider(
         layer_param_sharding_provider(spec), name="pipeline_layers"
     )
-    logger.info(
-        "Pipeline partition: %d layers -> %d stages %s.",
-        L, pp, [b - a for a, b in spec.boundaries],
-    )
+    if virtual > 1:
+        logger.info(
+            "Pipeline partition: %d layers -> %d stages x %d virtual "
+            "chunks %s.",
+            L, pp, virtual, [b - a for a, b in spec.boundaries],
+        )
+    else:
+        logger.info(
+            "Pipeline partition: %d layers -> %d stages %s.",
+            L, pp, [b - a for a, b in spec.boundaries],
+        )
     return assignment
 
 
@@ -413,6 +447,45 @@ def stage_layout(spec, num_stages):
     return idx, active, maxp
 
 
+def chunk_layout(spec, num_stages, virtual):
+    """(layer_index_grid [S, V, maxp], active_mask [S, V, maxp], maxp) for
+    the interleaved 1F1B executor: chunk ``c`` of ``spec.boundaries`` sits
+    at ``[c % S, c // S]`` (stage, local chunk). The per-chunk grids come
+    from ``stage_layout`` over the C = S*V chunk boundaries (one source of
+    truth for bounds defaults and padding), re-laid to the interleaved
+    placement."""
+    C = num_stages * virtual
+    if spec.boundaries is not None and len(spec.boundaries) != C:
+        raise PartitionError(
+            f"pipeline spec has {len(spec.boundaries)} chunk boundaries "
+            f"for {num_stages} stages x {virtual} virtual chunks."
+        )
+    idx, active, maxp = stage_layout(spec, C)   # [C, maxp], chunk order
+    shape = (virtual, num_stages, maxp)
+    # Row c -> grid[c % S, c // S]: reshape to [V, S, .] and swap.
+    return (idx.reshape(shape).transpose(1, 0, 2),
+            active.reshape(shape).transpose(1, 0, 2), maxp)
+
+
+def staged_chunk_views(spec, layer_params, num_stages, virtual):
+    """Stage the [L, ...] layer stack as ([S, V, maxp, ...] params,
+    [S, V, maxp, ...] xs, [S, V, maxp] active mask) for the interleaved
+    executor.
+
+    The chunked placement (chunk c -> stage c % S) interleaves the layer
+    axis across stages, so unlike the v=1 reshape this is always a gather
+    across the even [L] storage sharding — one layer-param reshard per
+    step, amortized over all V chunks' compute.
+    """
+    idx, active, maxp = chunk_layout(spec, num_stages, virtual)
+    gidx = jnp.asarray(idx)
+    staged_params = jax.tree_util.tree_map(lambda x: x[gidx], layer_params)
+    staged_xs = jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x)[gidx], spec.layer_xs
+    )
+    return staged_params, staged_xs, jnp.asarray(active)
+
+
 def layer_param_sharding_provider(spec):
     """Spec provider: stacked layer params get their leading (layer) axis
     sharded over pp; everything else replicated across pp. When the layer
@@ -454,7 +527,16 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     """
     spec = model._pipeline_spec
     cfg = state.cfg
-    S = cfg.pipeline_parallel_degree
+    phys_stages = cfg.pipeline_parallel_degree
+    virtual = int(getattr(spec, "virtual_degree", 1) or 1)
+    # virtual_pipeline_degree > 1 cut the model into pp*v chunks; this
+    # executor (forward-only path under the interleaved config) runs them
+    # as pp*v sequential logical stages — same math, contiguous [C]
+    # staging (chunk i on physical stage i // v). The interleaved chunk
+    # placement lives in the 1F1B executor only; telemetry and health
+    # below attribute back to PHYSICAL stage + chunk coordinates so
+    # operators never see stages that don't exist.
+    S = phys_stages * virtual
     num_mb = cfg.microbatches
     L = spec.num_layers
     from smdistributed_modelparallel_tpu.nn.auto_distribute import unwrap_hooks
@@ -539,16 +621,25 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
         record_pipeline_occupancy,
     )
 
+    # Gauges carry the PHYSICAL stage count; under chunked specs the
+    # measured fraction (C-1)/(mb+C-1) sitting above the interleaved
+    # theoretical bound is the honest report — this executor runs the
+    # chunks sequentially, it does not interleave them.
     record_pipeline_occupancy(
-        "fill_drain", S, num_mb, busy_slots=num_mb * S,
-        total_slots=n_ticks * S,
+        "fill_drain", phys_stages, num_mb, busy_slots=num_mb * S,
+        total_slots=n_ticks * S, virtual=virtual,
     )
     # The busy (tick, stage) -> microbatch assignments land in the flight
     # recorder once per trace: a stall dump can then say which schedule
     # slot each rank's program was built to be in, not just "in step N".
+    # Chunked specs record (physical stage, chunk) coordinates.
+    # Chunked specs record (physical stage, GLOBAL chunk) coordinates —
+    # the logical stage IS the boundary/chunk index here, matching the
+    # chunk ids the 1F1B executor records for the same layers.
     flight_recorder.record_schedule(
         "fill_drain",
-        ((t, s, "fwd", t - s)
+        ((t, s, "fwd", t - s) if virtual == 1
+         else (t, s // virtual, "fwd", t - s, s)
          for t in range(n_ticks) for s in range(S)
          if 0 <= t - s < num_mb),
     )
@@ -649,7 +740,22 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     carry_end, tails = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
     if hc is not None:
         (_, aux_total, (hbad, habs, hmb)) = carry_end
-        hc.add_stage_stats("fill_drain", hbad, habs, hmb)
+        if virtual > 1:
+            # Sequential chunk layout: logical stage i is global chunk i,
+            # running on physical stage i // v — reshape so sentinel trips
+            # attribute to stages that exist on the machine, tagged with
+            # the global chunk (boundary) index.
+            import numpy as np
+
+            hbad = hbad.reshape(phys_stages, virtual)
+            habs = habs.reshape(phys_stages, virtual)
+            hmb = hmb.reshape(phys_stages, virtual)
+            chunk_ids = np.arange(S).reshape(phys_stages, virtual)
+            hc.add_stage_stats(
+                "fill_drain", hbad, habs, hmb, chunk_ids=chunk_ids
+            )
+        else:
+            hc.add_stage_stats("fill_drain", hbad, habs, hmb)
     else:
         (_, aux_total) = carry_end
     # tails[t] is microbatch t-(S-1); keep the last num_mb ticks.

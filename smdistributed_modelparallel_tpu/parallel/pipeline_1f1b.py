@@ -95,18 +95,138 @@ def build_1f1b_schedule(num_stages, num_microbatches, window):
     return np.asarray(fwd_rows, np.int32), np.asarray(bwd_rows, np.int32)
 
 
-def schedule_occupancy(fwd, bwd):
+def schedule_occupancy(fwd, bwd, fwd_ticks=None, bwd_ticks=None):
     """(busy_slots, total_slots) of a static 1F1B schedule.
 
     Each tick has a forward and a backward sub-step per stage; a sub-slot
     is busy when its schedule entry is a microbatch index (>= 0). The
     compiled program executes exactly this schedule, so this IS the
-    measured occupancy (every microbatch appears exactly once per stage
-    per direction: busy == 2*S*M).
+    measured occupancy. Under virtual pipeline stages the entries are
+    (chunk, microbatch) units, so busy counts CHUNK sub-steps (busy ==
+    2*S*V*M) and stays comparable across ``virtual_pipeline_degree``
+    values; ``fwd_ticks``/``bwd_ticks`` then restrict the denominator to
+    the ticks whose sub-step actually executes (the virtual executor's
+    warmup ticks are forward-only and its cooldown ticks backward-only —
+    idle sub-steps that are never compiled are not bubble).
     """
     busy = int((fwd >= 0).sum()) + int((bwd >= 0).sum())
-    total = 2 * int(fwd.shape[0]) * int(fwd.shape[1])
+    if fwd_ticks is None:
+        fwd_ticks = int(fwd.shape[0])
+    if bwd_ticks is None:
+        bwd_ticks = int(bwd.shape[0])
+    total = int(fwd.shape[1]) * (fwd_ticks + bwd_ticks)
     return busy, total
+
+
+def build_interleaved_1f1b_schedule(num_stages, num_microbatches, window,
+                                    virtual):
+    """Static lockstep 1F1B schedule over ``virtual`` chunks per stage.
+
+    Megatron-style virtual pipeline stages: the model is cut into
+    ``C = num_stages * virtual`` chunks; global chunk ``c`` lives on stage
+    ``c % num_stages`` as that stage's local chunk ``k = c // num_stages``.
+    Returns ``(fwd_chunk, fwd_mb, bwd_chunk, bwd_mb)``: int32 arrays
+    ``[n_ticks, S]``; per tick each stage processes at most one
+    (chunk, microbatch) unit per direction (-1 = idle).
+
+    Invariants (generalizing the v=1 schedule's):
+      - every (chunk, microbatch) is forwarded and backwarded exactly once;
+      - fwd of chunk c, mb m runs strictly after fwd of chunk c-1, mb m;
+      - bwd of chunk c, mb m runs strictly after bwd of chunk c+1, mb m,
+        and not before its own fwd (same tick allowed only on the LAST
+        chunk, whose cotangent comes from the loss, not a neighbor);
+      - per (stage, chunk), at most ``window`` microbatches are in flight
+        (forwarded, not yet backwarded) at any tick.
+
+    Greedy policy: each stage picks the highest eligible chunk in both
+    directions (depth-first fwd pushes microbatches toward the loss so
+    backwards start sooner; highest-chunk bwd drains cotangents down the
+    chunk chain). At ``virtual=1`` this reduces EXACTLY to
+    ``build_1f1b_schedule`` (one chunk per stage, identical arrays).
+
+    Bubble: with ``window >= 2*num_stages`` the schedule achieves the
+    interleaved floor — occupancy over executed sub-steps (forward-only
+    warmup ticks + paired ticks + backward-only cooldown ticks, see
+    ``interleaved_phase_bounds``) equals
+    ``1 - (pp-1)/(v*mb + pp-1)``. The default ``active_microbatches``
+    (pp+2) reaches it at pp=2; deeper pipelines trade the last bubble
+    fraction against in-flight activation memory.
+    """
+    S, M, W, V = num_stages, num_microbatches, window, virtual
+    if W < 1:
+        raise PartitionError(f"active_microbatches must be >= 1, got {W}")
+    if V < 1:
+        raise PartitionError(f"virtual degree must be >= 1, got {V}")
+    C = S * V
+    fwd_next = [[0] * V for _ in range(S)]
+    bwd_next = [[0] * V for _ in range(S)]
+    fwd_tick = {}
+    bwd_tick = {}
+    fk_rows, fm_rows, bk_rows, bm_rows = [], [], [], []
+    t = 0
+    limit = 4 * V * (M + S) * max(1, (S + W - 1) // W) + 16 * V
+
+    def fwd_candidate(s):
+        """Highest eligible local chunk for stage s's fwd sub-step."""
+        for k in range(V - 1, -1, -1):
+            c = k * S + s
+            m = fwd_next[s][k]
+            if m < M and (fwd_next[s][k] - bwd_next[s][k]) < W:
+                if c == 0 or fwd_tick.get((c - 1, m), limit) < t:
+                    return k, m
+        return -1, -1
+
+    def bwd_candidate(s):
+        for k in range(V - 1, -1, -1):
+            c = k * S + s
+            m = bwd_next[s][k]
+            if m < M and fwd_tick.get((c, m), limit) <= t:
+                if c == C - 1 or bwd_tick.get((c + 1, m), limit) < t:
+                    return k, m
+        return -1, -1
+
+    while any(n < M for row in bwd_next for n in row):
+        fk, fm = zip(*(fwd_candidate(s) for s in range(S)))
+        for s in range(S):
+            if fm[s] >= 0:
+                fwd_tick[(fk[s] * S + s, fm[s])] = t
+                fwd_next[s][fk[s]] += 1
+        bk, bm = zip(*(bwd_candidate(s) for s in range(S)))
+        for s in range(S):
+            if bm[s] >= 0:
+                bwd_tick[(bk[s] * S + s, bm[s])] = t
+                bwd_next[s][bk[s]] += 1
+        fk_rows.append(fk)
+        fm_rows.append(fm)
+        bk_rows.append(bk)
+        bm_rows.append(bm)
+        t += 1
+        if t > limit:
+            raise PartitionError(
+                f"interleaved 1F1B schedule did not converge "
+                f"(S={S}, M={M}, W={W}, V={V})"
+            )
+    return (np.asarray(fk_rows, np.int32), np.asarray(fm_rows, np.int32),
+            np.asarray(bk_rows, np.int32), np.asarray(bm_rows, np.int32))
+
+
+def interleaved_phase_bounds(fwd_mb, bwd_mb):
+    """(t_bwd_start, t_fwd_end) of an interleaved schedule.
+
+    Ticks ``[0, t_bwd_start)`` have no backward work anywhere (warmup:
+    the executor compiles them as forward-only sub-steps) and ticks
+    ``[t_fwd_end, n_ticks)`` no forward work (cooldown: backward-only).
+    This phase split is what realizes the interleaved bubble win: the
+    rigidly paired tick (one fwd + one bwd sub-step) would idle a full
+    sub-step per warmup/cooldown tick, making the sub-slot bubble
+    independent of the virtual degree.
+    """
+    n_ticks = int(fwd_mb.shape[0])
+    bwd_any = (bwd_mb >= 0).any(axis=1)
+    fwd_any = (fwd_mb >= 0).any(axis=1)
+    t_b0 = int(np.argmax(bwd_any)) if bwd_any.any() else n_ticks
+    t_fe = n_ticks - int(np.argmax(fwd_any[::-1])) if fwd_any.any() else 0
+    return t_b0, t_fe
 
 
 def _tree_zeros(avals_or_tree, like=None):
@@ -142,6 +262,14 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     """
     spec = model._pipeline_spec
     cfg = state.cfg
+    virtual = int(getattr(cfg, "virtual_pipeline_degree", 1) or 1)
+    if virtual > 1:
+        # Interleaved virtual stages take the generalized executor; the
+        # default path below stays byte-for-byte the v=1 program.
+        return _pipeline_1f1b_virtual(
+            model, params, stacked_inputs, rng, mb_loss_fn, loss_seed_scale,
+            virtual,
+        )
     S = cfg.pipeline_parallel_degree
     M = cfg.microbatches
     L = spec.num_layers
@@ -672,6 +800,707 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
         )
     # Install the stage-accumulated layer grads into the rest-tree: the
     # result has the full parameter structure.
+    grads = _set_subtree(drep, spec.layer_path, layer_grads)
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(jnp.result_type(p)), grads, params
+    )
+    return grads, losses, outs
+
+
+def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
+                           loss_seed_scale, virtual):
+    """1F1B with ``virtual`` interleaved model chunks per pipeline stage.
+
+    Same numerical contract as the v=1 executor (grads/losses/outputs
+    interchangeable with the fill-drain path), different schedule shape:
+
+    - the partitioner cut the model into ``C = S*virtual`` chunks; global
+      chunk ``c`` lives on stage ``c % S`` (``parallel/pipeline.py::
+      chunk_layout``), so every chunk boundary crossing is a +1 rotation
+      on the pp axis — ``jnp.roll`` -> one collective-permute, exactly as
+      at v=1, just ``virtual`` times as often per microbatch;
+    - ring buffers are keyed by (local chunk, microbatch): shape
+      ``[S, V, W+1, ...]``;
+    - stage transfers are DOUBLE-BUFFERED: tick t's fwd outputs / bwd
+      cotangents park in transfer registers and the roll
+      (collective-permute) + ring write happen at the START of tick t+1 —
+      legal because the schedule's cross-chunk dependencies are strictly
+      earlier-tick, and it places each permute next to compute that does
+      not depend on it so the latency-hiding scheduler can overlap the
+      t+1 transfer with tick t+1's first compute instead of serializing
+      at the tick boundary;
+    - the tick loop is split into three scans — forward-only warmup
+      ticks, paired steady-state ticks, backward-only cooldown ticks
+      (``interleaved_phase_bounds``). This is what makes the bubble
+      shrink with ``virtual``: a rigidly paired tick would idle one full
+      sub-step per warmup/cooldown tick and the sub-slot bubble would
+      stay at its v=1 value no matter how many chunks exist.
+    """
+    spec = model._pipeline_spec
+    cfg = state.cfg
+    S = cfg.pipeline_parallel_degree
+    M = cfg.microbatches
+    L = spec.num_layers
+    V = virtual
+    W = min(cfg.active_microbatches or (S + 1), M)
+    W1 = W + 1
+    from smdistributed_modelparallel_tpu.nn.auto_distribute import unwrap_hooks
+
+    module = unwrap_hooks(model.module)
+    layer_module = spec.layer_module
+    half = cfg.half_dtype
+
+    fwd_k_np, fwd_m_np, bwd_k_np, bwd_m_np = build_interleaved_1f1b_schedule(
+        S, M, W, V
+    )
+    n_ticks = fwd_m_np.shape[0]
+    t_b0, t_fe = interleaved_phase_bounds(fwd_m_np, bwd_m_np)
+    from smdistributed_modelparallel_tpu.utils import health
+    from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+        flight_recorder,
+    )
+    from smdistributed_modelparallel_tpu.utils.telemetry import (
+        record_pipeline_occupancy,
+    )
+
+    busy, total = schedule_occupancy(
+        fwd_m_np, bwd_m_np, fwd_ticks=t_fe, bwd_ticks=n_ticks - t_b0
+    )
+    record_pipeline_occupancy(
+        "1f1b", S, M, busy_slots=busy, total_slots=total, virtual=V
+    )
+    # Slot events carry the GLOBAL chunk (boundary) index k*S + s: stage
+    # says where the work ran, chunk identifies the layers — the same
+    # coordinates the fill-drain executor records for chunked specs.
+    flight_recorder.record_schedule(
+        "1f1b",
+        ((t, s, d, int(m_arr[t, s]), int(k_arr[t, s]) * S + s)
+         for t in range(n_ticks) for s in range(S)
+         for d, k_arr, m_arr in (("fwd", fwd_k_np, fwd_m_np),
+                                 ("bwd", bwd_k_np, bwd_m_np))
+         if m_arr[t, s] >= 0),
+    )
+    fwd_k_sched = jnp.asarray(fwd_k_np)
+    fwd_m_sched = jnp.asarray(fwd_m_np)
+    bwd_k_sched = jnp.asarray(bwd_k_np)
+    bwd_m_sched = jnp.asarray(bwd_m_np)
+
+    from smdistributed_modelparallel_tpu.parallel.pipeline import (
+        _get_subtree,
+        _mk_rngs,
+        _scan_map,
+        chunk_layout,
+        staged_chunk_views,
+    )
+
+    def cast_half(tree):
+        from smdistributed_modelparallel_tpu.nn.utils import half_cast
+
+        return half_cast(tree, half)
+
+    layer_params = _get_subtree(params, spec.layer_path)
+    staged_params, staged_xs, active_rows = staged_chunk_views(
+        spec, layer_params, S, V
+    )
+
+    # The chunked gather ([L] -> [S, V, maxp]) breaks the sharding
+    # propagation that gives the v=1 executor its stage placement for free
+    # (a reshape keeps dim 0 on pp; a gather's output is unconstrained, and
+    # GSPMD then happily replicates the whole tick loop). Pin ONLY the
+    # leading stage axis of every stage-parallel value to the pp mesh axis
+    # and leave the rest unconstrained so batch/tp shardings still
+    # propagate.
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    from smdistributed_modelparallel_tpu.backend.topology import PP_AXIS
+
+    mesh = state.mesh
+    _pp_size = dict(mesh.shape).get(PP_AXIS, 1) if mesh is not None else 1
+
+    def pin_stage_axis(tree):
+        if mesh is None or _pp_size <= 1:
+            return tree
+
+        def pin(x):
+            if getattr(x, "ndim", 0) < 1 or x.shape[0] != S:
+                return x
+            rest = [_P.UNCONSTRAINED] * (x.ndim - 1)
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, _P(PP_AXIS, *rest))
+            )
+
+        return jax.tree_util.tree_map(pin, tree)
+
+    staged_params = pin_stage_axis(staged_params)
+    staged_xs = pin_stage_axis(staged_xs)
+    params_rest = _set_subtree(params, spec.layer_path, {})
+
+    def with_layers(p_rest):
+        return _set_subtree(p_rest, spec.layer_path, layer_params)
+
+    idx_np, active_np, maxp = chunk_layout(spec, S, V)
+
+    mb_keys = jax.random.split(rng, M)
+
+    # ---- embed all microbatches (the input queue) --------------------
+
+    def embed_mb(mb_input, key):
+        args, kwargs = mb_input
+        if spec.embed_method is None:
+            return args[0]
+        return module.apply(
+            {"params": cast_half(params)}, *args,
+            rngs=_mk_rngs(model, key, "embed"),
+            method=spec.embed_method, **kwargs,
+        )
+
+    embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
+
+    if spec.carry_is_tuple:
+        hidden_q = embedded[0]
+        sides = embedded[1:]
+    else:
+        hidden_q = embedded
+        sides = None
+
+    carry_aval = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), hidden_q
+    )
+
+    # ---- per-chunk forward (pure in chunk params and carry) ----------
+
+    from smdistributed_modelparallel_tpu.parallel.memory import remat_policy
+    from smdistributed_modelparallel_tpu.parallel.pipeline import (
+        apply_collecting_aux,
+        make_layer_apply,
+    )
+
+    apply_one_layer = make_layer_apply(
+        model, spec, layer_module, side_in_carry=False
+    )
+
+    if spec.carry_remat:
+        apply_one_layer = jax.checkpoint(apply_one_layer, policy=remat_policy())
+
+    def chunk_fwd(chunk_lp, chunk_lxs, x, side, c_idx, m_idx, act_row):
+        """Apply one chunk's layer slots; keys derived from (global chunk,
+        mb) — at V=1 the global chunk id IS the stage id, so the key
+        schedule is the v=1 executor's. Returns (carry, summed MoE aux)."""
+        base = jax.random.fold_in(jax.random.fold_in(rng, c_idx), m_idx)
+        chunk_lp = cast_half(chunk_lp)
+
+        def body(c, xs):
+            lp, lxs, i, act = xs
+            new_c, aux = apply_one_layer(
+                lp, c, lxs, jax.random.fold_in(base, i), side
+            )
+            out_c = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(act, n, o), new_c, c
+            )
+            return out_c, jnp.where(act, aux, 0.0)
+
+        idx = jnp.arange(maxp)
+        out, auxs = jax.lax.scan(body, x, (chunk_lp, chunk_lxs, idx, act_row))
+        return out, jnp.sum(auxs)
+
+    def gather_mb(tree, m):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+            tree,
+        )
+
+    def gather_sides_rows(ms):
+        if sides is None:
+            return None
+        return tuple(
+            jax.tree_util.tree_map(
+                lambda a: jax.vmap(
+                    lambda i: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+                )(ms),
+                s,
+            )
+            for s in sides
+        )
+
+    def select_chunk(tree, krow):
+        """Per-stage view of one chunk: [S, V, ...] -> [S, ...] at krow[s]."""
+        return jax.tree_util.tree_map(
+            lambda a: jax.vmap(
+                lambda av, k: jax.lax.dynamic_index_in_dim(av, k, 0, keepdims=False)
+            )(a, krow),
+            tree,
+        )
+
+    # ---- head + user loss (last stage, last chunk only) ---------------
+
+    def head_apply_aux(p, carry, key):
+        if spec.head_method is None:
+            return carry, jnp.zeros((), jnp.float32)
+        return apply_collecting_aux(
+            module, {"params": cast_half(p)}, carry,
+            rngs=_mk_rngs(model, key, "head"), method=spec.head_method,
+        )
+
+    def head_apply(p, carry, key):
+        return head_apply_aux(p, carry, key)[0]
+
+    loss_out_aval = jax.eval_shape(
+        lambda c: mb_loss_fn(head_apply(params, c, mb_keys[0]), 0, mb_keys[0]),
+        jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), carry_aval),
+    )
+
+    # ---- buffers ------------------------------------------------------
+
+    def zeros_chunk_ring(n):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros((S, V, n) + a.shape, a.dtype), carry_aval
+        )
+
+    def zeros_stage_rows():
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros((S,) + a.shape, a.dtype), carry_aval
+        )
+
+    grad_dtype = jnp.float32
+
+    def _acc_dtype(dtype):
+        if jnp.issubdtype(dtype, jnp.floating) and cfg._fp32_grad_accumulation:
+            return jnp.float32
+        return dtype
+
+    def param_grad_zeros(tree):
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, _acc_dtype(p.dtype)), tree
+        )
+
+    inbuf0 = zeros_chunk_ring(W1)    # inbuf[s, k, m % W1]: fwd input of (k, m)
+    stash0 = zeros_chunk_ring(W1)    # consumed fwd inputs (bwd recompute)
+    cotbuf0 = zeros_chunk_ring(W1)   # output cotangent of (k, m)
+    outbuf0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((S, W1) + a.shape, a.dtype), carry_aval
+    )                                # last chunk's fwd output (row S-1 only)
+    xfer_f0 = zeros_stage_rows()     # tick t's raw fwd outputs, rolled at t+1
+    xfer_b0 = zeros_stage_rows()     # tick t's raw input cotangents, ditto
+    dlay0 = param_grad_zeros(staged_params)
+    drep0 = param_grad_zeros(params_rest)
+    dembed0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((M,) + a.shape, grad_dtype), carry_aval
+    )
+    side_leaves = side_treedef = side_idx = None
+    dsides0 = None
+    if sides is not None:
+        side_leaves, side_treedef, side_idx = _inexact_leaves(
+            tuple(jax.tree_util.tree_map(lambda a: a[0], s) for s in sides)
+        )
+        dsides0 = [
+            jnp.zeros((M,) + side_leaves[i].shape, grad_dtype) for i in side_idx
+        ]
+    losses0 = jnp.zeros((M,), jnp.float32)
+    outs0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((M,) + a.shape, a.dtype), loss_out_aval[1]
+    )
+
+    stage_ids = jnp.arange(S)
+    aux_w = float(getattr(cfg, "moe_aux_loss_weight", 1.0))
+    aux_seed = (
+        jnp.asarray(aux_w, jnp.float32)
+        * jnp.asarray(loss_seed_scale, jnp.float32)
+    )
+
+    def set_ring(buf, row_chunks, row_slots, row_vals, row_active):
+        """buf[s, row_chunks[s], row_slots[s]] = row_vals[s] where active."""
+
+        def upd(b, v):
+            def one(bs, k, slot, vs, act):   # bs: [V, W1, ...]
+                sub = jax.lax.dynamic_index_in_dim(bs, k, 0, keepdims=False)
+                new = jax.lax.dynamic_update_index_in_dim(
+                    sub, vs.astype(bs.dtype), slot, 0
+                )
+                new = jnp.where(act, new, sub)
+                return jax.lax.dynamic_update_index_in_dim(bs, new, k, 0)
+
+            return jax.vmap(one)(b, row_chunks, row_slots, v, row_active)
+
+        return jax.tree_util.tree_map(upd, buf, row_vals)
+
+    def get_ring(buf, row_chunks, row_slots):
+        def one(bs, k, slot):
+            sub = jax.lax.dynamic_index_in_dim(bs, k, 0, keepdims=False)
+            return jax.lax.dynamic_index_in_dim(sub, slot, 0, keepdims=False)
+
+        return jax.tree_util.tree_map(
+            lambda b: jax.vmap(one)(b, row_chunks, row_slots), buf
+        )
+
+    def set_outbuf(buf, row_slots, row_vals, row_active):
+        def upd(b, v):
+            def one(bs, slot, vs, act):
+                new = jax.lax.dynamic_update_index_in_dim(
+                    bs, vs.astype(bs.dtype), slot, 0
+                )
+                return jnp.where(act, new, bs)
+
+            return jax.vmap(one)(b, row_slots, v, row_active)
+
+        return jax.tree_util.tree_map(upd, buf, row_vals)
+
+    def scatter_add_mb(buf, m, val, active):
+        def upd(b, v):
+            cur = jax.lax.dynamic_index_in_dim(b, m, 0, keepdims=False)
+            new = cur + jnp.where(active, v.astype(b.dtype), jnp.zeros_like(cur))
+            return jax.lax.dynamic_update_index_in_dim(b, new, m, 0)
+
+        return jax.tree_util.tree_map(upd, buf, val)
+
+    def scatter_set_mb(buf, m, val, active):
+        def upd(b, v):
+            cur = jax.lax.dynamic_index_in_dim(b, m, 0, keepdims=False)
+            new = jnp.where(active, v.astype(b.dtype), cur)
+            return jax.lax.dynamic_update_index_in_dim(b, new, m, 0)
+
+        return jax.tree_util.tree_map(upd, buf, val)
+
+    def _scatter_add_leaf(buf, m, val, active):
+        cur = jax.lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
+        new = cur + jnp.where(active, val.astype(buf.dtype), jnp.zeros_like(cur))
+        return jax.lax.dynamic_update_index_in_dim(buf, new, m, 0)
+
+    def scatter_chunk_stat(acc, krow, vals, act, op):
+        """acc[s, krow[s]] = op(acc[s, krow[s]], vals[s]) where act[s];
+        acc is [S, V] (per-stage per-chunk health stats)."""
+
+        def one(av, k, vv, m):
+            cur = jax.lax.dynamic_index_in_dim(av, k, 0, keepdims=False)
+            new = jnp.where(m, op(cur, vv), cur)
+            return jax.lax.dynamic_update_index_in_dim(av, new, k, 0)
+
+        return jax.vmap(one)(acc, krow, vals, act)
+
+    hc = health.active()
+
+    def tick_impl(carry, t, do_fwd, do_bwd):
+        """One schedule tick. ``do_fwd``/``do_bwd`` are STATIC phase flags:
+        warmup ticks compile only the forward sub-step, cooldown ticks only
+        the backward one — the idle sub-steps are never part of the
+        program, which is what the occupancy accounting assumes."""
+        if hc is not None:
+            (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, dlay, drep,
+             dembed, dsides, losses, outs, (hbad, habs, hmb)) = carry
+        else:
+            (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, dlay, drep,
+             dembed, dsides, losses, outs) = carry
+
+        # ---------------- deferred stage transfers ----------------
+        # Tick t-1's fwd outputs / bwd cotangents cross the pp axis here
+        # (jnp.roll -> collective-permute) and land in the rings before
+        # this tick's compute reads them. Chunk routing: fwd output of
+        # (stage s, chunk k) feeds (s+1 mod S, k + [s == S-1]); bwd input
+        # cotangent of (s, k) feeds (s-1 mod S, k - [s == 0]).
+        prev = jnp.maximum(t - 1, 0)
+        was_prev = t > 0
+        # Forward merge only in fwd-capable phases: the last forward tick
+        # can only contain LAST-chunk forwards (fwd(c,m) < fwd(c+1,m) and
+        # nothing later could consume a non-last chunk's output), and those
+        # route to outbuf, never the ring — so cooldown ticks would compile
+        # a provably all-masked roll (one dead collective-permute per tick).
+        if do_fwd:
+            pk = fwd_k_sched[prev]
+            pm = fwd_m_sched[prev]
+            p_act = (pm >= 0) & was_prev
+            dst_k = jnp.roll(pk, 1) + (stage_ids == 0)
+            dst_m = jnp.roll(jnp.maximum(pm, 0), 1)
+            # The last chunk's output (dst_k == V) is the head input, kept
+            # in outbuf at its producing tick, not routed forward.
+            dst_act = jnp.roll(p_act, 1) & (dst_k < V)
+            inbuf = set_ring(
+                inbuf, jnp.clip(dst_k, 0, V - 1), dst_m % W1,
+                jax.tree_util.tree_map(lambda o: jnp.roll(o, 1, axis=0), xfer_f),
+                dst_act,
+            )
+        if do_bwd:
+            pbk = bwd_k_sched[prev]
+            pbm = bwd_m_sched[prev]
+            pb_act = (pbm >= 0) & was_prev
+            dst_bk = jnp.roll(pbk, -1) - (stage_ids == S - 1)
+            dst_bm = jnp.roll(jnp.maximum(pbm, 0), -1)
+            # Global chunk 0's input cotangent (dst_bk == -1) went to the
+            # embedding accumulator at its producing tick.
+            dst_b_act = jnp.roll(pb_act, -1) & (dst_bk >= 0)
+            cotbuf = set_ring(
+                cotbuf, jnp.clip(dst_bk, 0, V - 1), dst_bm % W1,
+                jax.tree_util.tree_map(lambda o: jnp.roll(o, -1, axis=0), xfer_b),
+                dst_b_act,
+            )
+
+        # ---------------- forward sub-step ----------------
+        if do_fwd:
+            fk = fwd_k_sched[t]
+            fm = fwd_m_sched[t]
+            f_active = fm >= 0
+            fkc = jnp.clip(fk, 0, V - 1)
+            fmc = jnp.maximum(fm, 0)
+            f_slots = fmc % W1
+            ch_params = select_chunk(staged_params, fkc)
+            ch_xs = select_chunk(staged_xs, fkc)
+            ch_act = select_chunk(active_rows, fkc)
+            # Stage 0 chunk 0 reads the embedded queue; everything else
+            # reads its ring slot.
+            from_q = gather_mb(hidden_q, fmc[0])
+            buf_in = get_ring(inbuf, fkc, f_slots)
+            x_in = jax.tree_util.tree_map(
+                lambda q, b: b.at[0].set(jnp.where(fkc[0] == 0, q, b[0])),
+                from_q, buf_in,
+            )
+            f_sides = gather_sides_rows(fmc)
+            c_ids = fkc * S + stage_ids
+            outs_f, _aux_f = jax.vmap(
+                chunk_fwd,
+                in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0),
+            )(ch_params, ch_xs, x_in, f_sides, c_ids, fmc, ch_act)
+            outs_f = pin_stage_axis(outs_f)
+            stash = set_ring(stash, fkc, f_slots, x_in, f_active)
+            if hc is not None:
+                brow, arow = health.stage_row_stats(outs_f, S)
+                brow = jnp.where(f_active, brow, 0.0)
+                arow = jnp.where(f_active, arow, 0.0)
+                hmb = scatter_chunk_stat(
+                    hmb, fkc, fmc.astype(jnp.float32),
+                    f_active & (brow > 0),
+                    lambda cur, mb: jnp.where(cur < 0, mb, cur),
+                )
+                hbad = scatter_chunk_stat(
+                    hbad, fkc, brow, f_active, lambda cur, v: cur + v
+                )
+                habs = scatter_chunk_stat(
+                    habs, fkc, arow, f_active, jnp.maximum
+                )
+            last_row_active = f_active & (stage_ids == S - 1) & (fkc == V - 1)
+            outbuf = set_outbuf(outbuf, f_slots, outs_f, last_row_active)
+            xfer_f = outs_f
+
+        # ---------------- backward sub-step ----------------
+        if do_bwd:
+            bk = bwd_k_sched[t]
+            bm = bwd_m_sched[t]
+            b_active = bm >= 0
+            bkc = jnp.clip(bk, 0, V - 1)
+            bmc = jnp.maximum(bm, 0)
+            b_slots = bmc % W1
+
+            # Head + user loss VJP on the stashed LAST-chunk output: only
+            # meaningful when stage S-1 backwards chunk V-1 this tick.
+            is_lastk = b_active[S - 1] & (bkc[S - 1] == V - 1)
+            m_last = bmc[S - 1]
+            key_last = jax.lax.dynamic_index_in_dim(
+                mb_keys, m_last, 0, keepdims=False
+            )
+            out_last = jax.tree_util.tree_map(
+                lambda ob: jax.lax.dynamic_index_in_dim(
+                    ob[S - 1], b_slots[S - 1], 0, keepdims=False
+                ),
+                outbuf,
+            )
+
+            def head_loss(p_rest, out):
+                final, h_aux = head_apply_aux(with_layers(p_rest), out, key_last)
+                loss, user_out = mb_loss_fn(final, m_last, key_last)
+                loss = loss + jnp.asarray(aux_w, loss.dtype) * h_aux.astype(
+                    loss.dtype
+                )
+                return loss, user_out
+
+            def run_head():
+                loss_m, head_vjp, user_out = jax.vjp(
+                    head_loss, params_rest, out_last, has_aux=True
+                )
+                seed = jnp.asarray(loss_seed_scale, loss_m.dtype)
+                d_rep, d_out_last = head_vjp(seed)
+                return loss_m.astype(jnp.float32), d_rep, d_out_last, user_out
+
+            # Only 1/V of the backward ticks carry the last chunk, but the
+            # head+loss VJP is replicated (not stage-parallel) work: run it
+            # under lax.cond so the other ticks skip it entirely instead of
+            # computing it masked — at vocab-sized heads the masked version
+            # would cost ~V x the v=1 executor's replicated compute.
+            head_aval = jax.eval_shape(run_head)
+            loss_m, d_rep, d_out_last, user_out = jax.lax.cond(
+                is_lastk,
+                run_head,
+                lambda: jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), head_aval
+                ),
+            )
+
+            cot_in = get_ring(cotbuf, bkc, b_slots)
+            cot_in = jax.tree_util.tree_map(
+                lambda c, d: c.at[S - 1].set(
+                    jnp.where(is_lastk, d.astype(c.dtype), c[S - 1])
+                ),
+                cot_in, d_out_last,
+            )
+            b_sides = gather_sides_rows(bmc)
+            stash_in = get_ring(stash, bkc, b_slots)
+            ch_params_b = select_chunk(staged_params, bkc)
+            ch_xs_b = select_chunk(staged_xs, bkc)
+            ch_act_b = select_chunk(active_rows, bkc)
+            c_ids_b = bkc * S + stage_ids
+
+            def chunk_bwd(lp, lxs, x, side, cot, c_idx, m_idx, act_row):
+                def f(lp_, x_, side_):
+                    return chunk_fwd(lp_, lxs, x_, side_, c_idx, m_idx, act_row)
+
+                _, vjp = jax.vjp(f, lp, x, side)
+                return vjp((cot, aux_seed))
+
+            d_lp_rows, d_x_rows, d_side_rows = jax.vmap(
+                chunk_bwd,
+                in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0, 0),
+            )(ch_params_b, ch_xs_b, stash_in,
+              b_sides, cot_in, c_ids_b, bmc, ch_act_b)
+            d_lp_rows = pin_stage_axis(d_lp_rows)
+            d_x_rows = pin_stage_axis(d_x_rows)
+
+            # Accumulate layer grads into the per-(stage, chunk) slot.
+            def acc_chunk_rows(acc, rows):
+                def upd(a, r):
+                    def one(av, k, rv, m):
+                        cur = jax.lax.dynamic_index_in_dim(av, k, 0, keepdims=False)
+                        new = cur + jnp.where(m, rv.astype(av.dtype), 0)
+                        return jax.lax.dynamic_update_index_in_dim(av, new, k, 0)
+
+                    return jax.vmap(one)(a, bkc, r, b_active)
+
+                return jax.tree_util.tree_map(upd, acc, rows)
+
+            dlay = acc_chunk_rows(dlay, d_lp_rows)
+
+            drep = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(is_lastk, g.astype(a.dtype), 0),
+                drep, d_rep,
+            )
+
+            dembed = scatter_add_mb(
+                dembed, bmc[0],
+                jax.tree_util.tree_map(lambda r: r[0], d_x_rows),
+                b_active[0] & (bkc[0] == 0),
+            )
+
+            if sides is not None and dsides is not None:
+                def one_stage_side_add(ds, s):
+                    row_leaves, _, _ = _inexact_leaves(
+                        jax.tree_util.tree_map(lambda r: r[s], d_side_rows)
+                    )
+                    vals = [row_leaves[i] for i in side_idx]
+                    return [
+                        _scatter_add_leaf(d, bmc[s], v, b_active[s])
+                        for d, v in zip(ds, vals)
+                    ]
+
+                for s in range(S):
+                    dsides = one_stage_side_add(dsides, s)
+
+            losses = losses.at[m_last].set(
+                jnp.where(is_lastk, loss_m.astype(jnp.float32), losses[m_last])
+            )
+            outs = scatter_set_mb(outs, m_last, user_out, is_lastk)
+            xfer_b = d_x_rows
+
+        new_carry = (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, dlay,
+                     drep, dembed, dsides, losses, outs)
+        if hc is not None:
+            new_carry = new_carry + ((hbad, habs, hmb),)
+        return new_carry, None
+
+    carry0 = (
+        pin_stage_axis(inbuf0), pin_stage_axis(stash0),
+        pin_stage_axis(cotbuf0), pin_stage_axis(outbuf0),
+        pin_stage_axis(xfer_f0), pin_stage_axis(xfer_b0),
+        pin_stage_axis(dlay0), drep0, dembed0, dsides0, losses0, outs0,
+    )
+    if hc is not None:
+        carry0 = carry0 + ((
+            jnp.zeros((S, V), jnp.float32), jnp.zeros((S, V), jnp.float32),
+            jnp.full((S, V), -1.0, jnp.float32),
+        ),)
+
+    carry_end, _ = jax.lax.scan(
+        lambda c, t: tick_impl(c, t, True, False), carry0, jnp.arange(0, t_b0)
+    )
+    carry_end, _ = jax.lax.scan(
+        lambda c, t: tick_impl(c, t, True, True), carry_end,
+        jnp.arange(t_b0, t_fe),
+    )
+    carry_end, _ = jax.lax.scan(
+        lambda c, t: tick_impl(c, t, False, True), carry_end,
+        jnp.arange(t_fe, n_ticks),
+    )
+    if hc is not None:
+        (_, _, _, _, _, _, dlay, drep, dembed, dsides, losses, outs,
+         (hbad, habs, hmb)) = carry_end
+        # Grid position (s, k) holds GLOBAL chunk k*S + s.
+        chunk_ids = np.arange(V)[None, :] * S + np.arange(S)[:, None]
+        hc.add_stage_stats("1f1b", hbad, habs, hmb, chunk_ids=chunk_ids)
+    else:
+        (_, _, _, _, _, _, dlay, drep, dembed, dsides, losses,
+         outs) = carry_end
+
+    # ---- embedding backward ------------------------------------------
+
+    def embed_bwd(acc, xs):
+        mb_input, key, dcarry, dside_row = xs
+
+        def embed_inexact(p_rest):
+            args, kwargs = mb_input
+            out, aux = apply_collecting_aux(
+                module, {"params": cast_half(with_layers(p_rest))}, *args,
+                rngs=_mk_rngs(model, key, "embed"),
+                method=spec.embed_method, **kwargs,
+            )
+            leaves, _, idx = _inexact_leaves(out)
+            return [leaves[i] for i in idx] + [aux]
+
+        out_aval = jax.eval_shape(embed_inexact, params_rest)
+        if sides is not None:
+            cots = list(jax.tree_util.tree_leaves(dcarry)) + list(dside_row)
+        else:
+            cots = jax.tree_util.tree_leaves(dcarry)
+        cots = cots + [aux_seed]
+        cots = [c.astype(a.dtype) for c, a in zip(cots, out_aval)]
+        _, vjp = jax.vjp(embed_inexact, params_rest)
+        (dp,) = vjp(cots)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), acc, dp
+        )
+        return acc, None
+
+    if spec.embed_method is not None:
+        demb_params0 = param_grad_zeros(params_rest)
+        dside_stack = tuple(dsides) if dsides is not None else ()
+        demb_params, _ = jax.lax.scan(
+            embed_bwd, demb_params0,
+            (stacked_inputs, mb_keys, dembed, dside_stack),
+        )
+    else:
+        demb_params = None
+
+    # ---- assemble the full gradient tree -----------------------------
+
+    # [S, V, maxp, ...] accumulated chunk grads -> [L, ...]. The chunked
+    # placement interleaves the layer axis across stages, so this is
+    # always a scatter-add (the v=1 dense-reshape shortcut cannot apply).
+    flat_idx = jnp.asarray(idx_np.reshape(-1))
+    flat_mask = active_np.reshape(-1)
+
+    def to_layers(g):
+        gf = g.reshape((S * V * maxp,) + g.shape[3:])
+        gf = gf * flat_mask.reshape((-1,) + (1,) * (gf.ndim - 1))
+        return jnp.zeros((L,) + g.shape[3:], g.dtype).at[flat_idx].add(gf)
+
+    layer_grads = jax.tree_util.tree_map(to_layers, dlay)
+    if demb_params is not None:
+        drep = jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(a.dtype), drep, demb_params
+        )
     grads = _set_subtree(drep, spec.layer_path, layer_grads)
     grads = jax.tree_util.tree_map(
         lambda g, p: g.astype(jnp.result_type(p)), grads, params

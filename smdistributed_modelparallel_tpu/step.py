@@ -266,9 +266,16 @@ class StepFunction:
         # under: smp.reset()/re-init with a different cfg or mesh must not
         # serve a stale program whose shapes/flags happen to collide. The
         # health mode is part of the key: the sentinel reduces live inside
-        # the program, so flipping SMP_HEALTH_CHECK recompiles.
+        # the program, so flipping SMP_HEALTH_CHECK recompiles. The
+        # pipeline shape tuple (pp, schedule, virtual degree, microbatch
+        # math) is keyed explicitly as well: the baked 1F1B schedule and
+        # chunk layout depend on all four, and the key must not rely on
+        # every config change also bumping the generation.
         hmode = health.mode()
-        key = (state.generation,
+        pipe_key = (cfg.pipeline_parallel_degree, cfg.pipeline,
+                    getattr(cfg, "virtual_pipeline_degree", 1),
+                    num_mb, cfg.active_microbatches)
+        key = (state.generation, pipe_key,
                treedef, tuple(scan_idx), tuple(bcast_idx),
                tuple((i, _static_key(v)) for i, v in sorted(static.items())),
                tuple((v.shape, str(v.dtype)) for v in scan_vals),
@@ -565,8 +572,11 @@ class StepFunction:
 
         Schedule dispatch: ``pipeline: interleaved`` (the default) lowers to
         the 1F1B executor with bounded in-flight microbatches
-        (``parallel/pipeline_1f1b.py``); ``simple`` / forward-only steps use
-        the fill-drain executor (``parallel/pipeline.py``).
+        (``parallel/pipeline_1f1b.py``; ``virtual_pipeline_degree > 1``
+        selects its interleaved virtual-stage generalization inside the
+        same entry point); ``simple`` / forward-only steps use the
+        fill-drain executor (``parallel/pipeline.py``, which runs chunked
+        layouts as sequential logical stages).
         """
         from smdistributed_modelparallel_tpu.parallel.pipeline import pipeline_forward
 

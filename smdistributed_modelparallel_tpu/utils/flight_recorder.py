@@ -76,7 +76,7 @@ _FIELDS = {
     COLLECTIVE: ("op", "group", "nbytes", "group_size", "seq"),
     SYNC: ("name", "group", "seq", "wall_us"),
     WAIT: ("what", "peer", "tx", "outcome", "elapsed_us"),
-    SLOT: ("schedule", "tick", "stage", "direction", "microbatch"),
+    SLOT: ("schedule", "tick", "stage", "direction", "microbatch", "chunk"),
     PHASE: ("phase",),
     STEP: ("event", "step"),
     COMPILE: ("event", "name", "elapsed_us"),
@@ -170,25 +170,34 @@ class FlightRecorder:
         self.record(WAIT, what, int(peer), int(tx), outcome,
                     int(elapsed_s * 1e6))
 
-    def record_slot(self, schedule, tick, stage, direction, microbatch):
-        self.record(SLOT, schedule, int(tick), int(stage), direction,
-                    int(microbatch))
+    def record_slot(self, schedule, tick, stage, direction, microbatch,
+                    chunk=None):
+        """``chunk`` is the virtual-pipeline chunk coordinate (interleaved
+        schedules only); plain schedules omit it and their events keep the
+        pre-chunk field layout."""
+        if chunk is None:
+            self.record(SLOT, schedule, int(tick), int(stage), direction,
+                        int(microbatch))
+        else:
+            self.record(SLOT, schedule, int(tick), int(stage), direction,
+                        int(microbatch), int(chunk))
 
     def record_schedule(self, schedule, slots, cap=512):
         """Record a static pipeline schedule's busy slots (once, at
         build/trace time — the compiled program replays it every step).
-        ``slots``: iterable of (tick, stage, direction, microbatch).
-        Bounded to ``cap`` events so a huge schedule cannot evict the
-        whole collective/wait history from the ring; truncation leaves an
-        explicit marker."""
+        ``slots``: iterable of (tick, stage, direction, microbatch) or
+        (tick, stage, direction, microbatch, chunk) for interleaved
+        virtual-stage schedules. Bounded to ``cap`` events so a huge
+        schedule cannot evict the whole collective/wait history from the
+        ring; truncation leaves an explicit marker."""
         if not self.enabled:
             return
         n = 0
-        for tick, stage, direction, mb in slots:
+        for slot in slots:
             if n >= cap:
                 self.record(SLOT, schedule, -1, -1, "truncated", -1)
                 break
-            self.record_slot(schedule, tick, stage, direction, mb)
+            self.record_slot(schedule, *slot)
             n += 1
 
     def record_phase(self, phase):

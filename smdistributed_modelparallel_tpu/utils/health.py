@@ -161,9 +161,27 @@ class HealthCollector:
         first = jnp.where(bad > 0, jnp.argmax(per > 0).astype(jnp.float32), -1.0)
         self.add(name, bad, mx, first)
 
-    def add_stage_stats(self, schedule, bad, absmax, first_mb):
+    def add_stage_stats(self, schedule, bad, absmax, first_mb,
+                        chunk_ids=None):
         """Per-pipeline-stage entries from an executor's accumulated
-        boundary-activation stats ([S] vectors; static S)."""
+        boundary-activation stats ([S] vectors; static S). Under virtual
+        pipeline chunks the executors pass [S, V] grids plus a matching
+        ``chunk_ids`` grid of GLOBAL chunk (boundary) indices, and the
+        tags gain that coordinate — so a sentinel trip attributes to the
+        exact model chunk, the stage says where it physically ran, and
+        the two executors' tags for the same layers reconcile even though
+        their placements differ (1F1B interleaves chunks, the fill-drain
+        forward path runs them sequentially)."""
+        if getattr(bad, "ndim", 1) == 2:
+            num_stages, virtual = (int(d) for d in bad.shape)
+            for s in range(num_stages):
+                for k in range(virtual):
+                    g = int(chunk_ids[s][k]) if chunk_ids is not None else k
+                    self.add(
+                        f"pp/{schedule}/stage{s}/chunk{g}",
+                        bad[s, k], absmax[s, k], first_mb[s, k],
+                    )
+            return
         num_stages = int(bad.shape[0])
         for s in range(num_stages):
             self.add(f"pp/{schedule}/stage{s}", bad[s], absmax[s], first_mb[s])

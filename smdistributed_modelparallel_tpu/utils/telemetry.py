@@ -570,20 +570,21 @@ def record_comm(op, group, nbytes, group_size):
 
 
 def record_pipeline_occupancy(schedule, num_stages, num_microbatches,
-                              busy_slots, total_slots):
+                              busy_slots, total_slots, virtual=1):
     """Record measured schedule occupancy -> bubble fraction gauges.
 
     ``busy_slots``/``total_slots`` count (tick, stage[, sub-step]) slots of
     the static schedule actually baked into the compiled program; the
-    theoretical fill-drain bound is ``(pp-1)/(mb+pp-1)``. Gauges (not
-    counters): executors trace more than once per compile and gauge sets
-    are idempotent.
+    theoretical bound is ``(pp-1)/(mb+pp-1)`` for the plain schedules and
+    the interleaved ``(pp-1)/(v*mb+pp-1)`` when ``virtual > 1`` (each rank
+    owns ``v`` model chunks, so a schedule slot is a chunk sub-step and
+    the fill/drain ramps shrink by ``v``). Gauges (not counters):
+    executors trace more than once per compile and gauge sets are
+    idempotent.
     """
     measured = 1.0 - (busy_slots / total_slots) if total_slots else 0.0
-    theoretical = (
-        (num_stages - 1) / (num_microbatches + num_stages - 1)
-        if num_microbatches + num_stages > 1 else 0.0
-    )
+    denom = virtual * num_microbatches + num_stages - 1
+    theoretical = (num_stages - 1) / denom if denom > 0 else 0.0
     lab = dict(schedule=schedule)
     telemetry.gauge(
         "smp_pipeline_bubble_fraction",
@@ -591,8 +592,12 @@ def record_pipeline_occupancy(schedule, num_stages, num_microbatches,
     ).labels(**lab).set(measured)
     telemetry.gauge(
         "smp_pipeline_bubble_fraction_theoretical",
-        "fill-drain bound (pp-1)/(mb+pp-1)",
+        "schedule bound (pp-1)/(v*mb+pp-1); v=1 is the fill-drain bound",
     ).labels(**lab).set(theoretical)
+    telemetry.gauge(
+        "smp_pipeline_virtual_stages",
+        "virtual pipeline chunks per stage (1 = no interleaving)",
+    ).labels(**lab).set(virtual)
     telemetry.gauge(
         "smp_pipeline_schedule_slots", "slots in the static schedule"
     ).labels(state="busy", **lab).set(busy_slots)

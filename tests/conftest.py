@@ -98,6 +98,13 @@ _SLOW_TESTS = (
     "test_transformer.py::TestCrossAttention",
     "test_transformer.py::TestLMHeadTPParity",
     "test_pipeline_1f1b.py::TestInterleavedParity",
+    # Virtual-stage (pp*v >= 4) parity + compiled-HLO cases: each is a
+    # multi-pipeline-compile end-to-end run, tier 2 by nature. (Tier-1
+    # still guards v=1 schedule identity via the pure-numpy
+    # test_v1_reduces_to_plain_schedule and runs the v=2 end-to-end
+    # smoke + occupancy acceptance in TestVirtualStages.)
+    "test_pipeline_1f1b.py::TestVirtualParity",
+    "test_pipeline_1f1b.py::TestVirtualHLOGuard",
     "test_step.py::test_loss_decreases_transformer",
     "test_checkpoint.py::TestSaveLoad::test_partial_roundtrip",
     # Re-tiered from --durations with the compile cache off (each >= ~15s

@@ -2,10 +2,14 @@
 
 Parity targets: reference ``torch/pipeline.py:136-145`` (backward-first
 interleaving) and ``torch/server_queue.py:629-676`` (``active_microbatches``
-in-flight cap). Covers: static-schedule invariants, interleaved-vs-simple
-loss/grad parity, the peak-memory advantage (compiled-HLO temp buffer
-sizes), and window sensitivity.
+in-flight cap). Covers: static-schedule invariants (plain and virtual-stage
+interleaved), interleaved-vs-simple loss/grad parity, virtual-stage
+(``virtual_pipeline_degree``) parity + bubble accounting + HLO regression
+guards, the peak-memory advantage (compiled-HLO temp buffer sizes), and
+window sensitivity.
 """
+
+import re
 
 import numpy as np
 import pytest
@@ -16,10 +20,13 @@ import optax
 
 import smdistributed_modelparallel_tpu as smp
 from smdistributed_modelparallel_tpu.backend.state import state
-from smdistributed_modelparallel_tpu.models.transformer_lm import TransformerLM
 from smdistributed_modelparallel_tpu.parallel.pipeline_1f1b import (
     build_1f1b_schedule,
+    build_interleaved_1f1b_schedule,
+    interleaved_phase_bounds,
+    schedule_occupancy,
 )
+from smdistributed_modelparallel_tpu.models.transformer_lm import TransformerLM
 from tests.models import softmax_xent
 
 
@@ -65,7 +72,95 @@ class TestSchedule:
         assert f2.shape[0] <= f1.shape[0]
 
 
-def _train(cfg, steps=2, n_layers=4, batch=8):
+class TestInterleavedSchedule:
+    """Generalized (chunk, microbatch) schedule: virtual pipeline stages."""
+
+    @pytest.mark.parametrize("S,M,W,V", [
+        (2, 4, 3, 2), (2, 8, 4, 2), (2, 8, 4, 4), (4, 8, 8, 2),
+        (3, 7, 6, 3), (2, 8, 2, 2), (4, 4, 2, 2), (2, 3, 1, 3),
+        (1, 4, 2, 2), (3, 9, 6, 2),
+    ])
+    def test_invariants(self, S, M, W, V):
+        fk, fm, bk, bm = build_interleaved_1f1b_schedule(S, M, W, V)
+        C = S * V
+        n_ticks = fm.shape[0]
+        fwd_tick, bwd_tick = {}, {}
+        for t in range(n_ticks):
+            for s in range(S):
+                if fm[t, s] >= 0:
+                    c = fk[t, s] * S + s
+                    assert (c, fm[t, s]) not in fwd_tick
+                    fwd_tick[(c, fm[t, s])] = t
+                if bm[t, s] >= 0:
+                    c = bk[t, s] * S + s
+                    assert (c, bm[t, s]) not in bwd_tick
+                    bwd_tick[(c, bm[t, s])] = t
+        # Every (chunk, microbatch) forwarded and backwarded exactly once.
+        want = {(c, m) for c in range(C) for m in range(M)}
+        assert set(fwd_tick) == want
+        assert set(bwd_tick) == want
+        for c in range(C):
+            for m in range(M):
+                # Cross-chunk ordering (chunk c -> c+1 crosses one stage
+                # boundary, so strictly-earlier ticks).
+                if c > 0:
+                    assert fwd_tick[(c - 1, m)] < fwd_tick[(c, m)]
+                if c < C - 1:
+                    assert bwd_tick[(c + 1, m)] < bwd_tick[(c, m)]
+                # Per-chunk fwd before bwd (same tick only legal on the
+                # last chunk, whose cotangent comes from the loss).
+                assert fwd_tick[(c, m)] <= bwd_tick[(c, m)]
+                if fwd_tick[(c, m)] == bwd_tick[(c, m)]:
+                    assert c == C - 1
+        # In-flight window cap, per (stage, chunk).
+        for c in range(C):
+            for t in range(n_ticks):
+                fdone = sum(1 for m in range(M) if fwd_tick[(c, m)] <= t)
+                bdone = sum(1 for m in range(M) if bwd_tick[(c, m)] <= t)
+                assert fdone - bdone <= W, (c, t)
+
+    @pytest.mark.parametrize("S,M,W", [
+        (2, 4, 3), (4, 8, 5), (4, 4, 1), (3, 7, 4), (1, 4, 2),
+    ])
+    def test_v1_reduces_to_plain_schedule(self, S, M, W):
+        """At virtual=1 the generalized scheduler IS the plain one: the
+        default path's baked schedule (and so its HLO) cannot drift."""
+        fk, fm, bk, bm = build_interleaved_1f1b_schedule(S, M, W, 1)
+        fwd, bwd = build_1f1b_schedule(S, M, W)
+        assert np.array_equal(fm, fwd)
+        assert np.array_equal(bm, bwd)
+        assert (fk[fm >= 0] == 0).all() and (bk[bm >= 0] == 0).all()
+
+    def test_occupancy_hits_interleaved_floor_at_pp2(self):
+        """(pp=2, mb=8, v=2, default window pp+2): occupancy over executed
+        sub-steps equals the interleaved bound 1/17 (vs 1/9 at v=1)."""
+        for V, want in ((1, 1 / 9), (2, 1 / 17)):
+            fk, fm, bk, bm = build_interleaved_1f1b_schedule(2, 8, 4, V)
+            t_b0, t_fe = interleaved_phase_bounds(fm, bm)
+            busy, total = schedule_occupancy(
+                fm, bm, fwd_ticks=t_fe, bwd_ticks=fm.shape[0] - t_b0
+            )
+            assert busy == 2 * 2 * V * 8  # chunk sub-steps: 2*S*V*M
+            assert 1 - busy / total == pytest.approx(want)
+
+    def test_occupancy_default_args_match_v1_executor(self):
+        """schedule_occupancy without tick bounds keeps the v=1 executor's
+        accounting (paired ticks: total = 2*T*S)."""
+        fwd, bwd = build_1f1b_schedule(2, 4, 3)
+        busy, total = schedule_occupancy(fwd, bwd)
+        assert total == 2 * fwd.shape[0] * 2
+        assert busy == 2 * 2 * 4
+
+    def test_phase_bounds_split_warmup_and_cooldown(self):
+        fk, fm, bk, bm = build_interleaved_1f1b_schedule(2, 8, 4, 2)
+        t_b0, t_fe = interleaved_phase_bounds(fm, bm)
+        assert 0 < t_b0 < t_fe <= fm.shape[0]
+        assert (bm[:t_b0] < 0).all()       # warmup: no backward anywhere
+        assert (fm[t_fe:] < 0).all()       # cooldown: no forward anywhere
+        assert (bm[t_b0] >= 0).any() and (fm[t_fe - 1] >= 0).any()
+
+
+def _train(cfg, steps=2, n_layers=4, batch=8, step_fn=None):
     smp.reset()
     smp.init(cfg)
     module = TransformerLM(
@@ -75,12 +170,15 @@ def _train(cfg, steps=2, n_layers=4, batch=8):
     optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
     ids = jax.random.randint(jax.random.key(0), (batch, 12), 0, 32)
 
-    @smp.step
-    def train_step(model, batch):
-        logits = model(batch)
-        loss = jnp.mean(softmax_xent(logits[:, :-1], batch[:, 1:]))
-        model.backward(loss)
-        return loss
+    if step_fn is None:
+        @smp.step
+        def train_step(model, batch):
+            logits = model(batch)
+            loss = jnp.mean(softmax_xent(logits[:, :-1], batch[:, 1:]))
+            model.backward(loss)
+            return loss
+    else:
+        train_step = step_fn
 
     losses, grads = [], None
     for i in range(steps):
@@ -119,6 +217,250 @@ class TestInterleavedParity:
                 "active_microbatches": w, "ddp": True,
             })
             np.testing.assert_allclose(windowed, base, rtol=1e-4, atol=1e-5)
+
+
+def _bubble_gauges():
+    from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+
+    metrics = telemetry.report()["metrics"]
+
+    def one(name):
+        series = [
+            s for s in metrics.get(name, {}).get("series", [])
+            if s.get("labels", {}).get("schedule") == "1f1b"
+        ]
+        return series[0]["value"] if series else None
+
+    return (one("smp_pipeline_bubble_fraction"),
+            one("smp_pipeline_bubble_fraction_theoretical"),
+            one("smp_pipeline_virtual_stages"))
+
+
+class TestVirtualStages:
+    def test_v2_trains_reports_bubble_and_retraces(self):
+        """Fast-tier end-to-end: one shared @smp.step function trained at
+        (pp=2, mb=8, v=1) then re-initialized at v=2. Asserts the
+        acceptance numbers — theoretical bubble 1/9 -> 1/17 with the
+        measured occupancy gauge agreeing — plus loss parity between the
+        two virtual degrees and a fresh compile (cache retrace) for the
+        changed ``virtual_pipeline_degree``."""
+        @smp.step
+        def train_step(model, batch):
+            logits = model(batch)
+            loss = jnp.mean(softmax_xent(logits[:, :-1], batch[:, 1:]))
+            model.backward(loss)
+            return loss
+
+        v1, _, _ = _train(
+            {"pipeline_parallel_degree": 2, "microbatches": 8, "ddp": True},
+            step_fn=train_step,
+        )
+        measured, theoretical, virt = _bubble_gauges()
+        assert theoretical == pytest.approx(1 / 9)
+        assert virt == 1.0
+        keys_after_v1 = set(train_step._cache)
+
+        v2, _, _ = _train(
+            {"pipeline_parallel_degree": 2, "microbatches": 8, "ddp": True,
+             "virtual_pipeline_degree": 2},
+            step_fn=train_step,
+        )
+        measured, theoretical, virt = _bubble_gauges()
+        assert theoretical == pytest.approx(1 / 17)
+        assert measured == pytest.approx(1 / 17)
+        assert virt == 2.0
+        # Changed v -> a NEW compiled entry (the pipeline tuple is part of
+        # the cache key; serving the v=1 program would replay the wrong
+        # schedule).
+        new_keys = set(train_step._cache) - keys_after_v1
+        assert new_keys, "v=2 did not produce a fresh compiled step"
+        assert any(k[1][2] == 2 for k in new_keys)
+        np.testing.assert_allclose(v2, v1, rtol=1e-4, atol=1e-5)
+
+    def test_chunked_partition_layout(self):
+        """Round-robin chunk placement: L=8 over pp2 x v2 -> 4 chunks of 2,
+        chunk c on stage c % 2, and the flight recorder's schedule slots
+        carry the chunk coordinate."""
+        from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+            flight_recorder,
+        )
+
+        flight_recorder.clear()
+        _train(
+            {"pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+             "virtual_pipeline_degree": 2},
+            steps=1, n_layers=8,
+        )
+        spec = state.model._pipeline_spec
+        assert spec.virtual_degree == 2
+        assert spec.boundaries == [(0, 2), (2, 4), (4, 6), (6, 8)]
+        assignment = state.model._partition_result
+        assert assignment["layers/block#0"] == 0   # chunk 0 -> stage 0
+        assert assignment["layers/block#2"] == 1   # chunk 1 -> stage 1
+        assert assignment["layers/block#4"] == 0   # chunk 2 -> stage 0
+        assert assignment["layers/block#6"] == 1   # chunk 3 -> stage 1
+        slots = [e for e in flight_recorder.snapshot()
+                 if e["kind"] == "slot" and e.get("schedule") == "1f1b"]
+        assert slots and all("chunk" in e for e in slots)
+        # Slots carry GLOBAL chunk (boundary) ids; chunk c runs on stage
+        # c % pp.
+        assert {e["chunk"] for e in slots} == {0, 1, 2, 3}
+        assert all(e["chunk"] % 2 == e["stage"] for e in slots)
+
+    def test_manual_pins_rejected_under_virtual(self):
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            PartitionError,
+        )
+
+        smp.reset()
+        smp.init({"pipeline_parallel_degree": 2, "microbatches": 4,
+                  "ddp": True, "virtual_pipeline_degree": 2})
+        smp.set_partition("layers/block#0", 1)
+        module = TransformerLM(
+            vocab_size=32, max_len=12, d_model=16, n_layers=4, n_heads=2,
+        )
+        model = smp.DistributedModel(module)
+        ids = jax.random.randint(jax.random.key(0), (8, 12), 0, 32)
+
+        @smp.step
+        def train_step(model, batch):
+            logits = model(batch)
+            loss = jnp.mean(softmax_xent(logits[:, :-1], batch[:, 1:]))
+            model.backward(loss)
+            return loss
+
+        with pytest.raises(PartitionError, match="virtual_pipeline_degree"):
+            train_step(model, ids)
+
+    def test_config_rejects_virtual_with_simple_schedule(self):
+        from smdistributed_modelparallel_tpu.utils.exceptions import ConfigError
+
+        with pytest.raises(ConfigError):
+            smp.ModelParallelConfig({
+                "pipeline": "simple", "virtual_pipeline_degree": 2,
+            })
+
+    def test_config_alias_and_default(self):
+        cfg = smp.ModelParallelConfig({"virtual_pipeline_parallel_degree": 3})
+        assert cfg.virtual_pipeline_degree == 3
+        assert smp.ModelParallelConfig({}).virtual_pipeline_degree == 1
+
+
+def _strip_hlo(text):
+    return re.sub(r"metadata=\{[^}]*\}", "", text)
+
+
+def _mk_step():
+    """A fresh @smp.step train step (identical source each call, so the
+    lowered programs of two instances are comparable byte-for-byte)."""
+
+    @smp.step
+    def train_step(model, batch):
+        logits = model(batch)
+        loss = jnp.mean(softmax_xent(logits[:, :-1], batch[:, 1:]))
+        model.backward(loss)
+        return loss
+
+    return train_step
+
+
+def _compiled_step_hlo(step_fn):
+    runners = list(step_fn._cache.values())
+    assert len(runners) == 1
+    compiled = runners[0].holder.get("compiled")
+    if compiled is None:
+        pytest.skip("AOT step executable unavailable on this backend")
+    return compiled.as_text()
+
+
+class TestVirtualHLOGuard:
+    """No perf tax on the default path; permutes scale as expected."""
+
+    def test_v1_explicit_knob_is_byte_identical(self):
+        """virtual_pipeline_degree=1 (explicit) vs unset: the compiled pp=2
+        step must be byte-identical — the virtual machinery must not leak
+        into the default path."""
+        step_a, step_b = _mk_step(), _mk_step()
+        _train({"pipeline_parallel_degree": 2, "microbatches": 4,
+                "ddp": True}, steps=1, step_fn=step_a)
+        default_hlo = _compiled_step_hlo(step_a)
+        _train({"pipeline_parallel_degree": 2, "microbatches": 4,
+                "ddp": True, "virtual_pipeline_degree": 1},
+               steps=1, step_fn=step_b)
+        explicit_hlo = _compiled_step_hlo(step_b)
+        assert _strip_hlo(default_hlo) == _strip_hlo(explicit_hlo)
+        # The pp permutes are present in the default program (the guard
+        # below compares against this count).
+        assert default_hlo.count("collective-permute") > 0
+
+    def test_v2_keeps_pipeline_permutes(self):
+        """The v=2 program must still be pipeline-partitioned: the chunked
+        gather breaks GSPMD's sharding propagation, and without the
+        executor's stage-axis pins XLA silently replicates the whole tick
+        loop (0 collective-permutes — each device computing every stage).
+        Static permute count is bounded: the double-buffered transfers add
+        no per-chunk permutes (rolls stay one-per-direction-per-tick; the
+        tick count, not the op count, scales with v)."""
+        step_a, step_b = _mk_step(), _mk_step()
+        _train({"pipeline_parallel_degree": 2, "microbatches": 4,
+                "ddp": True}, steps=1, step_fn=step_a)
+        v1_count = _compiled_step_hlo(step_a).count("collective-permute")
+        _train({"pipeline_parallel_degree": 2, "microbatches": 4,
+                "ddp": True, "virtual_pipeline_degree": 2},
+               steps=1, step_fn=step_b)
+        v2_count = _compiled_step_hlo(step_b).count("collective-permute")
+        assert v1_count > 0
+        assert v2_count > 0, "v=2 program lost its pipeline partitioning"
+        # Three scan bodies (warmup/steady/cooldown) instead of one, each
+        # with the same per-tick permute pair: bounded static growth.
+        assert v2_count <= 10 * v1_count
+
+
+class TestVirtualParity:
+    def test_v2_matches_baseline_and_fill_drain(self):
+        """The tentpole numerical contract at (pp=2, v=2): grads, losses
+        and outputs interchangeable with the fill-drain executor and the
+        pp=1 baseline on the same inputs (same tolerances as the existing
+        1F1B parity guarantee)."""
+        base, base_grads, _ = _train({"microbatches": 4})
+        simple, s_grads, _ = _train({
+            "pipeline_parallel_degree": 2, "microbatches": 4,
+            "pipeline": "simple", "ddp": True,
+        })
+        inter, i_grads, _ = _train({
+            "pipeline_parallel_degree": 2, "microbatches": 4,
+            "virtual_pipeline_degree": 2, "ddp": True,
+        })
+        np.testing.assert_allclose(inter, base, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(inter, simple, rtol=1e-4, atol=1e-5)
+        for got, want in ((i_grads, base_grads), (i_grads, s_grads)):
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, rtol=1e-3, atol=1e-5
+                ),
+                got, want,
+            )
+
+    def test_v2_uneven_layers_and_window(self):
+        """Uneven chunking (L=6 over 4 chunks) and a tight in-flight
+        window both preserve parity."""
+        base, base_grads, _ = _train({"microbatches": 4}, n_layers=6)
+        v2, v2_grads, _ = _train({
+            "pipeline_parallel_degree": 2, "microbatches": 4,
+            "virtual_pipeline_degree": 2, "ddp": True,
+        }, n_layers=6)
+        np.testing.assert_allclose(v2, base, rtol=1e-4, atol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5),
+            v2_grads, base_grads,
+        )
+        base8, _, _ = _train({"microbatches": 8})
+        tight, _, _ = _train({
+            "pipeline_parallel_degree": 2, "microbatches": 8,
+            "virtual_pipeline_degree": 2, "active_microbatches": 2,
+            "ddp": True,
+        })
+        np.testing.assert_allclose(tight, base8, rtol=1e-4, atol=1e-5)
 
 
 class TestMemory:
