@@ -27,24 +27,15 @@ import sys
 import time
 
 
-# Peak dense bf16 TFLOP/s per chip by device kind (public spec sheets).
-_PEAK_TFLOPS = [
-    ("v6", 918.0),
-    ("v5p", 459.0),
-    ("v5 lite", 197.0),   # v5e
-    ("v5e", 197.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 46.0),
-]
-
-
 def _chip_peak_tflops(device):
-    kind = getattr(device, "device_kind", "").lower()
-    for frag, peak in _PEAK_TFLOPS:
-        if frag in kind:
-            return peak
-    return None
+    """Peak dense bf16 TFLOP/s of ``device`` — single source of truth in
+    utils/profiling.py (spec table by device kind, SMP_PEAK_TFLOPS
+    override). Imported lazily: bench must not touch the package before
+    the device-probe logic has decided the platform."""
+    from smdistributed_modelparallel_tpu.utils.profiling import device_peaks
+
+    flops, _ = device_peaks(device)
+    return flops / 1e12 if flops else None
 
 
 def _model_flops_per_step(n_layers, d_model, vocab, batch, seq):
@@ -579,6 +570,37 @@ def main():
     peak = _chip_peak_tflops(jax.devices()[0]) if on_tpu else None
     mfu = (flops / dt / 1e12) / peak if peak else None
 
+    # Roofline attribution (smp.profiling): analytic model FLOPs (the MFU
+    # definition above, unchanged across rounds) joined with the compiled
+    # step's bytes-accessed and the measured step time into the
+    # compute/comm/bubble decomposition — recorded in every BENCH_r*.json
+    # block so rounds feed scripts/perf_ledger.py without hand arithmetic.
+    # On the CPU smoke the peaks are unknown and the fields stay null.
+    roofline_out = None
+    try:
+        from smdistributed_modelparallel_tpu.utils import profiling
+
+        runner = next(iter(train_step._cache.values()), None)
+        compiled_exec = (
+            runner.holder.get("compiled") if runner is not None else None
+        )
+        rep = profiling.roofline(
+            "bench", step_time_s=dt, flops=float(flops),
+            compiled=compiled_exec,
+            peak_flops=peak * 1e12 if peak else None,
+        )
+        rd = rep.as_dict()
+        roofline_out = {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in rd.items()
+            if k in ("mfu", "bytes_accessed", "arithmetic_intensity",
+                     "ridge_intensity", "bound", "compute_s", "memory_s",
+                     "bubble_fraction", "bubble_s", "comm_s",
+                     "achieved_flops_per_s", "achieved_bytes_per_s")
+        }
+    except Exception as e:  # attribution must never kill the bench
+        sys.stderr.write(f"bench: roofline attribution unavailable ({e!r})\n")
+
     # Optional component breakdown (stderr; stdout stays one JSON line).
     # SMP_BENCH_BREAKDOWN=1 localizes the MFU gap: fwd-only vs fwd+bwd vs
     # full step isolates optimizer+update cost; the attention and LM-head
@@ -671,6 +693,7 @@ def main():
         "model_tflops_per_step": round(flops / 1e12, 3),
         "chip_peak_bf16_tflops": peak,
         "attention_path": attn_path,
+        "roofline": roofline_out,
         "final_loss": round(final_loss, 4),
     }))
 

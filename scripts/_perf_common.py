@@ -1,0 +1,97 @@
+"""Shared GPT-2 perf-probe harness.
+
+``scripts/perf_probe.py`` and ``scripts/step_breakdown.py`` used to carry
+copy-pasted duplicates of this model/loss/timing/readback scaffolding;
+both now import it from here and report their numbers through
+``smp.profiling.StepBreakdown`` so probe output lands in the same
+one-JSON-object-per-line schema as ``bench.py``'s stderr components (and
+the telemetry dump's ``smp_breakdown_ms`` gauge).
+
+Not a test module — the probes are manual TPU tools.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import jax.numpy as jnp
+
+# Canonical single-chip bench shape (bench.py's), reduced on CPU.
+VOCAB = 50257
+SEQ_TPU, SEQ_CPU = 1024, 64
+BATCH_TPU, BATCH_CPU = 8, 4
+NUM_MB = 4
+
+
+def on_tpu():
+    return jax.devices()[0].platform == "tpu"
+
+
+def bench_dims(tpu=None):
+    """The bench workload's dimensions: dict with seq_len, batch, num_mb,
+    vocab, model_kwargs (reduced model on CPU), iters."""
+    tpu = on_tpu() if tpu is None else tpu
+    return dict(
+        seq_len=SEQ_TPU if tpu else SEQ_CPU,
+        batch=BATCH_TPU if tpu else BATCH_CPU,
+        num_mb=NUM_MB,
+        vocab=VOCAB,
+        model_kwargs={} if tpu else dict(d_model=128, n_layers=2, n_heads=4),
+        iters=10 if tpu else 2,
+    )
+
+
+def readback(x):
+    """Force a device->host sync through one leaf (timing boundary)."""
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return float(np.asarray(leaf).ravel()[0])
+
+
+def timeit(fn, *args, iters=10):
+    """Mean per-iteration wall time with readback sync at both edges.
+    For donating functions use ``smp.profiling.StepBreakdown.record``
+    around a hand-threaded loop instead."""
+    out = fn(*args)
+    readback(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    readback(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def ce_loss(logits, ids):
+    """The bench's logsumexp CE over next-token targets."""
+    lg = logits[:, :-1]
+    tgt = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
+    lse = jax.scipy.special.logsumexp(lg.astype(jnp.float32), axis=-1)
+    return jnp.mean(lse - tgt.astype(jnp.float32))
+
+
+def half(params):
+    """bf16 compute cast of the floating leaves (master copies stay f32)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p,
+        params,
+    )
+
+
+def build_gpt2(tpu=None):
+    """(module, params0, ids, dims): the bench GPT-2 and its input batch."""
+    from smdistributed_modelparallel_tpu.models.gpt2 import gpt2_124m
+
+    dims = bench_dims(tpu)
+    ids = jax.random.randint(
+        jax.random.key(0), (dims["batch"], dims["seq_len"]), 0, dims["vocab"]
+    )
+    module = gpt2_124m(max_len=dims["seq_len"], **dims["model_kwargs"])
+    params0 = jax.jit(module.init)(jax.random.key(0), ids)["params"]
+    return module, params0, ids, dims

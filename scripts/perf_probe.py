@@ -1,17 +1,23 @@
 """Perf probe: decompose the bench gap vs plain JAX on the real chip.
 
 Measures (1) plain-JAX step, (2) full framework step via smp.step +
-optimizer.step, (3) the framework's compiled executable called directly with
-steady-state buffers — isolating device-program time from per-call Python
-dispatch. Not part of the test suite; run manually on TPU.
+optimizer.step, (3) the framework's compiled executable called directly
+with steady-state buffers — isolating device-program time from per-call
+Python dispatch — and joins each against the compiled cost analysis
+through ``smp.profiling.roofline``. Results are reported through
+``smp.profiling.StepBreakdown``: human-readable lines on stdout, one
+JSON object per line on stderr in bench.py's component schema. The
+GPT-2 harness (model/loss/timing/readback) is shared with
+``scripts/step_breakdown.py`` via ``scripts/_perf_common.py``.
+
+Not part of the test suite; run manually on TPU.
 """
 
 import functools
-import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _perf_common as common
 
 import jax
 import jax.numpy as jnp
@@ -19,41 +25,21 @@ import optax
 
 import smdistributed_modelparallel_tpu as smp
 from smdistributed_modelparallel_tpu.models.gpt2 import gpt2_124m
-
-
-def readback(x):
-    import numpy as np
-
-    return float(np.asarray(x.ravel()[0] if hasattr(x, "ravel") else x))
+from smdistributed_modelparallel_tpu.utils import profiling
 
 
 def main():
-    on_tpu = jax.devices()[0].platform == "tpu"
-    seq_len = 1024 if on_tpu else 64
-    batch = 8 if on_tpu else 4
-    num_mb = 4
-    vocab = 50257
-    model_kwargs = {} if on_tpu else dict(d_model=128, n_layers=2, n_heads=4)
-    iters = 10 if on_tpu else 2
-
-    def ce_loss(logits, ids):
-        lg = logits[:, :-1]
-        tgt = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
-        lse = jax.scipy.special.logsumexp(lg.astype(jnp.float32), axis=-1)
-        return jnp.mean(lse - tgt.astype(jnp.float32))
-
-    ids = jax.random.randint(jax.random.key(0), (batch, seq_len), 0, vocab)
-
-    module = gpt2_124m(max_len=seq_len, **model_kwargs)
-    params0 = jax.jit(module.init)(jax.random.key(0), ids)["params"]
+    module, params0, ids, dims = common.build_gpt2()
+    tpu = common.on_tpu()
+    num_mb, batch, seq_len = dims["num_mb"], dims["batch"], dims["seq_len"]
+    iters = dims["iters"]
     tx = optax.adamw(1e-4)
+    breakdown = profiling.StepBreakdown(context={"probe": "perf_probe"})
 
     def base_loss(params, mb):
-        if on_tpu:
-            params = jax.tree_util.tree_map(
-                lambda p: p.astype(jnp.bfloat16)
-                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
-        return ce_loss(module.apply({"params": params}, mb), mb)
+        if tpu:
+            params = common.half(params)
+        return common.ce_loss(module.apply({"params": params}, mb), mb)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def base_train(params, opt_state, ids):
@@ -69,45 +55,53 @@ def main():
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, jnp.mean(losses)
 
+    # [1] plain-JAX step (donating: hand-threaded loop, not timeit).
     opt_state0 = jax.jit(tx.init)(params0)
     p, o, l = base_train(params0, opt_state0, ids)
-    readback(l)
+    common.readback(l)
     t0 = time.perf_counter()
     for _ in range(iters):
         p, o, l = base_train(p, o, ids)
-    readback(l)
+    common.readback(l)
     base_dt = (time.perf_counter() - t0) / iters
+    breakdown.record("plain_jax_step", base_dt, iters=iters)
     print(f"[1] plain-JAX step:            {base_dt*1e3:8.2f} ms")
+    base_compiled = base_train.lower(params0, opt_state0, ids).compile()
     del p, o
 
+    # [2] full framework step.
     smp.reset()
-    smp.init({"microbatches": num_mb, "bf16": bool(on_tpu)})
-    model = smp.DistributedModel(gpt2_124m(max_len=seq_len, **model_kwargs))
+    smp.init({"microbatches": num_mb, "bf16": bool(tpu)})
+    model = smp.DistributedModel(
+        gpt2_124m(max_len=seq_len, **dims["model_kwargs"])
+    )
     optimizer = smp.DistributedOptimizer(optax.adamw(1e-4), model)
 
     @smp.step
     def train_step(model, batch_ids):
-        loss = ce_loss(model(batch_ids), batch_ids)
+        loss = common.ce_loss(model(batch_ids), batch_ids)
         model.backward(loss)
         return loss
 
     for _ in range(2):
         out = train_step(model, ids)
         optimizer.step()
-    readback(out.reduce_mean())
+    common.readback(out.reduce_mean())
 
     t0 = time.perf_counter()
     for _ in range(iters):
         out = train_step(model, ids)
         optimizer.step()
-    readback(out.reduce_mean())
+    common.readback(out.reduce_mean())
     fw_dt = (time.perf_counter() - t0) / iters
+    breakdown.record("smp_step_plus_optimizer", fw_dt, iters=iters)
     print(f"[2] smp.step + optimizer.step: {fw_dt*1e3:8.2f} ms")
 
     # [3] direct compiled-executable loop with steady-state buffers.
     runner = next(iter(train_step._cache.values()))
     compiled = runner.holder.get("compiled")
     print(f"    compiled executable available: {compiled is not None}")
+    raw_dt = None
     if compiled is not None:
         params = model.params
         opt_state = optimizer._opt_state
@@ -116,41 +110,47 @@ def main():
         rng = state.step_rng
         scale = jnp.asarray(1.0, jnp.float32)
         with jax.set_mesh(state.mesh):
-            g, outs, fin, rng, fused_out = compiled(
-                params, opt_state, [ids], [], rng, scale
-            )
-            jax.block_until_ready(outs)
+            out6 = compiled(params, opt_state, [ids], [], rng, scale)
+            jax.block_until_ready(out6[1])
             t0 = time.perf_counter()
             for _ in range(iters):
-                g, outs, fin, rng2, fused_out = compiled(
-                    params, opt_state, [ids], [], rng, scale
-                )
-                params, opt_state = fused_out
-                rng = rng2
-            readback(outs)
+                out6 = compiled(params, opt_state, [ids], [], rng, scale)
+                if out6[4]:
+                    params, opt_state = out6[4]
+                rng = out6[3]
+            common.readback(out6[1])
             raw_dt = (time.perf_counter() - t0) / iters
+        breakdown.record("direct_compiled_call", raw_dt, iters=iters)
+        breakdown.record("python_dispatch_overhead", fw_dt - raw_dt)
+        breakdown.record("device_program_gap_vs_plain", raw_dt - base_dt)
         print(f"[3] direct compiled call:      {raw_dt*1e3:8.2f} ms")
         print(f"    python dispatch overhead [2]-[3]: {(fw_dt-raw_dt)*1e3:6.2f} ms")
         print(f"    device-program gap [3]-[1]:       {(raw_dt-base_dt)*1e3:6.2f} ms")
 
-    # HLO cost comparison.
-    from smdistributed_modelparallel_tpu.utils.metrics import one_time_compile_report  # noqa
-
-    bl = base_train.lower(params0, opt_state0, ids).compile()
-    ca_b = bl.cost_analysis()
-    ca_f = compiled.cost_analysis() if compiled is not None else None
-    for nm, ca in (("baseline", ca_b), ("framework", ca_f)):
-        if ca is None:
+    # Roofline attribution: cost analysis joined with the measured times
+    # (published to the smp_mfu/smp_roofline_* gauges as a side effect).
+    for nm, exe, dt in (
+        ("baseline", base_compiled, base_dt),
+        ("framework", compiled, raw_dt or fw_dt),
+    ):
+        if exe is None:
             continue
-        if isinstance(ca, list):
-            ca = ca[0]
-        print(f"    {nm}: flops={ca.get('flops', 0):.3e} "
-              f"bytes={ca.get('bytes accessed', 0):.3e}")
-    mem_b = bl.memory_analysis()
-    print(f"    baseline temp bytes: {getattr(mem_b, 'temp_size_in_bytes', None)}")
-    if compiled is not None:
-        mem_f = compiled.memory_analysis()
-        print(f"    framework temp bytes: {getattr(mem_f, 'temp_size_in_bytes', None)}")
+        rep = profiling.roofline(f"perf_probe/{nm}", step_time_s=dt,
+                                 compiled=exe)
+        row = {k: v for k, v in rep.as_dict().items()
+               if v is not None and k not in ("name", "step_time_s")}
+        breakdown.record(f"roofline_{nm}", dt, **row)
+        print(f"    {nm}: flops={rep.flops or 0:.3e} "
+              f"bytes={rep.bytes_accessed or 0:.3e}"
+              + (f" mfu={rep.mfu:.4f}" if rep.mfu is not None else ""))
+        try:
+            ma = exe.memory_analysis()
+            print(f"    {nm} temp bytes: "
+                  f"{getattr(ma, 'temp_size_in_bytes', None)}")
+        except Exception:
+            pass
+
+    breakdown.emit(sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,67 +1,42 @@
 """Decompose the GPT-2 bench step time into phases on the real chip.
 
-Times jitted variants of the bench workload (fwd only, fwd+bwd, +optimizer,
-microbatched vs monolithic, grad-accum dtype) so the MFU gap is
-attributable to compute vs accumulation vs update traffic. Not part of the
-test suite; run manually on TPU.
+Times jitted variants of the bench workload (fwd only, fwd+bwd,
++optimizer, microbatched vs monolithic, grad-accum dtype) so the MFU gap
+is attributable to compute vs accumulation vs update traffic. The GPT-2
+harness (model/loss/timing/readback) comes from ``scripts/_perf_common``
+and every variant is reported through ``smp.profiling.StepBreakdown`` —
+human-readable lines on stdout, one JSON object per line on stderr in
+bench.py's component schema. Not part of the test suite; run manually
+on TPU.
 """
 
 import functools
-import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _perf_common as common
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from smdistributed_modelparallel_tpu.models.gpt2 import gpt2_124m
-
-
-def readback(x):
-    import numpy as np
-
-    return float(np.asarray(jax.tree_util.tree_leaves(x)[0]).ravel()[0])
-
-
-def timeit(fn, *args, iters=10):
-    out = fn(*args)
-    readback(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    readback(out)
-    return (time.perf_counter() - t0) / iters
+from smdistributed_modelparallel_tpu.utils import profiling
 
 
 def main():
-    seq_len, batch, num_mb, vocab = 1024, 8, 4, 50257
-    ids = jax.random.randint(jax.random.key(0), (batch, seq_len), 0, vocab)
-    module = gpt2_124m(max_len=seq_len)
-    params0 = jax.jit(module.init)(jax.random.key(0), ids)["params"]
+    module, params0, ids, dims = common.build_gpt2()
+    num_mb, batch, seq_len = dims["num_mb"], dims["batch"], dims["seq_len"]
+    iters = dims["iters"]
     tx = optax.adamw(1e-4)
-    opt0 = jax.jit(tx.init)(params0)
-
-    def ce_loss(logits, ids):
-        lg = logits[:, :-1]
-        tgt = jnp.take_along_axis(lg, ids[:, 1:, None], axis=-1)[..., 0]
-        lse = jax.scipy.special.logsumexp(lg.astype(jnp.float32), axis=-1)
-        return jnp.mean(lse - tgt.astype(jnp.float32))
+    breakdown = profiling.StepBreakdown(context={"probe": "step_breakdown"})
 
     def loss_fn(hp, mb):
-        return ce_loss(module.apply({"params": hp}, mb), mb)
+        return common.ce_loss(module.apply({"params": hp}, mb), mb)
 
-    def half(p):
-        return jax.tree_util.tree_map(
-            lambda x: x.astype(jnp.bfloat16)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x, p)
-
-    # [A] forward only, 4 microbatches
+    # [A] forward only, microbatched
     @jax.jit
     def fwd_only(params, ids):
-        hp = half(params)
+        hp = common.half(params)
         mbs = ids.reshape(num_mb, batch // num_mb, seq_len)
 
         def body(c, mb):
@@ -73,7 +48,7 @@ def main():
     # [B] fwd+bwd, fp32 accumulate (bench structure, no optimizer)
     @jax.jit
     def fwdbwd(params, ids):
-        hp = half(params)
+        hp = common.half(params)
         mbs = ids.reshape(num_mb, batch // num_mb, seq_len)
 
         def body(acc, mb):
@@ -90,7 +65,7 @@ def main():
     # update, donated)
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def full_step(params, opt_state, ids):
-        hp = half(params)
+        hp = common.half(params)
         mbs = ids.reshape(num_mb, batch // num_mb, seq_len)
 
         def body(acc, mb):
@@ -108,7 +83,7 @@ def main():
     # [D] monolithic batch (no microbatching): upper bound without accum
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def mono_step(params, opt_state, ids):
-        hp = half(params)
+        hp = common.half(params)
         loss, g = jax.value_and_grad(loss_fn)(hp, ids)
         g = jax.tree_util.tree_map(lambda x, p: x.astype(p.dtype), g, params)
         upd, opt_state = tx.update(g, opt_state, params)
@@ -117,7 +92,7 @@ def main():
     # [E] bf16 grad accumulation (numerics tradeoff probe)
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def bf16acc_step(params, opt_state, ids):
-        hp = half(params)
+        hp = common.half(params)
         mbs = ids.reshape(num_mb, batch // num_mb, seq_len)
 
         def body(acc, mb):
@@ -131,42 +106,36 @@ def main():
         upd, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, upd), opt_state, jnp.mean(losses)
 
+    def timed_donating(name, step_fn, label):
+        """Donating variants thread (params, opt_state) themselves."""
+        p = jax.jit(module.init)(jax.random.key(0), ids)["params"]
+        o = jax.jit(tx.init)(p)
+        p, o, l = step_fn(p, o, ids)          # warmup: compile off the clock
+        common.readback(l)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, o, l = step_fn(p, o, ids)
+        common.readback(l)
+        dt = (time.perf_counter() - t0) / iters
+        breakdown.record(name, dt, iters=iters)
+        print(f"{label} {dt*1e3:8.2f} ms")
+        del p, o
+
     print(f"devices: {jax.devices()}")
-    dt = timeit(fwd_only, params0, ids)
+    _, dt = breakdown.time("fwd_only_4mb", fwd_only, params0, ids,
+                           iters=iters, readback=common.readback)
     print(f"[A] fwd only (4 mb):        {dt*1e3:8.2f} ms")
-    dt = timeit(fwdbwd, params0, ids)
+    _, dt = breakdown.time("fwd_bwd_fp32_accum", fwdbwd, params0, ids,
+                           iters=iters, readback=common.readback)
     print(f"[B] fwd+bwd fp32 accum:     {dt*1e3:8.2f} ms")
+    timed_donating("full_step_bench", full_step,
+                   "[C] full step (bench):     ")
+    timed_donating("monolithic_batch_step", mono_step,
+                   "[D] monolithic batch step: ")
+    timed_donating("bf16_accum_step", bf16acc_step,
+                   "[E] bf16-accum step:       ")
 
-    p, o = params0, opt0
-    p, o, l = full_step(p, o, ids)  # warmup: compile outside the clock
-    readback(l)
-    t0 = time.perf_counter()
-    for _ in range(10):
-        p, o, l = full_step(p, o, ids)
-    readback(l)
-    print(f"[C] full step (bench):      {(time.perf_counter()-t0)/10*1e3:8.2f} ms")
-    del p, o
-
-    p = jax.jit(module.init)(jax.random.key(0), ids)["params"]
-    o = jax.jit(tx.init)(p)
-    p, o, l = mono_step(p, o, ids)
-    readback(l)
-    t0 = time.perf_counter()
-    for _ in range(10):
-        p, o, l = mono_step(p, o, ids)
-    readback(l)
-    print(f"[D] monolithic batch step:  {(time.perf_counter()-t0)/10*1e3:8.2f} ms")
-    del p, o
-
-    p = jax.jit(module.init)(jax.random.key(0), ids)["params"]
-    o = jax.jit(tx.init)(p)
-    p, o, l = bf16acc_step(p, o, ids)
-    readback(l)
-    t0 = time.perf_counter()
-    for _ in range(10):
-        p, o, l = bf16acc_step(p, o, ids)
-    readback(l)
-    print(f"[E] bf16-accum step:        {(time.perf_counter()-t0)/10*1e3:8.2f} ms")
+    breakdown.emit(sys.stderr)
 
 
 if __name__ == "__main__":

@@ -11,9 +11,12 @@ Renders the run the way the reference's one-time Studio metrics upload was
 read: throughput (tokens/sec), pipeline bubble fraction (measured vs the
 (pp-1)/(mb+pp-1) bound), host comm volume by collective, compile-cache
 behavior and compile wall time, XLA-counted FLOPs/bytes of the compiled
-step, training health (sentinel words, loss-scale events, grad/update
-norms, fault attributions, OOM post-mortems — utils/health.py), and peak
-HBM per device.
+step, performance (the smp_mfu / smp_roofline_* gauges published by
+utils/profiling.py: MFU, arithmetic intensity vs the ridge point, and
+the compute/comm/bubble decomposition of the step time), training health
+(sentinel words, loss-scale events, grad/update norms, fault
+attributions, OOM post-mortems — utils/health.py), and peak HBM per
+device.
 
 Given a DIRECTORY, every telemetry dump in it (the per-rank
 ``path.rank<i>`` files N processes write for one ``SMP_TELEMETRY_PATH``)
@@ -150,6 +153,58 @@ def render(report, out=sys.stdout):
         tmp = _value(report, "smp_compiled_step_temp_bytes", step=name)
         w(f"compiled {name}: {_fmt_num(s['value'])} FLOPs, "
           f"{_fmt_bytes(ba)} accessed, {_fmt_bytes(tmp)} temp\n")
+
+    # -- performance (roofline/MFU; utils/profiling.py) ------------------
+    # Programs with a known peak carry smp_mfu; programs attributed on an
+    # unknown backend (CPU smoke without the peak env overrides) still
+    # show achieved FLOP/s and arithmetic intensity.
+    perf_names = sorted({
+        s["labels"].get("step", "?")
+        for metric in ("smp_mfu", "smp_roofline_achieved_flops_per_s")
+        for s in _series(report, metric)
+    })
+    if perf_names:
+        w("\n-- performance --\n")
+        for name in perf_names:
+            mfu = _value(report, "smp_mfu", step=name)
+            flops = _value(report, "smp_roofline_flops", step=name)
+            step_s = _value(report, "smp_roofline_step_seconds", step=name)
+            achieved = _value(
+                report, "smp_roofline_achieved_flops_per_s", step=name
+            )
+            line = f"{name}: "
+            line += f"MFU {mfu:.3f}" if mfu is not None else "MFU n/a"
+            if achieved is not None:
+                line += f"  ({_fmt_num(achieved)} FLOP/s achieved"
+                if flops is not None and step_s:
+                    line += f" = {_fmt_num(flops)} FLOP / {step_s * 1e3:.1f} ms"
+                line += ")"
+            w(line + "\n")
+            ai = _value(
+                report, "smp_roofline_arithmetic_intensity", step=name
+            )
+            ridge = _value(report, "smp_roofline_ridge_intensity", step=name)
+            if ai is not None:
+                line = f"  arithmetic intensity {ai:.1f} FLOP/B"
+                if ridge is not None:
+                    line += f" vs ridge {ridge:.1f}"
+                    cb = _value(
+                        report, "smp_roofline_compute_bound", step=name
+                    )
+                    if cb is not None:
+                        line += (" -> " + ("compute" if cb else "memory")
+                                 + "-bound")
+                w(line + "\n")
+            comp = _value(report, "smp_roofline_compute_seconds", step=name)
+            comm = _value(report, "smp_roofline_comm_seconds", step=name)
+            bub = _value(report, "smp_roofline_bubble_seconds", step=name)
+            if step_s and comp is not None:
+                parts = [f"compute {100 * comp / step_s:.1f}%"]
+                if comm is not None:
+                    parts.append(f"comm+other {100 * comm / step_s:.1f}%")
+                if bub is not None:
+                    parts.append(f"bubble {100 * bub / step_s:.1f}%")
+                w("  decomposition: " + " / ".join(parts) + "\n")
 
     # -- health ---------------------------------------------------------
     # Fed by utils/health.py (SMP_HEALTH_CHECK sentinel), the fp16 loss

@@ -26,10 +26,20 @@ every rank's clock aligned:
    network jitter, so per-rank residual offsets are measured against the
    earliest rank at each shared mark and subtracted (median over marks).
 
+Profiler region spans (``smp_phase/<name>`` timeline events emitted by
+``smp.profiling.region`` around step trace/compile/dispatch/fetch, host
+collectives, and ``optimizer.step``) pass through fusion under their own
+names, so the cross-rank Perfetto view shows step-phase regions aligned
+with the collective/bus events — the same names an XLA profiler capture
+of the run carries.
+
 Also prints a straggler report: the per-rank clock table, per-step
-durations/skew with slowest-rank attribution, measured-vs-expected
-pipeline bubble per rank, and a collective-desync check that diffs the
-per-group sequence streams across ranks.
+durations/skew with slowest-rank attribution, **per-phase skew** (the
+``smp_phase/*`` region durations compared across ranks, so a straggler
+is attributable to its phase — dispatch vs fetch vs a collective — not
+just its step), measured-vs-expected pipeline bubble per rank, and a
+collective-desync check that diffs the per-group sequence streams
+across ranks.
 
 Stdlib only — runnable anywhere the dumps can be copied to.
 """
@@ -45,6 +55,7 @@ _RANK_RE = re.compile(r"\.rank(\d+)$")
 _ANCHOR_RE = re.compile(r"^smp_clock_anchor/(\d+)/(\d+)$")
 _SYNC_RE = re.compile(r"^smp_sync/(.+)/([^/]+)/(-?\d+)$")
 _STEP_RE = re.compile(r"^step_(\d+)_(begin|end)$")
+_PHASE_RE = re.compile(r"^smp_phase/(.+)$")
 
 
 class Stream:
@@ -320,6 +331,31 @@ def step_table(streams):
     return steps
 
 
+def phase_table(streams):
+    """{(step, phase): {rank: total duration µs}} from the ``smp_phase/*``
+    region spans ``smp.profiling.region`` records into the timeline.
+    Multiple spans of the same phase within one step (e.g. two dispatch
+    regions) sum. Steps come from the span's recorded args; native-
+    recorder dumps without them land under step -1."""
+    phases = {}
+    for s in streams:
+        if s.kind != "timeline":
+            continue
+        for ev in s.events:
+            if ev.get("ph") != "X":
+                continue
+            m = _PHASE_RE.match(ev.get("name", ""))
+            if not m:
+                continue
+            step = (ev.get("args") or {}).get("step", -1)
+            if not isinstance(step, int):
+                step = -1
+            key = (step, m.group(1))
+            per_rank = phases.setdefault(key, {})
+            per_rank[s.rank] = per_rank.get(s.rank, 0.0) + ev.get("dur", 0.0)
+    return phases
+
+
 def desync_check(streams):
     """Diff per-group collective sequence streams across ranks. Returns a
     list of human-readable findings (empty = consistent)."""
@@ -402,6 +438,21 @@ def render_report(streams, clock_table, out=sys.stdout):
             if len(ends) > 1:
                 w(f"      step {step} end skew across ranks: "
                   f"{(max(ends) - min(ends)) / 1e3:.3f} ms\n")
+
+    phases = phase_table(streams)
+    if phases:
+        w("\n-- per-phase skew (smp_phase/* regions) --\n")
+        w(f"{'step':>4}  {'phase':<28}{'rank':>4}  {'duration ms':>12}  "
+          f"{'vs median':>10}\n")
+        for (step, phase) in sorted(phases):
+            durs = {r: d / 1e3 for r, d in phases[(step, phase)].items()}
+            med = statistics.median(durs.values())
+            slowest = max(durs, key=durs.get)
+            for r in sorted(durs):
+                mark = ("  <- slowest"
+                        if (r == slowest and len(durs) > 1) else "")
+                w(f"{'-' if step < 0 else step:>4}  {phase:<28}{r:>4}  "
+                  f"{durs[r]:>12.3f}  {durs[r] - med:>+10.3f}{mark}\n")
 
     tele = [s for s in streams if s.kind == "telemetry"]
     if tele:

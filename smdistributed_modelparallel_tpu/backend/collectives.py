@@ -37,6 +37,7 @@ import jax
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.resilience.chaos import chaos
 from smdistributed_modelparallel_tpu.utils.exceptions import SMPRuntimeError
+from smdistributed_modelparallel_tpu.utils import profiling
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.utils.telemetry import (
@@ -271,7 +272,8 @@ class CollectiveCommunicator:
         # wedged inside the broadcast must leave this as its ring's last
         # word, same as the native bus waits do.
         flight_recorder.record_wait("broadcast", -1, 0, "begin", 0.0)
-        with watchdog.guard(f"broadcast/{getattr(group, 'name', group)}"):
+        with watchdog.guard(f"broadcast/{getattr(group, 'name', group)}"), \
+                profiling.region("collective/broadcast", track="host"):
             # Length-prefix exchange, then the payload as a uint8 array.
             n = multihost_utils.broadcast_one_to_all(
                 np.array([len(payload)], dtype=np.int64), is_source=jax.process_index() == src
@@ -309,7 +311,8 @@ class CollectiveCommunicator:
         payload = pickle.dumps(obj)
         # Begin-edge before the blocking collective; see broadcast.
         flight_recorder.record_wait("allgather", -1, 0, "begin", 0.0)
-        with watchdog.guard(f"allgather/{getattr(group, 'name', group)}"):
+        with watchdog.guard(f"allgather/{getattr(group, 'name', group)}"), \
+                profiling.region("collective/allgather", track="host"):
             lens = np.asarray(
                 multihost_utils.process_allgather(
                     np.asarray([len(payload)], np.int64)
@@ -406,11 +409,12 @@ class CollectiveCommunicator:
         seq = self._barrier_seq.get(gname, 0)
         self._barrier_seq[gname] = seq + 1
         if len(procs) > 1:
-            if len(procs) < jax.process_count():
-                with watchdog.guard(f"barrier/{gname}"):
-                    self._get_bus(f"smp.barrier({group})").barrier(procs)
-            else:
-                state.core.barrier(name)
+            with profiling.region(f"collective/barrier/{gname}", track="host"):
+                if len(procs) < jax.process_count():
+                    with watchdog.guard(f"barrier/{gname}"):
+                        self._get_bus(f"smp.barrier({group})").barrier(procs)
+                else:
+                    state.core.barrier(name)
         # Sync mark AFTER the barrier: every member leaves it within
         # network jitter of the others, so this rank's wall clock at this
         # point is the cross-rank alignment signal trace_fuse uses to

@@ -128,6 +128,11 @@ class ModelParallelCore:
         # must reach the file or trace_fuse loses its alignment signal.
         from smdistributed_modelparallel_tpu.backend.state import state
 
+        # A profiler capture still open at shutdown (run ended inside its
+        # window) is closed here so the trace file is usable.
+        from smdistributed_modelparallel_tpu.utils import profiling
+
+        profiling.capture.stop_if_active()
         if state.timeline is not None:
             state.timeline.flush()
         telemetry.set_phase("shutdown")

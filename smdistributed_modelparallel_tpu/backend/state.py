@@ -102,6 +102,12 @@ class ModelParallelState:
         )
 
         preemption.install()
+        from smdistributed_modelparallel_tpu.utils import profiling
+
+        # SIGUSR2 arms a one-step profiler capture on a live run
+        # (utils/profiling.py); the SMP_PROFILE window is read lazily at
+        # the first step edge.
+        profiling.capture.install_signal()
 
     def _check(self):
         if not self.initialized:
@@ -128,6 +134,9 @@ class ModelParallelState:
         telemetry.reset()
         flight_recorder.clear()
         health.reset()
+        from smdistributed_modelparallel_tpu.utils import profiling
+
+        profiling.capture.reset()
         from smdistributed_modelparallel_tpu.resilience import (
             reset as resilience_reset,
         )

@@ -22,6 +22,7 @@ import optax
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.module_manager import path_key
 from smdistributed_modelparallel_tpu.utils import health
+from smdistributed_modelparallel_tpu.utils import profiling
 from smdistributed_modelparallel_tpu.utils.exceptions import (
     SMPValidationError,
     StepUsageError,
@@ -114,12 +115,16 @@ class DistributedOptimizer:
         tx = self.tx
 
         def update(params, opt_state, grads):
-            if clip is not None:
-                gnorm = optax.global_norm(grads)
-                scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            updates, new_opt_state = tx.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            # In-graph profiler region: the optimizer's ops carry this
+            # scope in HLO op metadata, so an XLA trace of the fused step
+            # shows where the update ends and the model compute begins.
+            with profiling.named_region("smp/optimizer/update"):
+                if clip is not None:
+                    gnorm = optax.global_norm(grads)
+                    scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                    grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+                updates, new_opt_state = tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
             return new_params, new_opt_state
 
         return update
@@ -133,6 +138,10 @@ class DistributedOptimizer:
         (``torch/optimizers/optimizer.py:355-391``) — sharded update then
         param allgather; under XLA both emerge from the sharding specs.
         """
+        with profiling.region("optimizer/step"):
+            self._step_impl()
+
+    def _step_impl(self):
         if self.model._grads_store is None:
             raise StepUsageError(
                 "No gradients available: run an @smp.step function with "

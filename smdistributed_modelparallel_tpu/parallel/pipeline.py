@@ -38,6 +38,7 @@ from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.backend.topology import PP_AXIS
 from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.profiling import named_region
 
 logger = get_logger()
 
@@ -600,7 +601,8 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     mb_keys = jax.random.split(rngs_key, num_mb)
 
     # Embed all microbatches upfront (the pipeline's input queue).
-    embedded, embed_auxs = _scan_map(embed_mb, stacked_inputs, mb_keys)
+    with named_region("smp/pipeline/embed"):
+        embedded, embed_auxs = _scan_map(embed_mb, stacked_inputs, mb_keys)
 
     # [L, ...] -> [S, maxp, ...]; dim 0 stays sharded on pp. Uniform
     # boundaries collapse to a reshape; uneven ones gather padded slots.
@@ -704,9 +706,10 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
             carry_in = feed
         # Distinct dropout keys per (stage, tick).
         tick_keys = jax.vmap(lambda k: jax.random.fold_in(k, t))(stage_keys)
-        outs, aux_row = vmapped_stages(
-            staged_params, staged_xs, carry_in, tick_keys, active_rows
-        )
+        with named_region("smp/pipeline/tick_fwd"):
+            outs, aux_row = vmapped_stages(
+                staged_params, staged_xs, carry_in, tick_keys, active_rows
+            )
         x_outs = outs[0] if sides is not None else outs
         # MoE aux: stage s holds microbatch t - s; fill/drain ticks where
         # that index is invalid computed on garbage/duplicate inputs and
@@ -737,7 +740,8 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
             jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.float32),
             jnp.full((S,), -1.0, jnp.float32),
         ),)
-    carry_end, tails = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+    with named_region("smp/pipeline/fill_drain"):
+        carry_end, tails = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
     if hc is not None:
         (_, aux_total, (hbad, habs, hmb)) = carry_end
         if virtual > 1:
@@ -761,7 +765,8 @@ def pipeline_forward(model, params, stacked_inputs, rngs_key, mb_kwargs=None):
     # tails[t] is microbatch t-(S-1); keep the last num_mb ticks.
     collected = jax.tree_util.tree_map(lambda x: x[S - 1:], tails)
 
-    outputs, head_auxs = _scan_map(head_mb, collected, mb_keys)
+    with named_region("smp/pipeline/head"):
+        outputs, head_auxs = _scan_map(head_mb, collected, mb_keys)
     return outputs, aux_total + jnp.sum(embed_auxs) + jnp.sum(head_auxs)
 
 

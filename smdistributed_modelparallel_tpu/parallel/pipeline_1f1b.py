@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.utils.exceptions import PartitionError
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.profiling import named_region
 
 logger = get_logger()
 
@@ -350,7 +351,8 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             method=spec.embed_method, **kwargs,
         )
 
-    embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
+    with named_region("smp/pipeline/embed"):
+        embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
 
     if spec.carry_is_tuple:
         hidden_q = embedded[0]
@@ -562,10 +564,12 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             lambda q, b: b.at[0].set(q), from_q, buf_in
         )
         f_sides = gather_sides_rows(fmc)
-        outs_f, _aux_f = jax.vmap(
-            stage_fwd,
-            in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0),
-        )(staged_params, staged_xs, x_in, f_sides, stage_ids, fmc, active_rows)
+        with named_region("smp/pipeline/tick_fwd"):
+            outs_f, _aux_f = jax.vmap(
+                stage_fwd,
+                in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0),
+            )(staged_params, staged_xs, x_in, f_sides, stage_ids, fmc,
+              active_rows)
         # Stash the consumed inputs for backward recompute.
         stash = set_ring(stash, f_slots, x_in, f_active)
         if hc is not None:
@@ -618,13 +622,14 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             )
             return loss, user_out
 
-        loss_m, head_vjp, user_out = jax.vjp(
-            head_loss, params_rest, out_last, has_aux=True
-        )
-        seed = jnp.asarray(loss_seed_scale, jnp.float32) * jnp.where(
-            b_active[S - 1], 1.0, 0.0
-        )
-        d_rep, d_out_last = head_vjp(seed.astype(loss_m.dtype))
+        with named_region("smp/pipeline/head"):
+            loss_m, head_vjp, user_out = jax.vjp(
+                head_loss, params_rest, out_last, has_aux=True
+            )
+            seed = jnp.asarray(loss_seed_scale, jnp.float32) * jnp.where(
+                b_active[S - 1], 1.0, 0.0
+            )
+            d_rep, d_out_last = head_vjp(seed.astype(loss_m.dtype))
 
         # All stages: plain stage VJP; cotangents come from cotbuf except
         # the last stage's, which is the head/loss cotangent just computed.
@@ -646,11 +651,13 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             # accumulated below).
             return vjp((cot, aux_seed))
 
-        d_lp_rows, d_x_rows, d_side_rows = jax.vmap(
-            stage_bwd,
-            in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0, 0),
-        )(staged_params, staged_xs, stash_in,
-          b_sides, cot_in, stage_ids, bmc, active_rows)
+        with named_region("smp/pipeline/tick_bwd"):
+            d_lp_rows, d_x_rows, d_side_rows = jax.vmap(
+                stage_bwd,
+                in_axes=(0, 0, 0, 0 if sides is not None else None,
+                         0, 0, 0, 0),
+            )(staged_params, staged_xs, stash_in,
+              b_sides, cot_in, stage_ids, bmc, active_rows)
 
         # Accumulate layer grads (mask idle rows).
         mask_b = b_active
@@ -723,7 +730,8 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
             jnp.zeros((S,), jnp.float32), jnp.zeros((S,), jnp.float32),
             jnp.full((S,), -1.0, jnp.float32),
         ),)
-    carry_end, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+    with named_region("smp/pipeline/steady"):
+        carry_end, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
     if hc is not None:
         (_, _, _, _, dlay, drep, dembed, dsides, losses, outs,
          (hbad, habs, hmb)) = carry_end
@@ -869,6 +877,18 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
     record_pipeline_occupancy(
         "1f1b", S, M, busy_slots=busy, total_slots=total, virtual=V
     )
+    # Phase tick counts next to the occupancy gauges: the roofline
+    # bubble attribution (utils/profiling.py) and the trace_fuse phase
+    # view both read the warmup/steady/cooldown split from here.
+    from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+
+    _phase_gauge = telemetry.gauge(
+        "smp_pipeline_phase_ticks",
+        "ticks per interleaved schedule phase (warmup/steady/cooldown)",
+    )
+    _phase_gauge.labels(phase="warmup").set(t_b0)
+    _phase_gauge.labels(phase="steady").set(t_fe - t_b0)
+    _phase_gauge.labels(phase="cooldown").set(n_ticks - t_fe)
     # Slot events carry the GLOBAL chunk (boundary) index k*S + s: stage
     # says where the work ran, chunk identifies the layers — the same
     # coordinates the fill-drain executor records for chunked specs.
@@ -954,7 +974,8 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
             method=spec.embed_method, **kwargs,
         )
 
-    embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
+    with named_region("smp/pipeline/embed"):
+        embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
 
     if spec.carry_is_tuple:
         hidden_q = embedded[0]
@@ -1253,10 +1274,12 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
             )
             f_sides = gather_sides_rows(fmc)
             c_ids = fkc * S + stage_ids
-            outs_f, _aux_f = jax.vmap(
-                chunk_fwd,
-                in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0),
-            )(ch_params, ch_xs, x_in, f_sides, c_ids, fmc, ch_act)
+            with named_region("smp/pipeline/tick_fwd"):
+                outs_f, _aux_f = jax.vmap(
+                    chunk_fwd,
+                    in_axes=(0, 0, 0, 0 if sides is not None else None,
+                             0, 0, 0),
+                )(ch_params, ch_xs, x_in, f_sides, c_ids, fmc, ch_act)
             outs_f = pin_stage_axis(outs_f)
             stash = set_ring(stash, fkc, f_slots, x_in, f_active)
             if hc is not None:
@@ -1323,13 +1346,14 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
             # computing it masked — at vocab-sized heads the masked version
             # would cost ~V x the v=1 executor's replicated compute.
             head_aval = jax.eval_shape(run_head)
-            loss_m, d_rep, d_out_last, user_out = jax.lax.cond(
-                is_lastk,
-                run_head,
-                lambda: jax.tree_util.tree_map(
-                    lambda a: jnp.zeros(a.shape, a.dtype), head_aval
-                ),
-            )
+            with named_region("smp/pipeline/head"):
+                loss_m, d_rep, d_out_last, user_out = jax.lax.cond(
+                    is_lastk,
+                    run_head,
+                    lambda: jax.tree_util.tree_map(
+                        lambda a: jnp.zeros(a.shape, a.dtype), head_aval
+                    ),
+                )
 
             cot_in = get_ring(cotbuf, bkc, b_slots)
             cot_in = jax.tree_util.tree_map(
@@ -1352,11 +1376,13 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
                 _, vjp = jax.vjp(f, lp, x, side)
                 return vjp((cot, aux_seed))
 
-            d_lp_rows, d_x_rows, d_side_rows = jax.vmap(
-                chunk_bwd,
-                in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0, 0),
-            )(ch_params_b, ch_xs_b, stash_in,
-              b_sides, cot_in, c_ids_b, bmc, ch_act_b)
+            with named_region("smp/pipeline/tick_bwd"):
+                d_lp_rows, d_x_rows, d_side_rows = jax.vmap(
+                    chunk_bwd,
+                    in_axes=(0, 0, 0, 0 if sides is not None else None,
+                             0, 0, 0, 0),
+                )(ch_params_b, ch_xs_b, stash_in,
+                  b_sides, cot_in, c_ids_b, bmc, ch_act_b)
             d_lp_rows = pin_stage_axis(d_lp_rows)
             d_x_rows = pin_stage_axis(d_x_rows)
 
@@ -1423,17 +1449,24 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
             jnp.full((S, V), -1.0, jnp.float32),
         ),)
 
-    carry_end, _ = jax.lax.scan(
-        lambda c, t: tick_impl(c, t, True, False), carry0, jnp.arange(0, t_b0)
-    )
-    carry_end, _ = jax.lax.scan(
-        lambda c, t: tick_impl(c, t, True, True), carry_end,
-        jnp.arange(t_b0, t_fe),
-    )
-    carry_end, _ = jax.lax.scan(
-        lambda c, t: tick_impl(c, t, False, True), carry_end,
-        jnp.arange(t_fe, n_ticks),
-    )
+    # Named profiler regions per schedule phase: an XLA trace of the
+    # compiled step shows the warmup/steady/cooldown loops as separately
+    # labeled op groups, so bubble time is attributable to its ramp.
+    with named_region("smp/pipeline/warmup"):
+        carry_end, _ = jax.lax.scan(
+            lambda c, t: tick_impl(c, t, True, False), carry0,
+            jnp.arange(0, t_b0),
+        )
+    with named_region("smp/pipeline/steady"):
+        carry_end, _ = jax.lax.scan(
+            lambda c, t: tick_impl(c, t, True, True), carry_end,
+            jnp.arange(t_b0, t_fe),
+        )
+    with named_region("smp/pipeline/cooldown"):
+        carry_end, _ = jax.lax.scan(
+            lambda c, t: tick_impl(c, t, False, True), carry_end,
+            jnp.arange(t_fe, n_ticks),
+        )
     if hc is not None:
         (_, _, _, _, _, _, dlay, drep, dembed, dsides, losses, outs,
          (hbad, habs, hmb)) = carry_end

@@ -45,6 +45,7 @@ from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
 from smdistributed_modelparallel_tpu.resilience.chaos import chaos
 from smdistributed_modelparallel_tpu.resilience.preemption import preemption
 from smdistributed_modelparallel_tpu.utils import health
+from smdistributed_modelparallel_tpu.utils import profiling
 from smdistributed_modelparallel_tpu.utils.exceptions import StepUsageError
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
@@ -78,6 +79,7 @@ class StepFunction:
         self.non_split_inputs = non_split_inputs
         self.input_split_axes = input_split_axes
         self._cache = {}
+        self._last_runner = None
         functools.update_wrapper(self, fn)
 
     # ------------------------------------------------------------------
@@ -112,26 +114,48 @@ class StepFunction:
         tl = state.timeline
         telemetry.set_phase(f"step_{state.step_count}")
         flight_recorder.record_step("begin", state.step_count)
+        # On-demand profiler capture (SMP_PROFILE=steps=N:M / SIGUSR2):
+        # starts exactly at this step's begin edge when armed; a single
+        # attribute test otherwise.
+        profiling.capture.on_step_begin(state.step_count)
         t_step = time.perf_counter()
+        exact_time = False
         if tl is not None and tl.enabled:
             tl.start_step(state.step_count)
             with tl.span(f"step_{state.step_count}"):
                 grads, outputs = self._run_compiled(
                     model, stacked_args, stacked_kwargs
                 )
-                jax.block_until_ready(outputs)
+                with profiling.region("step/fetch"):
+                    jax.block_until_ready(outputs)
             tl.end_step(state.step_count)
             tl.flush()
+            exact_time = True
         else:
             grads, outputs = self._run_compiled(
                 model, stacked_args, stacked_kwargs
             )
-        # Dispatch wall time: exact when the timeline forced a block above,
-        # otherwise a lower bound (async dispatch returns before the device
+            if profiling.should_sample_step(state.step_count):
+                # Roofline sample: block on this step's outputs so the
+                # measured time covers device execution. Without it the
+                # async-dispatch time is a lower bound and smp_mfu would
+                # overreport (possibly >1). ~1/16 steps; cost is one
+                # drained dispatch queue.
+                with profiling.region("step/fetch"):
+                    jax.block_until_ready(outputs)
+                exact_time = True
+        # Dispatch wall time: exact when a block happened above, otherwise
+        # a lower bound (async dispatch returns before the device
         # finishes) — still enough for compile-vs-steady-state attribution.
+        t_step = time.perf_counter() - t_step
         telemetry.histogram(
             "smp_step_dispatch_seconds", "host wall time per step dispatch"
-        ).observe(time.perf_counter() - t_step)
+        ).observe(t_step)
+        profiling.capture.on_step_end(state.step_count, outputs=outputs)
+        if exact_time:
+            # smp_mfu / smp_roofline_* gauges for this program, from its
+            # cached cost analysis + this step's exact wall time.
+            profiling.record_step_roofline(self._last_runner, t_step)
         flight_recorder.record_step("end", state.step_count)
         telemetry.counter("smp_step_total", "step invocations").inc()
         if state.memory_metrics is not None:
@@ -300,10 +324,11 @@ class StepFunction:
                 del self._cache[k]
             telemetry.set_phase(f"step_{state.step_count}/trace")
             t_build = time.perf_counter()
-            compiled = self._build(
-                model, treedef, scan_idx, bcast_idx, static, num_mb,
-                scan_meta, opt.build_update_fn() if fused else None,
-            )
+            with profiling.region("step/trace"):
+                compiled = self._build(
+                    model, treedef, scan_idx, bcast_idx, static, num_mb,
+                    scan_meta, opt.build_update_fn() if fused else None,
+                )
             t_build = time.perf_counter() - t_build
             telemetry.histogram(
                 "smp_step_trace_seconds", "step program build/trace wall time"
@@ -312,6 +337,7 @@ class StepFunction:
             self._cache[key] = compiled
         else:
             cache_events.labels(event="hit").inc()
+        self._last_runner = compiled
         tokens = _count_tokens(scan_vals, scan_meta)
         if tokens:
             telemetry.counter(
@@ -837,10 +863,12 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                 telemetry.set_phase(f"compile/{name}")
                 t_compile = time.perf_counter()
                 try:
-                    lowered = jitted.lower(
-                        params, opt_state, scan_vals, bcast_vals, rng, loss_scale
-                    )
-                    compiled = lowered.compile()
+                    with profiling.region("step/compile"):
+                        lowered = jitted.lower(
+                            params, opt_state, scan_vals, bcast_vals, rng,
+                            loss_scale,
+                        )
+                        compiled = lowered.compile()
                     state.last_compile_report = one_time_compile_report(
                         name, compiled
                     )
@@ -860,7 +888,9 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
             c = holder["compiled"]
             if c is not None:
                 try:
-                    return c(params, opt_state, scan_vals, bcast_vals, rng, loss_scale)
+                    with profiling.region("step/dispatch"):
+                        return c(params, opt_state, scan_vals, bcast_vals,
+                                 rng, loss_scale)
                 except (TypeError, ValueError) as e:
                     # Input aval/sharding mismatch only (the step cache keys
                     # on shapes, so this is a layout drift, e.g. resharded
@@ -878,8 +908,9 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                     health.maybe_oom_postmortem(name, c, e)
                     raise
             try:
-                return jitted(params, opt_state, scan_vals, bcast_vals, rng,
-                              loss_scale)
+                with profiling.region("step/dispatch"):
+                    return jitted(params, opt_state, scan_vals, bcast_vals,
+                                  rng, loss_scale)
             except Exception as e:
                 health.maybe_oom_postmortem(name, holder.get("compiled"), e)
                 raise
@@ -887,6 +918,7 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
     run.jitted = jitted
     run.mesh = mesh
     run.holder = holder
+    run.step_name = name
     run.raw_divisor = raw_divisor if fused_update is not None else None
     run.health_schema = schema_box
     return run

@@ -1,0 +1,748 @@
+"""Performance observability: named profiler regions, on-demand XLA
+profiler capture, and roofline/MFU attribution (``smp.profiling``).
+
+The reference library ships profiling hooks as a first-class surface
+(herring timers + the ``smp_timeline_*`` C API around every server
+action); this module is the TPU build's equivalent, designed around the
+fact that chip windows on this image are rare and flaky: when one opens,
+a single run must capture a trace, attribute the MFU gap, and land in a
+tracked trajectory (``scripts/perf_ledger.py``) without anyone re-running
+ad-hoc probes. Four cooperating pieces:
+
+1. **Named regions** — one vocabulary for every profiling surface.
+   ``region(name)`` brackets a host-side phase with
+   ``jax.profiler.TraceAnnotation`` (so the region shows up, by the same
+   name, in an XLA profiler trace) AND a ``state.timeline`` span (so
+   ``scripts/trace_fuse.py`` can align it cross-rank and report per-phase
+   skew). ``named_region(name)`` is the in-graph twin: a
+   ``jax.named_scope`` whose name lands in the compiled HLO's op
+   metadata, tagging pipeline warmup/steady/cooldown phases, per-tick
+   fwd/bwd sub-steps, and the optimizer update inside the device
+   timeline. Wired through the step engine (trace/compile/dispatch/
+   fetch), both pipeline executors, host collectives, and
+   ``optimizer.step``.
+
+2. **On-demand capture** — ``SMP_PROFILE=steps=N:M`` brackets
+   ``jax.profiler.start_trace``/``stop_trace`` around exactly steps
+   N..M (inclusive) into a per-rank directory under ``SMP_PROFILE_PATH``
+   (default ``smp_profile/rank<i>``). ``SIGUSR2`` arms a one-step capture
+   on a live run. Disarmed cost is one attribute test per step edge; the
+   start/stop overhead of an actual capture is recorded in
+   ``smp_profile_overhead_seconds_total`` so always-on cost stays
+   measurably zero.
+
+3. **Roofline / MFU attribution** — ``roofline(...)`` joins compiled-HLO
+   ``cost_analysis``/``memory_analysis`` (FLOPs, bytes accessed) with a
+   measured step wall time and the device's peak FLOP/s + HBM bandwidth
+   (spec-sheet table by ``device_kind``; ``SMP_PEAK_TFLOPS`` /
+   ``SMP_PEAK_GBPS`` override for unlisted backends) into MFU, achieved
+   bytes/s, arithmetic intensity vs the ridge point, and a
+   compute-vs-comm-vs-bubble decomposition of the step time (bubble from
+   the pipeline occupancy gauges). Published as ``smp_mfu`` /
+   ``smp_roofline_*`` gauges and rendered by the "performance" section of
+   ``scripts/telemetry_report.py``. The step engine calls
+   ``record_step_roofline`` on every dispatch, so a run on known hardware
+   carries its MFU in every telemetry dump with no extra configuration.
+
+4. **Breakdown API** — ``StepBreakdown`` collects named component
+   timings and emits them in the same one-JSON-object-per-line schema
+   ``bench.py`` writes to stderr (``{"component": ..., "ms": ...}``), so
+   ``scripts/perf_probe.py`` / ``scripts/step_breakdown.py`` results land
+   in the shape the perf ledger ingests.
+
+Import-hygiene contract: importing this module must never initialize an
+accelerator backend (``jax.profiler``/``jax.named_scope`` are pure-host
+imports; ``jax.devices()`` is only touched from ``device_peaks`` at
+attribution time).
+"""
+
+import atexit
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import jax
+
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+
+logger = get_logger()
+
+PROFILE_ENV = "SMP_PROFILE"
+PROFILE_PATH_ENV = "SMP_PROFILE_PATH"
+PEAK_TFLOPS_ENV = "SMP_PEAK_TFLOPS"
+PEAK_GBPS_ENV = "SMP_PEAK_GBPS"
+
+# Region names are prefixed so every surface (XLA profiler trace, our
+# Perfetto timeline, trace_fuse's per-phase skew report, compiled-HLO op
+# metadata) can recognize them by one convention:
+#   host phases:    smp_phase/<name>   (region())
+#   in-graph scopes: smp/<subsystem>/<name>  (named_region())
+REGION_PREFIX = "smp_phase/"
+
+
+def _timeline():
+    """The live session timeline, or None. Resolved lazily: this module
+    must not import backend.state at import time (state pulls in the whole
+    core, and collectives/step import *us*)."""
+    from smdistributed_modelparallel_tpu.backend.state import state
+
+    return state.timeline
+
+
+class _Region:
+    """One named host-side profiler region (see ``region``)."""
+
+    __slots__ = ("name", "track", "_ta", "_tl", "_begin_us")
+
+    def __init__(self, name, track):
+        self.name = name
+        self.track = track
+        self._ta = None
+        self._tl = None
+        self._begin_us = 0.0
+
+    def __enter__(self):
+        # TraceAnnotation is a TraceMe under the hood: near-free when no
+        # profiler session is active, and a named host event when one is —
+        # exactly the "same region names in the XLA trace" contract.
+        try:
+            self._ta = jax.profiler.TraceAnnotation(self.name)
+            self._ta.__enter__()
+        except Exception:  # pragma: no cover - profiler backend quirks
+            self._ta = None
+        tl = _timeline()
+        if tl is not None and tl.enabled:
+            self._tl = tl
+            self._begin_us = tl._now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if self._tl is not None:
+            self._tl.record_event(
+                self.name, self._begin_us, self._tl._now_us(),
+                track=self.track,
+            )
+        if self._ta is not None:
+            self._ta.__exit__(*exc)
+        return False
+
+
+def region(name, track="phase"):
+    """Context manager: one named host-side profiler region.
+
+    Emits the region under ``smp_phase/<name>`` to BOTH observability
+    surfaces at once: a ``jax.profiler.TraceAnnotation`` (visible in an
+    XLA profiler capture) and a ``state.timeline`` span on the ``phase``
+    track (visible in the fused Perfetto view; ``trace_fuse.py`` computes
+    per-phase cross-rank skew from these). No-op-cheap when neither a
+    profiler session nor the timeline is active.
+    """
+    return _Region(REGION_PREFIX + name, track)
+
+
+def named_region(name):
+    """In-graph region: a ``jax.named_scope`` wrapper. The name lands in
+    the compiled HLO's op metadata (``op_name`` paths), so XLA profiler
+    device timelines and HLO dumps carry the same region vocabulary as the
+    host-side ``region`` spans."""
+    return jax.named_scope(name)
+
+
+# ----------------------------------------------------------------------
+# On-demand capture (SMP_PROFILE / SIGUSR2)
+# ----------------------------------------------------------------------
+
+
+def _parse_profile_spec(spec):
+    """``steps=N:M`` / ``steps=N`` / bare ``N:M`` -> (first, last)
+    inclusive step window. Raises ValueError on anything else."""
+    body = spec.strip()
+    if body.startswith("steps="):
+        body = body[len("steps="):]
+    parts = body.split(":")
+    if not body or len(parts) > 2:
+        raise ValueError(f"unparseable {PROFILE_ENV} spec {spec!r}")
+    first = int(parts[0])
+    last = int(parts[1]) if len(parts) == 2 else first
+    if first < 0 or last < first:
+        raise ValueError(
+            f"{PROFILE_ENV} window {spec!r} must satisfy 0 <= N <= M"
+        )
+    return first, last
+
+
+class ProfileCapture:
+    """Programmatic ``jax.profiler`` capture bracketed at step edges.
+
+    The step engine calls ``on_step_begin(step)`` / ``on_step_end(step)``
+    around every dispatch. When a window is armed (``SMP_PROFILE=
+    steps=N:M`` at init, or a SIGUSR2 received on a live run — which arms
+    a one-step window at the next step edge), the capture starts at the
+    begin edge of step N and stops at the end edge of step M, writing the
+    trace into ``<SMP_PROFILE_PATH>/rank<i>`` so multi-process runs never
+    clobber each other. Disarmed, both hooks are a single attribute test.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._parsed_env = False
+        self._window = None          # (first, last) inclusive, or None
+        self._sig_request = False    # set by the SIGUSR2 handler
+        self._installed = False
+        self.active = False
+        self.last_window = None      # (first, last) of the last capture
+        self._started_at = None
+        self._last_step = None       # most recent step edge seen
+
+    # -- configuration --------------------------------------------------
+
+    def _ensure_spec(self):
+        if self._parsed_env:
+            return
+        self._parsed_env = True
+        spec = os.environ.get(PROFILE_ENV, "")
+        if not spec:
+            return
+        try:
+            self._window = _parse_profile_spec(spec)
+        except ValueError as e:
+            logger.warning("%s ignored: %s", PROFILE_ENV, e)
+
+    @property
+    def window(self):
+        self._ensure_spec()
+        return self._window
+
+    def rank_dir(self):
+        base = os.environ.get(PROFILE_PATH_ENV, "smp_profile")
+        rank = telemetry.process_index
+        return os.path.join(base, f"rank{0 if rank is None else rank}")
+
+    def install_signal(self):
+        """Install the SIGUSR2 trigger (main thread only; re-entrant)."""
+        if self._installed:
+            return
+        try:
+            signal.signal(signal.SIGUSR2, self._on_sigusr2)
+            self._installed = True
+        except (ValueError, OSError, AttributeError) as e:
+            # Non-main thread, or a platform without SIGUSR2.
+            logger.debug("SIGUSR2 profile trigger unavailable: %s", e)
+
+    def _on_sigusr2(self, signum, frame):
+        # Async-signal context: only set a flag; the next step edge arms.
+        self._sig_request = True
+
+    # -- step-edge hooks (called by the step engine) --------------------
+
+    def on_step_begin(self, step):
+        self._ensure_spec()
+        self._last_step = step
+        if self._sig_request:
+            self._sig_request = False
+            if self.active or self._window is not None:
+                # A capture is running or a configured window is still
+                # pending — the signal must not cancel it (the armed
+                # window may be the chip-window trace the run exists to
+                # collect).
+                logger.info(
+                    "SIGUSR2 ignored: profiler capture %s.",
+                    "already running" if self.active
+                    else f"window {self._window} already armed",
+                )
+            else:
+                # One-step window at the step about to run.
+                self._window = (step, step)
+                logger.info(
+                    "SIGUSR2: profiler capture armed for step %d.", step
+                )
+        win = self._window
+        if win is None or self.active or not (win[0] <= step <= win[1]):
+            return
+        with self._lock:
+            if self.active:
+                return
+            t0 = time.perf_counter()
+            path = self.rank_dir()
+            try:
+                os.makedirs(path, exist_ok=True)
+                jax.profiler.start_trace(path)
+            except Exception as e:
+                logger.warning(
+                    "profiler capture start failed (%s); window disarmed.", e
+                )
+                self._window = None
+                return
+            self.active = True
+            self._started_at = step
+            self._record_overhead(time.perf_counter() - t0)
+            telemetry.gauge(
+                "smp_profile_active", "1 while a profiler capture is running"
+            ).set(1)
+            logger.info(
+                "profiler capture started at step %d (window %d..%d) -> %s",
+                step, win[0], win[1], path,
+            )
+
+    def on_step_end(self, step, outputs=None):
+        if not self.active:
+            return
+        win = self._window
+        if win is not None and step < win[1]:
+            return
+        # Make the captured window actually contain this step's device
+        # execution (dispatch is async): block before stopping the trace.
+        if outputs is not None:
+            try:
+                jax.block_until_ready(outputs)
+            except Exception:  # pragma: no cover - donated/consumed buffers
+                pass
+        self._stop(step)
+
+    def _stop(self, step):
+        with self._lock:
+            if not self.active:
+                return
+            t0 = time.perf_counter()
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # pragma: no cover
+                logger.warning("profiler capture stop failed: %s", e)
+            self.active = False
+            first = self._started_at if self._started_at is not None else step
+            self.last_window = (first, step)
+            self._window = None       # window consumed; SIGUSR2 can re-arm
+            self._record_overhead(time.perf_counter() - t0)
+            telemetry.gauge(
+                "smp_profile_active", "1 while a profiler capture is running"
+            ).set(0)
+            telemetry.counter(
+                "smp_profile_captures_total", "completed profiler captures"
+            ).inc()
+            telemetry.gauge(
+                "smp_profile_last_first_step",
+                "first step of the last profiler capture",
+            ).set(first)
+            telemetry.gauge(
+                "smp_profile_last_last_step",
+                "last step of the last profiler capture",
+            ).set(step)
+            logger.info(
+                "profiler capture stopped: steps %d..%d -> %s",
+                first, step, self.rank_dir(),
+            )
+
+    @staticmethod
+    def _record_overhead(seconds):
+        telemetry.counter(
+            "smp_profile_overhead_seconds_total",
+            "host seconds spent starting/stopping profiler captures "
+            "(zero unless a capture ran)",
+        ).inc(seconds)
+
+    def stop_if_active(self):
+        """Shutdown/atexit hook: a run that ends mid-window still gets a
+        usable trace rather than a torn session. The recorded window ends
+        at the last step edge this capture actually saw."""
+        if self.active:
+            last = self._last_step
+            if last is None:
+                last = self._started_at if self._started_at is not None else -1
+            self._stop(last)
+
+    def reset(self):
+        """Testing hook: stop any live capture and re-read the env."""
+        self.stop_if_active()
+        self._parsed_env = False
+        self._window = None
+        self._sig_request = False
+        self.last_window = None
+        self._started_at = None
+        self._last_step = None
+
+
+capture = ProfileCapture()
+atexit.register(capture.stop_if_active)
+
+
+# ----------------------------------------------------------------------
+# Roofline / MFU attribution
+# ----------------------------------------------------------------------
+
+# Peak dense bf16 TFLOP/s and HBM GB/s per chip, by device_kind fragment
+# (public spec sheets). Single source of truth — bench.py's MFU
+# denominator reads THIS table through device_peaks.
+_PEAK_TFLOPS = (
+    ("v6", 918.0),
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),
+    ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 46.0),
+)
+_PEAK_GBPS = (
+    ("v6", 1640.0),
+    ("v5p", 2765.0),
+    ("v5 lite", 819.0),
+    ("v5e", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+_DEVICE_KIND_CACHE = []  # [kind] once resolved (jax.devices() is sticky)
+
+
+def _env_float(name):
+    raw = os.environ.get(name, "")
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("invalid %s=%r (want a number); ignored.", name, raw)
+        return None
+
+
+def _device_kind(device):
+    if device is not None:
+        return getattr(device, "device_kind", "").lower()
+    if not _DEVICE_KIND_CACHE:
+        try:
+            _DEVICE_KIND_CACHE.append(
+                getattr(jax.devices()[0], "device_kind", "").lower()
+            )
+        except Exception:  # pragma: no cover - backend bring-up failure
+            _DEVICE_KIND_CACHE.append("")
+    return _DEVICE_KIND_CACHE[0]
+
+
+def device_peaks(device=None):
+    """(peak FLOP/s, peak bytes/s) for the attribution denominator.
+
+    ``SMP_PEAK_TFLOPS`` / ``SMP_PEAK_GBPS`` override (required on
+    backends the spec table does not know, e.g. the CPU test mesh);
+    otherwise looked up by ``device_kind``. Unknown entries are None —
+    callers must treat MFU as unavailable rather than fabricate one.
+    """
+    flops = _env_float(PEAK_TFLOPS_ENV)
+    flops = flops * 1e12 if flops is not None else None
+    bps = _env_float(PEAK_GBPS_ENV)
+    bps = bps * 1e9 if bps is not None else None
+    if flops is None or bps is None:
+        kind = _device_kind(device)
+        if flops is None:
+            for frag, v in _PEAK_TFLOPS:
+                if frag in kind:
+                    flops = v * 1e12
+                    break
+        if bps is None:
+            for frag, v in _PEAK_GBPS:
+                if frag in kind:
+                    bps = v * 1e9
+                    break
+    return flops, bps
+
+
+def cost_of(compiled):
+    """(flops, bytes_accessed) from a compiled executable's
+    ``cost_analysis`` — (None, None) when the backend won't say."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0] if cost else {}
+        flops = cost.get("flops")
+        nbytes = cost.get("bytes accessed")
+        return (
+            float(flops) if flops is not None else None,
+            float(nbytes) if nbytes is not None else None,
+        )
+    except Exception:
+        return None, None
+
+
+class RooflineReport:
+    """One step program's roofline attribution (plain attributes +
+    ``as_dict``). ``None`` fields mean "not attributable" (unknown peak,
+    missing cost analysis), never a guess."""
+
+    def __init__(self, **kw):
+        self.name = kw.get("name")
+        self.step_time_s = kw.get("step_time_s")
+        self.flops = kw.get("flops")
+        self.bytes_accessed = kw.get("bytes_accessed")
+        self.peak_flops_per_s = kw.get("peak_flops_per_s")
+        self.peak_bytes_per_s = kw.get("peak_bytes_per_s")
+        self.mfu = kw.get("mfu")
+        self.achieved_flops_per_s = kw.get("achieved_flops_per_s")
+        self.achieved_bytes_per_s = kw.get("achieved_bytes_per_s")
+        self.arithmetic_intensity = kw.get("arithmetic_intensity")
+        self.ridge_intensity = kw.get("ridge_intensity")
+        self.bound = kw.get("bound")        # "compute" | "memory" | None
+        self.compute_s = kw.get("compute_s")
+        self.memory_s = kw.get("memory_s")
+        self.bubble_fraction = kw.get("bubble_fraction")
+        self.bubble_s = kw.get("bubble_s")
+        self.comm_s = kw.get("comm_s")
+
+    def as_dict(self):
+        return {
+            k: getattr(self, k)
+            for k in (
+                "name", "step_time_s", "flops", "bytes_accessed",
+                "peak_flops_per_s", "peak_bytes_per_s", "mfu",
+                "achieved_flops_per_s", "achieved_bytes_per_s",
+                "arithmetic_intensity", "ridge_intensity", "bound",
+                "compute_s", "memory_s", "bubble_fraction", "bubble_s",
+                "comm_s",
+            )
+        }
+
+
+def _live_gauge_max(name):
+    """Max value across a live gauge family's series (None when absent)."""
+    fam = telemetry._families.get(name)
+    if fam is None:
+        return None
+    with fam._lock:
+        children = list(fam._children.values())
+    return max((c.value for c in children), default=None)
+
+
+def roofline(name="step", *, step_time_s, flops=None, bytes_accessed=None,
+             compiled=None, bubble_fraction=None, device=None,
+             peak_flops=None, peak_bytes_per_s=None, publish=True):
+    """Join program cost with measured wall time into a roofline report.
+
+    Args:
+      name: label for the published gauges (``step=<name>``).
+      step_time_s: measured wall time of one step of this program.
+      flops / bytes_accessed: explicit program cost; missing pieces are
+        filled from ``compiled.cost_analysis()`` when given.
+      compiled: a compiled executable (``jax.jit(...).lower().compile()``
+        or the step runner's AOT executable).
+      bubble_fraction: pipeline idle fraction; defaults to the live
+        ``smp_pipeline_bubble_fraction`` gauge (0.0 when no pipeline).
+      device / peak_flops / peak_bytes_per_s: attribution denominators;
+        default to ``device_peaks`` (spec table + the peak env overrides).
+      publish: set the ``smp_mfu`` / ``smp_roofline_*`` gauges.
+
+    Decomposition (published per label): ``compute_s`` is the ideal
+    compute-bound time ``flops / peak_flops``; ``bubble_s`` is
+    ``bubble_fraction * step_time``; ``comm_s`` is the residual — time
+    the roofline model cannot attribute to ideal compute or schedule
+    bubble (collectives, memory-bound stalls, host overhead).
+    ``memory_s`` (``bytes / peak_bw``) is reported alongside as the
+    bandwidth bound.
+    """
+    if compiled is not None and (flops is None or bytes_accessed is None):
+        c_flops, c_bytes = cost_of(compiled)
+        flops = flops if flops is not None else c_flops
+        bytes_accessed = (
+            bytes_accessed if bytes_accessed is not None else c_bytes
+        )
+    if peak_flops is None or peak_bytes_per_s is None:
+        d_flops, d_bps = device_peaks(device)
+        peak_flops = peak_flops if peak_flops is not None else d_flops
+        peak_bytes_per_s = (
+            peak_bytes_per_s if peak_bytes_per_s is not None else d_bps
+        )
+    if bubble_fraction is None:
+        bubble_fraction = _live_gauge_max("smp_pipeline_bubble_fraction")
+        bubble_fraction = 0.0 if bubble_fraction is None else bubble_fraction
+
+    dt = float(step_time_s) if step_time_s else None
+    achieved_f = flops / dt if (flops is not None and dt) else None
+    achieved_b = bytes_accessed / dt if (bytes_accessed is not None and dt) else None
+    mfu = (
+        achieved_f / peak_flops
+        if (achieved_f is not None and peak_flops) else None
+    )
+    ai = (
+        flops / bytes_accessed
+        if (flops is not None and bytes_accessed) else None
+    )
+    ridge = (
+        peak_flops / peak_bytes_per_s
+        if (peak_flops and peak_bytes_per_s) else None
+    )
+    bound = None
+    if ai is not None and ridge is not None:
+        bound = "compute" if ai >= ridge else "memory"
+    compute_s = flops / peak_flops if (flops is not None and peak_flops) else None
+    memory_s = (
+        bytes_accessed / peak_bytes_per_s
+        if (bytes_accessed is not None and peak_bytes_per_s) else None
+    )
+    bubble_s = bubble_fraction * dt if dt is not None else None
+    comm_s = None
+    if dt is not None and compute_s is not None and bubble_s is not None:
+        comm_s = max(dt - compute_s - bubble_s, 0.0)
+
+    report = RooflineReport(
+        name=name, step_time_s=dt, flops=flops,
+        bytes_accessed=bytes_accessed, peak_flops_per_s=peak_flops,
+        peak_bytes_per_s=peak_bytes_per_s, mfu=mfu,
+        achieved_flops_per_s=achieved_f, achieved_bytes_per_s=achieved_b,
+        arithmetic_intensity=ai, ridge_intensity=ridge, bound=bound,
+        compute_s=compute_s, memory_s=memory_s,
+        bubble_fraction=bubble_fraction, bubble_s=bubble_s, comm_s=comm_s,
+    )
+    if publish:
+        _publish(report)
+    return report
+
+
+def _publish(r):
+    lab = dict(step=r.name)
+    for value, metric, help_ in (
+        (r.mfu, "smp_mfu",
+         "model FLOPs utilization of the last measured step"),
+        (r.flops, "smp_roofline_flops",
+         "program FLOPs joined into the roofline report"),
+        (r.bytes_accessed, "smp_roofline_bytes",
+         "program bytes accessed joined into the roofline report"),
+        (r.step_time_s, "smp_roofline_step_seconds",
+         "measured step wall time of the roofline report"),
+        (r.achieved_flops_per_s, "smp_roofline_achieved_flops_per_s",
+         "achieved FLOP/s of the last measured step"),
+        (r.achieved_bytes_per_s, "smp_roofline_achieved_bytes_per_s",
+         "achieved HBM bytes/s of the last measured step"),
+        (r.arithmetic_intensity, "smp_roofline_arithmetic_intensity",
+         "program FLOPs per byte accessed"),
+        (r.ridge_intensity, "smp_roofline_ridge_intensity",
+         "device ridge point (peak FLOP/s / peak bytes/s)"),
+        (r.compute_s, "smp_roofline_compute_seconds",
+         "ideal compute-bound time (flops / peak FLOP/s)"),
+        (r.memory_s, "smp_roofline_memory_seconds",
+         "ideal bandwidth-bound time (bytes / peak bytes/s)"),
+        (r.bubble_s, "smp_roofline_bubble_seconds",
+         "pipeline-bubble share of the step time"),
+        (r.comm_s, "smp_roofline_comm_seconds",
+         "residual step time not attributed to ideal compute or bubble "
+         "(collectives, memory stalls, host overhead)"),
+        (r.peak_flops_per_s, "smp_roofline_peak_flops_per_s",
+         "peak FLOP/s used as the MFU denominator"),
+        (r.peak_bytes_per_s, "smp_roofline_peak_bytes_per_s",
+         "peak bytes/s used as the bandwidth denominator"),
+    ):
+        if value is not None:
+            telemetry.gauge(metric, help_).labels(**lab).set(float(value))
+    if r.bound is not None:
+        telemetry.gauge(
+            "smp_roofline_compute_bound",
+            "1 when arithmetic intensity sits above the ridge point",
+        ).labels(**lab).set(1.0 if r.bound == "compute" else 0.0)
+
+
+ROOFLINE_SAMPLE_EVERY = 16
+
+
+def should_sample_step(step):
+    """Steps where the engine blocks on the step's outputs to measure an
+    EXACT wall time for the roofline gauges (step 1, then every 16th).
+
+    Without a block, async dispatch returns long before the device
+    finishes; dividing program FLOPs by that lower-bound time would
+    publish an upper-bound — i.e. wrong, possibly >1.0 — MFU. Sampling
+    keeps the gauges honest at ~zero throughput cost (one drained
+    dispatch queue per 16 steps)."""
+    return step % ROOFLINE_SAMPLE_EVERY == 1
+
+
+def record_step_roofline(runner, step_time_s):
+    """Per-step hook from the step engine: publish ``smp_mfu`` and the
+    decomposition for this runner's program, costing a few float ops.
+
+    The runner's compiled cost analysis is read once and cached on the
+    runner; attribution is skipped entirely (cached as unavailable) when
+    the executable or its cost analysis is missing. The engine only calls
+    this with EXACT step times — the timeline-blocked path, or a sampled
+    ``should_sample_step`` block — never the async-dispatch lower bound.
+    """
+    if runner is None or not step_time_s:
+        return None
+    cached = getattr(runner, "_roofline_cost", None)
+    if cached is None:
+        compiled = runner.holder.get("compiled") if hasattr(runner, "holder") else None
+        cost = cost_of(compiled) if compiled is not None else (None, None)
+        cached = cost if cost[0] is not None else False
+        runner._roofline_cost = cached
+    if cached is False:
+        return None
+    flops, nbytes = cached
+    return roofline(
+        getattr(runner, "step_name", "step"),
+        step_time_s=step_time_s, flops=flops, bytes_accessed=nbytes,
+    )
+
+
+# ----------------------------------------------------------------------
+# Breakdown API (scripts/perf_probe.py, scripts/step_breakdown.py, bench)
+# ----------------------------------------------------------------------
+
+
+class StepBreakdown:
+    """Named component timings, emitted one JSON object per line in the
+    exact schema ``bench.py`` writes to stderr:
+    ``{"component": <name>, "ms": <float>, ...extras}``.
+
+    ``record`` takes seconds (the JSON carries ms, like bench); every
+    component also lands in the ``smp_breakdown_ms`` telemetry gauge so a
+    probe run's breakdown rides in its telemetry dump.
+    """
+
+    def __init__(self, context=None):
+        self._rows = []
+        self._context = dict(context or {})
+
+    @property
+    def rows(self):
+        return list(self._rows)
+
+    def record(self, component, seconds, **extras):
+        row = dict(self._context)
+        row.update(extras)
+        row["component"] = component
+        row["ms"] = round(float(seconds) * 1e3, 3)
+        self._rows.append(row)
+        telemetry.gauge(
+            "smp_breakdown_ms", "perf-probe component wall time (ms)"
+        ).labels(component=component).set(float(seconds) * 1e3)
+        return row
+
+    def time(self, component, fn, *args, iters=10, readback=None, **extras):
+        """Warmup call + timed loop; records the mean per-iteration wall
+        time. ``readback`` forces a device->host sync (defaults to
+        ``jax.block_until_ready``). Not for donating functions — those
+        must thread their own state and call ``record`` directly."""
+        out = fn(*args)
+        self._force(out, readback)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        self._force(out, readback)
+        dt = (time.perf_counter() - t0) / iters
+        self.record(component, dt, iters=iters, **extras)
+        return out, dt
+
+    @staticmethod
+    def _force(out, readback):
+        if readback is not None:
+            readback(out)
+        else:
+            jax.block_until_ready(out)
+
+    def emit(self, stream=None):
+        """Write every recorded row as one JSON line (bench schema).
+        Returns the rows."""
+        stream = sys.stderr if stream is None else stream
+        for row in self._rows:
+            stream.write(json.dumps(row) + "\n")
+        stream.flush()
+        return self.rows

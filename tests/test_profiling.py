@@ -1,0 +1,497 @@
+"""Performance-observability tests (``utils/profiling.py`` +
+``scripts/perf_ledger.py``).
+
+Covers the four tentpole pieces: named profiler regions (in-graph names
+land in compiled-HLO op metadata; host regions land in the timeline),
+on-demand capture (``SMP_PROFILE=steps=N:M`` brackets exactly that window
+into a per-rank dir; SIGUSR2 arms a one-step capture), roofline/MFU
+attribution (toy values match hand-computed FLOPs/bytes; gauges publish;
+the telemetry-report CLI renders them), and the perf-regression ledger
+(golden synthetic fixtures + the tier-1 gate over the COMMITTED bench
+history, which must reproduce the ROADMAP trajectory: r2 0.984 -> r4
+1.013 / MFU 0.342). The compile-cache hit-rate assertion rides the
+end-to-end run — a deterministic CPU-safe regression gate, per the
+ledger's no-wall-time-in-CI rule. Plus the trace_fuse per-phase skew
+satellite over synthetic two-rank timelines.
+"""
+
+import importlib.util
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils import profiling
+from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+from smdistributed_modelparallel_tpu.utils.timeline import Timeline
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPTS = os.path.join(_REPO, "scripts")
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_SCRIPTS, name + ".py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _gauge(report, name, **labels):
+    fam = report.get("metrics", {}).get(name)
+    for s in (fam or {}).get("series", []):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s.get("value")
+    return None
+
+
+# ----------------------------------------------------------------------
+# Named regions
+# ----------------------------------------------------------------------
+
+
+class TestRegions:
+    def test_named_region_in_compiled_hlo_and_cost_join(self):
+        """One compile covers both halves: the in-graph region name lands
+        in the compiled HLO's op metadata, and roofline() joins that same
+        executable's cost analysis with a wall time."""
+
+        def f(x):
+            with profiling.named_region("smp/test/matmul_region"):
+                return x @ x
+
+        compiled = jax.jit(f).lower(jnp.ones((32, 32))).compile()
+        assert "matmul_region" in compiled.as_text()
+
+        rep = profiling.roofline(
+            "hlo_join", step_time_s=0.01, compiled=compiled,
+            peak_flops=1e12, peak_bytes_per_s=1e9,
+        )
+        assert rep.flops is not None and rep.flops > 0
+        assert rep.bytes_accessed is not None and rep.bytes_accessed > 0
+        assert rep.mfu == pytest.approx(rep.flops / 0.01 / 1e12)
+
+    def test_region_records_timeline_span(self, tmp_path):
+        path = str(tmp_path / "tl.json")
+        tl = Timeline(path=path)
+        assert tl.enabled
+        old = state.timeline
+        state.timeline = tl
+        try:
+            with profiling.region("unit/phase"):
+                time.sleep(0.002)
+        finally:
+            state.timeline = old
+        tl.flush()
+        with open(tl.path) as f:
+            events = json.load(f)["traceEvents"]
+        spans = [e for e in events
+                 if e.get("name") == "smp_phase/unit/phase"
+                 and e.get("ph") == "X"]
+        assert spans and spans[0]["dur"] > 0
+
+    def test_region_noop_without_timeline(self):
+        old = state.timeline
+        state.timeline = None
+        try:
+            with profiling.region("unit/nothing"):
+                pass
+        finally:
+            state.timeline = old
+
+
+# ----------------------------------------------------------------------
+# On-demand capture
+# ----------------------------------------------------------------------
+
+
+class TestCapture:
+    def test_parse_spec(self):
+        assert profiling._parse_profile_spec("steps=1:2") == (1, 2)
+        assert profiling._parse_profile_spec("steps=3") == (3, 3)
+        assert profiling._parse_profile_spec("4:7") == (4, 7)
+        for bad in ("steps=2:1", "steps=-1", "steps=a:b", "", "1:2:3"):
+            with pytest.raises(ValueError):
+                profiling._parse_profile_spec(bad)
+
+    def test_sigusr2_arms_one_step_window(self, monkeypatch, tmp_path):
+        calls = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+        )
+        monkeypatch.setenv(profiling.PROFILE_PATH_ENV, str(tmp_path))
+        monkeypatch.delenv(profiling.PROFILE_ENV, raising=False)
+        cap = profiling.ProfileCapture()
+        prev = signal.getsignal(signal.SIGUSR2)
+        try:
+            cap.install_signal()
+            os.kill(os.getpid(), signal.SIGUSR2)
+            deadline = time.time() + 5
+            while not cap._sig_request and time.time() < deadline:
+                time.sleep(0.005)
+            assert cap._sig_request, "signal handler never ran"
+            cap.on_step_begin(7)
+            assert cap.active
+            cap.on_step_end(7)
+            assert not cap.active
+        finally:
+            signal.signal(signal.SIGUSR2, prev)
+        assert [c[0] for c in calls] == ["start", "stop"]
+        assert calls[0][1].endswith("rank0")
+        assert cap.last_window == (7, 7)
+
+    def test_sigusr2_does_not_cancel_armed_window(self, monkeypatch):
+        monkeypatch.setenv(profiling.PROFILE_ENV, "steps=100:102")
+        cap = profiling.ProfileCapture()
+        cap._sig_request = True      # as if SIGUSR2 arrived before step 5
+        cap.on_step_begin(5)
+        assert not cap.active
+        assert cap.window == (100, 102)   # the configured window survives
+
+    def test_stop_if_active_records_last_seen_step(self, monkeypatch):
+        monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+        monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+        monkeypatch.setenv(profiling.PROFILE_ENV, "steps=1:5")
+        cap = profiling.ProfileCapture()
+        cap.on_step_begin(1)
+        cap.on_step_end(1)
+        cap.on_step_begin(2)
+        cap.on_step_end(2)
+        assert cap.active                 # window runs through step 5
+        cap.stop_if_active()              # run died after step 2
+        assert cap.last_window == (1, 2)
+
+    def test_disarmed_hooks_are_noops(self, monkeypatch):
+        monkeypatch.delenv(profiling.PROFILE_ENV, raising=False)
+        cap = profiling.ProfileCapture()
+        cap.on_step_begin(0)
+        cap.on_step_end(0)
+        assert not cap.active and cap.last_window is None
+
+
+# ----------------------------------------------------------------------
+# Roofline / MFU attribution
+# ----------------------------------------------------------------------
+
+
+class TestRoofline:
+    def test_toy_values_match_hand_computed(self):
+        rep = profiling.roofline(
+            "toy", step_time_s=0.5, flops=1e12, bytes_accessed=1e10,
+            bubble_fraction=0.2, peak_flops=4e12, peak_bytes_per_s=1e11,
+        )
+        assert rep.mfu == pytest.approx(0.5)          # 1e12 / 0.5 / 4e12
+        assert rep.achieved_flops_per_s == pytest.approx(2e12)
+        assert rep.achieved_bytes_per_s == pytest.approx(2e10)
+        assert rep.arithmetic_intensity == pytest.approx(100.0)
+        assert rep.ridge_intensity == pytest.approx(40.0)
+        assert rep.bound == "compute"                 # 100 >= 40
+        assert rep.compute_s == pytest.approx(0.25)   # 1e12 / 4e12
+        assert rep.memory_s == pytest.approx(0.1)     # 1e10 / 1e11
+        assert rep.bubble_s == pytest.approx(0.1)     # 0.2 * 0.5
+        assert rep.comm_s == pytest.approx(0.15)      # 0.5 - 0.25 - 0.1
+        # Published gauges match the report.
+        report = telemetry.report()
+        assert _gauge(report, "smp_mfu", step="toy") == pytest.approx(0.5)
+        assert _gauge(
+            report, "smp_roofline_comm_seconds", step="toy"
+        ) == pytest.approx(0.15)
+        assert _gauge(
+            report, "smp_roofline_compute_bound", step="toy"
+        ) == 1.0
+
+    def test_memory_bound_classification(self):
+        rep = profiling.roofline(
+            "toy_mem", step_time_s=0.1, flops=1e9, bytes_accessed=1e9,
+            bubble_fraction=0.0, peak_flops=1e12, peak_bytes_per_s=1e10,
+        )
+        assert rep.arithmetic_intensity == pytest.approx(1.0)
+        assert rep.ridge_intensity == pytest.approx(100.0)
+        assert rep.bound == "memory"
+
+    def test_device_peak_env_overrides(self, monkeypatch):
+        monkeypatch.setenv(profiling.PEAK_TFLOPS_ENV, "2")
+        monkeypatch.setenv(profiling.PEAK_GBPS_ENV, "4")
+        flops, bps = profiling.device_peaks()
+        assert flops == pytest.approx(2e12)
+        assert bps == pytest.approx(4e9)
+
+    def test_unknown_backend_yields_no_mfu(self, monkeypatch):
+        monkeypatch.delenv(profiling.PEAK_TFLOPS_ENV, raising=False)
+        monkeypatch.delenv(profiling.PEAK_GBPS_ENV, raising=False)
+        # CPU device kind is not in the spec table: MFU must be absent,
+        # never fabricated.
+        rep = profiling.roofline(
+            "toy_cpu", step_time_s=0.1, flops=1e9, bytes_accessed=1e9,
+            bubble_fraction=0.0, publish=False,
+        )
+        assert rep.mfu is None
+        assert rep.achieved_flops_per_s == pytest.approx(1e10)
+
+
+class TestBreakdown:
+    def test_records_and_emits_bench_schema(self):
+        bd = profiling.StepBreakdown(context={"probe": "unit"})
+        bd.record("fwd_only", 0.012, iters=3)
+        bd.record("full_step", 0.034)
+        buf = io.StringIO()
+        rows = bd.emit(buf)
+        lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+        assert lines == rows
+        assert lines[0]["component"] == "fwd_only"
+        assert lines[0]["ms"] == pytest.approx(12.0)
+        assert lines[0]["probe"] == "unit"
+        assert lines[0]["iters"] == 3
+        assert _gauge(
+            telemetry.report(), "smp_breakdown_ms", component="full_step"
+        ) == pytest.approx(34.0)
+
+
+# ----------------------------------------------------------------------
+# End-to-end: capture window + smp_mfu + compile-cache gate (CPU smoke)
+# ----------------------------------------------------------------------
+
+
+class TestEndToEnd:
+    def test_capture_window_mfu_and_cache_hit_rate(self, tmp_path,
+                                                   monkeypatch):
+        prof_dir = tmp_path / "prof"
+        monkeypatch.setenv(profiling.PROFILE_ENV, "steps=1:2")
+        monkeypatch.setenv(profiling.PROFILE_PATH_ENV, str(prof_dir))
+        # The CPU mesh has no spec-table peaks; the override is what makes
+        # smp_mfu appear on the smoke run (acceptance criterion).
+        monkeypatch.setenv(profiling.PEAK_TFLOPS_ENV, "0.001")
+        monkeypatch.setenv(profiling.PEAK_GBPS_ENV, "1.0")
+        profiling.capture.reset()
+
+        smp.init({"microbatches": 2})
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(8)(x)
+
+        model = smp.DistributedModel(Net())
+        opt = smp.DistributedOptimizer(optax.sgd(0.1), model)
+
+        @smp.step
+        def train(model, x, y):
+            out = model(x)
+            loss = jnp.mean((out - y) ** 2)
+            model.backward(loss)
+            return loss
+
+        x = jax.random.normal(jax.random.key(0), (4, 8))
+        y = jax.random.normal(jax.random.key(1), (4, 8))
+        for _ in range(4):
+            train(model, x, y)
+            opt.step()
+
+        # Capture bracketed exactly steps 1..2, into the per-rank dir.
+        assert profiling.capture.last_window == (1, 2)
+        rank_dir = os.path.join(str(prof_dir), "rank0")
+        assert os.path.isdir(rank_dir)
+        trace_files = [
+            os.path.join(r, f)
+            for r, _, fs in os.walk(rank_dir) for f in fs
+        ]
+        assert trace_files, "capture produced no trace files"
+        assert sum(os.path.getsize(f) for f in trace_files) > 0
+
+        report = telemetry.report()
+        assert _gauge(report, "smp_profile_active") == 0.0
+        assert _gauge(report, "smp_profile_last_first_step") == 1.0
+        assert _gauge(report, "smp_profile_last_last_step") == 2.0
+        assert _gauge(report, "smp_profile_captures_total") == 1.0
+
+        # smp_mfu + roofline decomposition, self-consistent with the
+        # published FLOPs / step time / peak (hand-computable chain).
+        mfu = _gauge(report, "smp_mfu", step="step")
+        flops = _gauge(report, "smp_roofline_flops", step="step")
+        step_s = _gauge(report, "smp_roofline_step_seconds", step="step")
+        peak = _gauge(report, "smp_roofline_peak_flops_per_s", step="step")
+        comp = _gauge(report, "smp_roofline_compute_seconds", step="step")
+        comm = _gauge(report, "smp_roofline_comm_seconds", step="step")
+        bub = _gauge(report, "smp_roofline_bubble_seconds", step="step")
+        assert mfu is not None and mfu > 0
+        assert peak == pytest.approx(1e9)             # 0.001 TFLOP/s
+        assert mfu == pytest.approx(flops / step_s / peak, rel=1e-6)
+        assert comp == pytest.approx(flops / peak, rel=1e-6)
+        assert bub == pytest.approx(0.0)              # no pipeline
+        assert comp + comm + bub == pytest.approx(step_s, rel=1e-6)
+
+        # Regression-gate half: CPU-smoke compile-cache hit rate (no wall
+        # time — 4 identical steps must be 1 miss + 3 hits).
+        assert _gauge(
+            report, "smp_step_compile_cache_total", event="miss"
+        ) == 1.0
+        assert _gauge(
+            report, "smp_step_compile_cache_total", event="hit"
+        ) == 3.0
+
+        # The report CLI renders the Performance section from this dump.
+        tr = _load_script("telemetry_report")
+        buf = io.StringIO()
+        tr.render(report, out=buf)
+        text = buf.getvalue()
+        assert "-- performance --" in text
+        assert "MFU" in text and "decomposition:" in text
+
+
+# ----------------------------------------------------------------------
+# Perf-regression ledger
+# ----------------------------------------------------------------------
+
+
+def _write_round(repo, n, rc, parsed=None):
+    payload = {"n": n, "cmd": "python bench.py", "rc": rc, "tail": "",
+               "parsed": parsed}
+    with open(os.path.join(repo, f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump(payload, f)
+
+
+def _tpu_parsed(vs, mfu=None, value=50000.0):
+    return {"metric": "tokens/sec/chip GPT-2-124M train step",
+            "value": value, "vs_baseline": vs, "mfu": mfu}
+
+
+class TestLedger:
+    @pytest.fixture()
+    def ledger_mod(self):
+        return _load_script("perf_ledger")
+
+    def test_golden_notes_fallback(self, tmp_path, ledger_mod):
+        repo = str(tmp_path)
+        _write_round(repo, 1, 0, _tpu_parsed(1.0))
+        _write_round(repo, 2, 3)
+        with open(os.path.join(repo, "BENCH_NOTES.md"), "w") as f:
+            f.write(
+                "# notes\n\n## Round 2 (chip wedged late)\n\nprose says "
+                "round-1 measured vs_baseline 0.5 (must NOT be parsed)\n\n"
+                "```\npath a:  vs_baseline 1.02   MFU 0.31\n"
+                "path b:  vs_baseline 1.10   MFU 0.40\n```\n"
+            )
+        with open(os.path.join(repo, "BASELINE.json"), "w") as f:
+            json.dump({"metric": "m"}, f)
+        ledger = ledger_mod.build_ledger(repo)
+        assert ledger["ok"], ledger["problems"]
+        r2 = ledger["rounds"][1]
+        assert r2["status"] == "notes"
+        assert r2["vs_baseline"] == pytest.approx(1.10)   # best block
+        assert r2["mfu"] == pytest.approx(0.40)
+        assert ledger["best_on_chip"]["round"] == 2
+
+    def test_regression_without_notes_entry_fails(self, tmp_path,
+                                                  ledger_mod):
+        repo = str(tmp_path)
+        _write_round(repo, 1, 0, _tpu_parsed(1.0))
+        _write_round(repo, 2, 0, _tpu_parsed(0.80))
+        with open(os.path.join(repo, "BASELINE.json"), "w") as f:
+            json.dump({"metric": "m"}, f)
+        ledger = ledger_mod.build_ledger(repo)
+        assert not ledger["ok"]
+        assert any("regressed" in p for p in ledger["problems"])
+        # A BENCH_NOTES.md entry for the round excuses the drop.
+        with open(os.path.join(repo, "BENCH_NOTES.md"), "w") as f:
+            f.write("## Round 2\n\nknown slow path probe; expected.\n")
+        assert ledger_mod.build_ledger(repo)["ok"]
+
+    def test_numbering_and_schema_invariants(self, tmp_path, ledger_mod):
+        repo = str(tmp_path)
+        with open(os.path.join(repo, "BASELINE.json"), "w") as f:
+            json.dump({"metric": "m"}, f)
+        # rc=0 with no parsed block is a schema error.
+        _write_round(repo, 1, 0, None)
+        ledger = ledger_mod.build_ledger(repo)
+        assert any("schema" in p or "parsed" in p for p in ledger["problems"])
+        # Duplicate round number in the next file.
+        _write_round(repo, 1, 0, _tpu_parsed(1.0))
+        os.replace(
+            os.path.join(repo, "BENCH_r01.json"),
+            os.path.join(repo, "BENCH_r02.json"),
+        )
+        _write_round(repo, 1, 0, _tpu_parsed(1.0))
+        ledger = ledger_mod.build_ledger(repo)
+        assert any("strictly increasing" in p for p in ledger["problems"])
+
+    def test_committed_history_reproduces_roadmap(self, ledger_mod):
+        """Tier-1 regression gate over the real repo history: the ledger
+        must reproduce the ROADMAP bench trajectory from committed files
+        and its invariants must hold."""
+        ledger = ledger_mod.build_ledger(_REPO)
+        assert ledger["ok"], ledger["problems"]
+        by_round = {r["round"]: r for r in ledger["rounds"]}
+        assert by_round[2]["vs_baseline"] == pytest.approx(0.984)
+        assert by_round[2]["mfu"] == pytest.approx(0.2714)
+        assert by_round[4]["status"] == "notes"
+        assert by_round[4]["vs_baseline"] == pytest.approx(1.013)
+        assert by_round[4]["mfu"] == pytest.approx(0.342)
+        assert ledger["best_on_chip"]["round"] == 4
+
+    def test_cli_check_entry_point(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(_SCRIPTS, "perf_ledger.py"),
+             "--check"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        verdict = json.loads(out.stdout)
+        assert verdict["ok"] is True
+
+
+# ----------------------------------------------------------------------
+# trace_fuse: per-phase skew from smp_phase/* region spans
+# ----------------------------------------------------------------------
+
+
+class TestTraceFusePhases:
+    def _timeline_payload(self, rank, wall0_us, dispatch_ms):
+        return {"traceEvents": [
+            {"name": f"smp_clock_anchor/{wall0_us}/{rank}", "ph": "i",
+             "ts": 0.0, "pid": 0, "tid": "sync", "s": "g"},
+            {"name": "step_0_begin", "ph": "i", "ts": 100.0, "pid": 0,
+             "tid": "pipeline", "s": "g"},
+            {"name": "smp_phase/step/dispatch", "ph": "X", "ts": 120.0,
+             "dur": dispatch_ms * 1e3, "pid": 0, "tid": "phase",
+             "args": {"step": 0}},
+            {"name": "step_0_end", "ph": "i",
+             "ts": 150.0 + dispatch_ms * 1e3, "pid": 0, "tid": "pipeline",
+             "s": "g"},
+        ]}
+
+    def test_per_phase_skew_report(self, tmp_path):
+        tf = _load_script("trace_fuse")
+        wall = 1_700_000_000_000_000
+        for rank, ms in ((0, 10.0), (1, 25.0)):
+            with open(tmp_path / f"tl.json.rank{rank}", "w") as f:
+                json.dump(self._timeline_payload(rank, wall, ms), f)
+        streams = tf.collect_inputs([str(tmp_path)])
+        assert len(streams) == 2
+        clock = tf.align(streams)
+        buf = io.StringIO()
+        tf.render_report(streams, clock, out=buf)
+        text = buf.getvalue()
+        assert "per-phase skew" in text
+        assert "step/dispatch" in text
+        assert "<- slowest" in text
+        # Rank 1's 25 ms dispatch must be attributed as the slow one.
+        phases = tf.phase_table(streams)
+        durs = phases[(0, "step/dispatch")]
+        assert durs[1] > durs[0]
+        assert max(durs, key=durs.get) == 1
