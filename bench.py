@@ -282,19 +282,22 @@ def _health_overhead_probe(train_step, model, optimizer, ids, iters,
 
 
 def _pipeline_interleave_probe(deadline):
-    """SMP_BENCH_PIPELINE_PROBE=1: virtual_pipeline_degree=1 vs =2 A/B at
-    pp=2, mb=8.
+    """SMP_BENCH_PIPELINE_PROBE=1: 3-way pipeline-schedule A/B at pp=2,
+    mb=8 — plain 1F1B (v=1) vs interleaved (v=2) vs zero-bubble ZB-H1
+    (v=2, split backward).
 
     Same interleaved-pairs methodology as the health probe (alternating
-    blocks, medians of up to 3 pairs, window-capped) with one forced
-    difference: the two variants cannot share a compiled program — the
-    virtual degree changes the partitioning and the baked schedule — so
-    each block re-inits the framework and pays its compile during the
-    per-block warmup steps, OUTSIDE the timed region. Emits one stderr
-    JSON line {"component": "pipeline_interleave", v1_ms, v2_ms, speedup,
-    ...}; the pass criterion is a TPU criterion recorded in BENCH_NOTES.md
-    (the CPU smoke number is compile/reduce-bound and only proves the
-    plumbing). Never fails the bench.
+    blocks, medians of up to 3 rounds, window-capped) with one forced
+    difference: the variants cannot share a compiled program — the
+    schedule kind and virtual degree change the partitioning and the
+    baked schedule — so each block re-inits the framework and pays its
+    compile during the per-block warmup steps, OUTSIDE the timed region.
+    Emits one stderr JSON line {"component": "pipeline_schedule",
+    schedules: {name: ms}, speedup_v2, speedup_zb, schedule_best, ...}
+    (plus the legacy v1_ms/v2_ms/speedup fields); the pass criterion is a
+    TPU criterion recorded in BENCH_NOTES.md (the CPU smoke number is
+    compile/reduce-bound and only proves the plumbing). Never fails the
+    bench.
     """
     import jax
 
@@ -322,11 +325,12 @@ def _pipeline_interleave_probe(deadline):
     )
     iters = 10 if on_tpu else 3
 
-    def build(v):
+    def build(v, schedule="interleaved"):
         smp.reset()
         smp.init({
             "pipeline_parallel_degree": 2, "microbatches": 8, "ddp": True,
             "virtual_pipeline_degree": v, "bf16": bool(on_tpu),
+            "pipeline": schedule,
         })
         model = smp.DistributedModel(TransformerLM(
             vocab_size=vocab, max_len=seq, d_model=d_model,
@@ -347,8 +351,8 @@ def _pipeline_interleave_probe(deadline):
 
         return model, optimizer, train_step, ids
 
-    def timed_block(v):
-        model, optimizer, train_step, ids = build(v)
+    def timed_block(v, schedule="interleaved"):
+        model, optimizer, train_step, ids = build(v, schedule)
         out = None
         for _ in range(2):      # warmup: compile + first dispatch
             out = train_step(model, ids)
@@ -361,14 +365,19 @@ def _pipeline_interleave_probe(deadline):
         _readback(out.reduce_mean())
         return (time.perf_counter() - t0) / iters
 
-    v1_times, v2_times = [], []
+    # Variant order inside a round keeps the A/B/C blocks interleaved so
+    # clock/thermal drift hits all three schedules alike.
+    variants = (("1f1b", 1, "interleaved"),
+                ("interleaved_v2", 2, "interleaved"),
+                ("zb_h1", 2, "zero_bubble"))
+    times = {name: [] for name, _, _ in variants}
     for _ in range(3):
-        v1_times.append(timed_block(1))
-        v2_times.append(timed_block(2))
+        for name, v, schedule in variants:
+            times[name].append(timed_block(v, schedule))
         if time.time() > deadline:
             sys.stderr.write(
                 "bench: pipeline probe hit the window deadline; using the "
-                f"{len(v2_times)} block pair(s) measured so far.\n")
+                f"{len(times['zb_h1'])} block round(s) measured so far.\n")
             break
     smp.reset()
 
@@ -377,15 +386,20 @@ def _pipeline_interleave_probe(deadline):
         n = len(s)
         return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
 
-    v1_dt = median(v1_times)
-    v2_dt = median(v2_times)
+    med = {name: median(ts) for name, ts in times.items()}
+    best = min(med, key=med.get)
     sys.stderr.write(json.dumps({
-        "component": "pipeline_interleave",
+        "component": "pipeline_schedule",
         "pp": 2, "microbatches": 8,
-        "v1_ms": round(v1_dt * 1e3, 3),
-        "v2_ms": round(v2_dt * 1e3, 3),
-        "speedup": round(v1_dt / v2_dt, 4),
-        "blocks": len(v2_times),
+        "schedules": {name: round(dt * 1e3, 3) for name, dt in med.items()},
+        "schedule_best": best,
+        "speedup_v2": round(med["1f1b"] / med["interleaved_v2"], 4),
+        "speedup_zb": round(med["1f1b"] / med["zb_h1"], 4),
+        # Legacy fields (round <= 5 consumers of the v1-vs-v2 probe).
+        "v1_ms": round(med["1f1b"] * 1e3, 3),
+        "v2_ms": round(med["interleaved_v2"] * 1e3, 3),
+        "speedup": round(med["1f1b"] / med["interleaved_v2"], 4),
+        "blocks": len(times["zb_h1"]),
         "on_tpu": on_tpu,
     }) + "\n")
     sys.stderr.flush()
@@ -529,6 +543,17 @@ def main():
         )
         os.environ["SMP_DISABLE_FUSED_CE"] = "1"
         model, optimizer, train_step, out = build_framework(False)
+
+    # Pipeline schedule of the headline config, captured NOW (the probes
+    # below re-init and reset the framework): "none" while the headline
+    # runs unpipelined, the cfg knob once it moves to pp >= 2.
+    from smdistributed_modelparallel_tpu.backend.state import state as _state
+
+    headline_schedule = (
+        _state.cfg.pipeline
+        if _state.cfg is not None and _state.cfg.pipeline_parallel_degree > 1
+        else "none"
+    )
 
     # ---- interleaved timing (A/B/A/B) ----
     # Chip clock/thermal state drifts over tens of seconds; timing all
@@ -685,6 +710,10 @@ def main():
                   + ("" if on_tpu else " (CPU smoke, reduced model)"),
         "value": round(tok_per_sec_chip, 2),
         "unit": "tokens/sec/chip",
+        # Pipeline schedule of the headline config (pp=1 runs none); the
+        # perf ledger carries this so rounds that move the schedule knob
+        # stay attributable.
+        "schedule": headline_schedule,
         "vs_baseline": round(tok_per_sec_chip / base_tok_per_sec, 3),
         "baseline_def": "plain-JAX same-model train step, same run",
         "plain_jax_tokens_per_sec_chip": round(base_tok_per_sec, 2),

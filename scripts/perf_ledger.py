@@ -165,6 +165,7 @@ def build_ledger(repo, threshold=0.05):
             "tokens_per_sec_chip": None,
             "step_ms": None,
             "roofline": None,
+            "schedule": None,
             "documented": n in documented,
         }
         if rc == 0:
@@ -177,6 +178,13 @@ def build_ledger(repo, threshold=0.05):
                 )
                 row["status"] = "schema_error"
             else:
+                schedule = parsed.get("schedule")
+                if schedule is not None and not isinstance(schedule, str):
+                    problems.append(
+                        f"{name}: 'schedule' must be a string when "
+                        f"present, got {type(schedule).__name__}"
+                    )
+                    schedule = None
                 row.update(
                     on_chip=_is_on_chip(parsed),
                     vs_baseline=parsed["vs_baseline"],
@@ -184,6 +192,11 @@ def build_ledger(repo, threshold=0.05):
                     tokens_per_sec_chip=parsed["value"],
                     step_ms=parsed.get("step_ms"),
                     roofline=parsed.get("roofline"),
+                    # Pipeline schedule the round's headline number ran
+                    # under (bench.py >= round 6 stamps it; older rounds
+                    # predate the field and stay None): schedule-knob
+                    # moves stay attributable across the trajectory.
+                    schedule=schedule,
                 )
         elif n in notes:
             # Tunnel wedged before the driver's run, but the round DID
@@ -249,8 +262,9 @@ def render_table(ledger, out=sys.stdout):
                if r["tokens_per_sec_chip"] is not None else "-")
         sms = f"{r['step_ms']:.1f}" if r["step_ms"] is not None else "-"
         chip = {True: "tpu", False: "cpu", None: "-"}[r["on_chip"]]
+        sched = f"  [{r['schedule']}]" if r.get("schedule") else ""
         w(f"{r['round']:>5}  {r['status']:<15}{chip:<6}{vb:>8}"
-          f"{mfu:>7}{tps:>12}{sms:>9}  {r['source']}\n")
+          f"{mfu:>7}{tps:>12}{sms:>9}  {r['source']}{sched}\n")
         roof = r.get("roofline")
         if isinstance(roof, dict) and roof.get("mfu") is not None:
             parts = [f"mfu {roof['mfu']:.3f}"]

@@ -278,10 +278,15 @@ def fuse(streams):
                 elif kind == "phase":
                     name = ev.get("phase", "phase")
                 elif kind == "slot":
+                    # direction carries the pass for split-backward
+                    # schedules (fwd / bwd_input / bwd_weight); the bare
+                    # "pass" field additionally rides in args below.
                     name = (f"{ev.get('direction')}:mb"
                             f"{ev.get('microbatch')}@s{ev.get('stage')}")
                     if ev.get("chunk") is not None:
                         name += f"/c{ev['chunk']}"
+                    if ev.get("pass") is not None:
+                        name += f"/{ev['pass']}"
                 args = {k: v for k, v in ev.items()
                         if k not in ("ts_us", "id")}
                 out.append({
@@ -401,6 +406,33 @@ def desync_check(streams):
     return findings
 
 
+def schedule_slot_table(streams):
+    """({(rank, schedule, direction, pass): count}, truncated_ranks) over
+    recorder SLOT events. Split-backward (zero-bubble) schedules carry
+    the pass coordinate, so the report separates B (bwd_input, critical
+    path) from W (bwd_weight, bubble filler) ticks in the recorded
+    schedule. ``truncated_ranks``: ranks whose ring hit
+    ``record_schedule``'s event cap (tick=-1 marker) — their counts are
+    lower bounds, biased against the late-scheduled passes (the W-heavy
+    cooldown tail is what gets dropped)."""
+    counts = {}
+    truncated = set()
+    for s in streams:
+        if s.kind != "recorder":
+            continue
+        for ev in s.events:
+            if ev.get("kind") != "slot":
+                continue
+            if ev.get("tick", -1) < 0:
+                if ev.get("direction") == "truncated":
+                    truncated.add(s.rank)
+                continue
+            key = (s.rank, ev.get("schedule", "?"),
+                   ev.get("direction", "?"), ev.get("pass"))
+            counts[key] = counts.get(key, 0) + 1
+    return counts, truncated
+
+
 def render_report(streams, clock_table, out=sys.stdout):
     w = out.write
     ranks = sorted({s.rank for s in streams})
@@ -483,6 +515,25 @@ def render_report(streams, clock_table, out=sys.stdout):
                      else f"{'n/a':>10}")
                   + f"{int(pp) if pp else 0:>4}{int(mb) if mb else 0:>4}"
                   + flag + "\n")
+
+    slot_counts, slot_truncated = schedule_slot_table(streams)
+    if slot_counts:
+        w("\n-- schedule slots by pass --\n")
+        w(f"{'rank':>4}  {'schedule':<12}{'direction':<14}{'pass':<6}"
+          f"{'slots':>6}\n")
+        for (rank, sched, direction, pass_name) in sorted(
+            slot_counts, key=lambda k: (k[0], k[1], k[2], k[3] or "")
+        ):
+            mark = "  (truncated: lower bound)" if rank in slot_truncated \
+                else ""
+            w(f"{rank:>4}  {sched:<12}{direction:<14}"
+              f"{pass_name or '-':<6}"
+              f"{slot_counts[(rank, sched, direction, pass_name)]:>6}"
+              f"{mark}\n")
+        if slot_truncated:
+            w(f"!! rank(s) {sorted(slot_truncated)}: schedule recording "
+              "hit the flight-recorder cap; counts are lower bounds "
+              "(raise SMP_FLIGHT_RECORDER_SIZE / record_schedule cap)\n")
 
     findings = desync_check(streams)
     w("\n-- collective consistency --\n")

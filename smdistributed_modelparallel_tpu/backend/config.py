@@ -200,7 +200,14 @@ class ModelParallelConfig:
         if not is_non_default:
             return
         for dep, required in spec.get("requires", {}).items():
-            if values[dep] != required:
+            # A list/tuple of required values means "any of these".
+            if isinstance(required, (list, tuple)):
+                if values[dep] not in required:
+                    raise ConfigError(
+                        f"Config '{key}'={value} requires '{dep}' in "
+                        f"{list(required)}, got {values[dep]}"
+                    )
+            elif values[dep] != required:
                 raise ConfigError(
                     f"Config '{key}'={value} requires '{dep}'={required}, got {values[dep]}"
                 )

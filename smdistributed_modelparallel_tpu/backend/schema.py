@@ -44,17 +44,21 @@ SCHEMA = {
     "pipeline": {
         "type": str,
         "default": "interleaved",
-        "options": ["simple", "interleaved", "_only_forward"],
+        "options": ["simple", "interleaved", "zero_bubble", "_only_forward"],
         "description": "Pipelining schedule. 'interleaved' lowers to a 1F1B "
         "schedule in the compiled microbatch loop; 'simple' to all-forward-"
-        "then-all-backward.",
+        "then-all-backward; 'zero_bubble' to the ZB-H1 split-backward "
+        "schedule (input-grad pass on the critical path, weight-grad pass "
+        "deferred into the cooldown bubble — bound "
+        "2(pp-1)/(3*v*mb+2(pp-1)), below the interleaved floor at the same "
+        "activation memory; composes with virtual_pipeline_degree).",
     },
     "virtual_pipeline_degree": {
         "type": int,
         "default": 1,
         "lower_bound": 1,
         "alias": "virtual_pipeline_parallel_degree",
-        "requires": {"pipeline": "interleaved"},
+        "requires": {"pipeline": ["interleaved", "zero_bubble"]},
         "dependencies": ["pipeline"],
         "description": "Megatron-style interleaved virtual pipeline stages: "
         "each pipeline rank owns this many non-contiguous model chunks "

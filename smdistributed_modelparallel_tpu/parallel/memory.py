@@ -110,6 +110,72 @@ def checkpoint_sequential(fns, input, strategy="each"):
     return x
 
 
+def zero_bubble_ring_plan(fwd_k, fwd_m, bwd_k, bwd_m, wgt_k, wgt_m,
+                          num_stages, virtual, window):
+    """Ring-buffer budget of the zero-bubble (ZB-H1) executor.
+
+    The split backward extends ring-entry lifetimes: a stashed chunk
+    input and its retained output cotangent stay live from the forward
+    until the DEFERRED weight-grad pass consumes them (the fused
+    executors free them at the monolithic backward). This walks the
+    static schedule and returns the exact peak:
+
+    - ``stash_alive_peak``: max per-(stage, chunk) count of microbatches
+      forwarded but not yet weight-graded at any tick (counting a
+      same-tick F-write/W-read as overlapping — the executor's sub-step
+      order writes the forward stash before the W pass reads);
+    - ``w_queue_peak``: max per-(stage, chunk) count of deferred
+      weight-grad units (input-graded, not yet weight-graded) — the
+      "W-queue" depth the cooldown packing costs;
+    - ``ring_slots``: slots the executor allocates per (stage, chunk)
+      ring — ``max(stash_alive_peak, window + 1)``. The ``window + 1``
+      floor is the fused executors' ring size (the in-flight input
+      buffer needs it regardless of W deferral), so
+      ``extra_ring_slots == 0`` means ZB's same-activation-memory claim
+      holds exactly: the deferral fits in slack the in-flight cap
+      already paid for. At the default window it always does; tighter
+      windows may grow the ring and the executor's
+      ``smp_pipeline_ring_slots`` gauge reports what was allocated.
+    """
+    S, V = int(num_stages), int(virtual)
+    n_ticks = int(fwd_m.shape[0])
+    C = S * V
+    f_ticks = [[] for _ in range(C)]   # per global chunk, m-ordered
+    b_ticks = [[] for _ in range(C)]
+    w_ticks = [[] for _ in range(C)]
+    for t in range(n_ticks):
+        for s in range(S):
+            if fwd_m[t, s] >= 0:
+                f_ticks[int(fwd_k[t, s]) * S + s].append(t)
+            if bwd_m[t, s] >= 0:
+                b_ticks[int(bwd_k[t, s]) * S + s].append(t)
+            if wgt_m[t, s] >= 0:
+                w_ticks[int(wgt_k[t, s]) * S + s].append(t)
+    import bisect
+
+    stash_alive_peak = 0
+    w_queue_peak = 0
+    for c in range(C):
+        fts, bts, wts = f_ticks[c], b_ticks[c], w_ticks[c]
+        for m, ft in enumerate(fts):
+            # Alive at F(c, m)'s tick: m+1 forwarded minus Ws strictly
+            # before it (a same-tick W runs after the F write).
+            freed = bisect.bisect_left(wts, ft)
+            stash_alive_peak = max(stash_alive_peak, m + 1 - freed)
+        for m, bt in enumerate(bts):
+            # Deferred at B(c, m)'s tick: m+1 input-graded minus Ws
+            # strictly before it (a same-tick W drains after B).
+            drained = bisect.bisect_left(wts, bt)
+            w_queue_peak = max(w_queue_peak, m + 1 - drained)
+    ring_slots = max(stash_alive_peak, int(window) + 1, 2)
+    return {
+        "ring_slots": ring_slots,
+        "stash_alive_peak": stash_alive_peak,
+        "w_queue_peak": w_queue_peak,
+        "extra_ring_slots": ring_slots - (int(window) + 1),
+    }
+
+
 def module_checkpoint_enabled(mm, *paths):
     """Whether any of the given module paths has an activation-checkpoint
     config registered (smp.set_activation_checkpointing)."""

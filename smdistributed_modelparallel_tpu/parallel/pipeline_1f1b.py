@@ -29,6 +29,14 @@ statically in Python and baked into ONE ``lax.scan`` over ticks:
 The executor returns (mean_loss-scaled grads, stacked user outputs, stacked
 losses); the step engine (``step.py``) divides out the loss scale exactly as
 in the fill-drain path so the two schedules are numerically interchangeable.
+
+Three executors share this module: the plain v=1 path (``pipeline_1f1b``
+below, byte-stable by contract), the interleaved virtual-stage
+generalization (``_pipeline_1f1b_virtual``: (chunk, microbatch) units over
+``pp*v`` chunks), and the zero-bubble ZB-H1 executor
+(``_pipeline_zero_bubble``: (chunk, microbatch, pass) units — backward
+split into an input-grad pass and a deferred weight-grad pass that fills
+the cooldown bubble; selected by ``pipeline: "zero_bubble"``).
 """
 
 import numpy as np
@@ -96,7 +104,8 @@ def build_1f1b_schedule(num_stages, num_microbatches, window):
     return np.asarray(fwd_rows, np.int32), np.asarray(bwd_rows, np.int32)
 
 
-def schedule_occupancy(fwd, bwd, fwd_ticks=None, bwd_ticks=None):
+def schedule_occupancy(fwd, bwd, fwd_ticks=None, bwd_ticks=None, wgt=None,
+                       wgt_ticks=None):
     """(busy_slots, total_slots) of a static 1F1B schedule.
 
     Each tick has a forward and a backward sub-step per stage; a sub-slot
@@ -109,13 +118,23 @@ def schedule_occupancy(fwd, bwd, fwd_ticks=None, bwd_ticks=None):
     the ticks whose sub-step actually executes (the virtual executor's
     warmup ticks are forward-only and its cooldown ticks backward-only —
     idle sub-steps that are never compiled are not bubble).
+
+    Zero-bubble schedules split the backward into input-grad (B) and
+    weight-grad (W) passes: ``bwd`` then carries the B pass, ``wgt`` the
+    W pass (with its own ``wgt_ticks`` executed-span bound), and busy
+    counts (chunk, microbatch, pass) sub-steps — 3*S*V*M when every unit
+    ran exactly once.
     """
     busy = int((fwd >= 0).sum()) + int((bwd >= 0).sum())
     if fwd_ticks is None:
         fwd_ticks = int(fwd.shape[0])
     if bwd_ticks is None:
         bwd_ticks = int(bwd.shape[0])
-    total = int(fwd.shape[1]) * (fwd_ticks + bwd_ticks)
+    total_ticks = fwd_ticks + bwd_ticks
+    if wgt is not None:
+        busy += int((wgt >= 0).sum())
+        total_ticks += int(wgt.shape[0]) if wgt_ticks is None else wgt_ticks
+    total = int(fwd.shape[1]) * total_ticks
     return busy, total
 
 
@@ -230,6 +249,152 @@ def interleaved_phase_bounds(fwd_mb, bwd_mb):
     return t_b0, t_fe
 
 
+def build_zero_bubble_schedule(num_stages, num_microbatches, window,
+                               virtual=1):
+    """ZB-H1 zero-bubble schedule: (chunk, microbatch, pass) units.
+
+    Splits the backward into an input-gradient pass (B, on the critical
+    path: it feeds the upstream stage's cotangent) and a weight-gradient
+    pass (W, deferrable: it depends only on the stage's own B), and packs
+    the deferred Ws into ticks that the F/B schedule would otherwise
+    leave idle — cooldown first. Returns
+    ``(fwd_chunk, fwd_mb, bwd_chunk, bwd_mb, wgt_chunk, wgt_mb)``: int32
+    arrays ``[n_ticks, S]``, one (chunk, microbatch) unit per stage per
+    pass per tick (-1 = idle).
+
+    Invariants (on top of the interleaved schedule's for F and B):
+      - every (chunk, microbatch) runs each of F, B, W exactly once;
+      - B(c, m) depends on F(c, m) and the downstream B(c+1, m) exactly
+        as the interleaved schedule's monolithic backward does — the
+        (F, B) sub-schedule here IS ``build_interleaved_1f1b_schedule``'s
+        output tick-for-tick (fusing W back into B reproduces it);
+      - W(c, m) depends ONLY on B(c, m); the same tick is legal because
+        the executor orders sub-steps F -> B -> W within a tick;
+      - per stage, at most one W per tick (it is a real compute slot).
+
+    Packing policy: per stage, Ws run FIFO in B-completion order, shifted
+    so no stage starts its W run before the LAST stage has started
+    backwards (``w_lo = max_s first_B_tick(s)``). Early stages therefore
+    defer weight grads into the B-drain cooldown — the ticks where their
+    B slot idles waiting for upstream cotangents — instead of fusing them
+    into warm B ticks and idling cold ones. At (pp=2, mb >= pp, default
+    window) every stage's W run is gapless and the sub-slot bubble over
+    executed pass spans reaches
+
+        2*(pp-1) / (3*v*mb + 2*(pp-1))
+
+    strictly below the interleaved floor (pp-1)/(v*mb + pp-1) for every
+    v, mb (the F and B ramps keep their pp-1 idle sub-slots; the W pass
+    contributes zero). The deferral depth this costs is bounded — the
+    W-queue ring is accounted by ``parallel/memory.py::
+    zero_bubble_ring_plan`` and stays within the existing ``window + 1``
+    stash ring at the default window.
+    """
+    S, M, V = num_stages, num_microbatches, virtual
+    fwd_k, fwd_m, bwd_k, bwd_m = build_interleaved_1f1b_schedule(
+        S, M, window, V
+    )
+    n_fb = int(fwd_m.shape[0])
+    # Per-stage B completions in tick order (== microbatch FIFO per
+    # (stage, chunk): bwd_next only ever increments).
+    per_stage = [[] for _ in range(S)]
+    for t in range(n_fb):
+        for s in range(S):
+            if bwd_m[t, s] >= 0:
+                per_stage[s].append((t, int(bwd_k[t, s]), int(bwd_m[t, s])))
+    firsts = [rows[0][0] for rows in per_stage if rows]
+    w_lo = max(firsts) if firsts else 0
+    n_ticks = n_fb
+    assign = [[] for _ in range(S)]
+    for s in range(S):
+        prev = -1
+        for i, (bt, k, m) in enumerate(per_stage[s]):
+            wt = max(w_lo + i, bt, prev + 1)
+            prev = wt
+            assign[s].append((wt, k, m))
+            n_ticks = max(n_ticks, wt + 1)
+
+    def pad(a):
+        if n_ticks == a.shape[0]:
+            return a
+        tail = np.full((n_ticks - a.shape[0], S), -1, np.int32)
+        return np.concatenate([a, tail])
+
+    fwd_k, fwd_m, bwd_k, bwd_m = (pad(a) for a in (fwd_k, fwd_m,
+                                                   bwd_k, bwd_m))
+    wgt_k = np.full((n_ticks, S), -1, np.int32)
+    wgt_m = np.full((n_ticks, S), -1, np.int32)
+    for s in range(S):
+        for wt, k, m in assign[s]:
+            wgt_k[wt, s] = k
+            wgt_m[wt, s] = m
+    return fwd_k, fwd_m, bwd_k, bwd_m, wgt_k, wgt_m
+
+
+def zero_bubble_phase_bounds(fwd_mb, bwd_mb, wgt_mb):
+    """Executed-tick span ``(lo, hi)`` per pass: F, B, W.
+
+    Generalizes ``interleaved_phase_bounds`` to three passes: ticks
+    outside a pass's span never compile that pass's sub-step (the ZB
+    executor scans per contiguous segment of active passes), so only
+    in-span idle sub-slots are bubble. ``(0, 0)`` marks a pass with no
+    work (degenerate schedules).
+    """
+
+    def span(arr):
+        busy = (arr >= 0).any(axis=1)
+        if not busy.any():
+            return (0, 0)
+        lo = int(np.argmax(busy))
+        hi = int(arr.shape[0] - np.argmax(busy[::-1]))
+        return (lo, hi)
+
+    return span(fwd_mb), span(bwd_mb), span(wgt_mb)
+
+
+def zero_bubble_theoretical_bubble(num_stages, num_microbatches, virtual=1):
+    """ZB-H1 sub-slot bubble bound: 2*(pp-1)/(3*v*mb + 2*(pp-1)).
+
+    Denominator: 3 passes of v*mb busy sub-slots per stage plus the F and
+    B ramps' pp-1 extra span ticks each; numerator: those two ramps' idle
+    sub-slots (the W pass packs gapless). Strictly below the interleaved
+    bound (pp-1)/(v*mb + pp-1) whenever v*mb > 0.
+    """
+    S, M, V = num_stages, num_microbatches, virtual
+    denom = 3 * V * M + 2 * (S - 1)
+    return 2 * (S - 1) / denom if denom > 0 else 0.0
+
+
+def _zb_segments(f_span, b_span, w_span, n_ticks):
+    """Contiguous tick segments [a, b) with static per-pass flags
+    (do_fwd, do_bwd, do_wgt) — the ZB executor compiles one scan per
+    segment, so out-of-span sub-steps never enter the program (same
+    trick as the interleaved warmup/steady/cooldown split, generalized
+    to three passes)."""
+    cuts = sorted({0, n_ticks, *f_span, *b_span, *w_span})
+    segs = []
+    for a, b in zip(cuts, cuts[1:]):
+        if a >= b:
+            continue
+        flags = (f_span[0] <= a < f_span[1],
+                 b_span[0] <= a < b_span[1],
+                 w_span[0] <= a < w_span[1])
+        if any(flags):
+            segs.append((a, b, flags))
+    return segs
+
+
+def _zb_segment_region(do_fwd, do_bwd, do_wgt):
+    """Profiler region name for a ZB schedule segment."""
+    if do_fwd and not do_bwd:
+        return "smp/pipeline/warmup"
+    if do_fwd:
+        return "smp/pipeline/steady"
+    if do_bwd:
+        return "smp/pipeline/cooldown"
+    return "smp/pipeline/cooldown_weight" if do_wgt else "smp/pipeline/idle"
+
+
 def _tree_zeros(avals_or_tree, like=None):
     src = avals_or_tree if like is None else like
     return jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), src)
@@ -240,6 +405,104 @@ def _inexact_leaves(tree):
     idx = [i for i, l in enumerate(leaves)
            if jnp.issubdtype(jnp.result_type(l), jnp.inexact)]
     return leaves, treedef, idx
+
+
+# ---- shared ring/scatter primitives of the chunk-generalized executors
+# (_pipeline_1f1b_virtual and _pipeline_zero_bubble; the plain v=1
+# executor keeps its own 2-level ring helpers so its traced program —
+# byte-identity contract — is built from untouched code). All are pure
+# in their arguments: ring geometry ([S, V, R, ...]) rides in the
+# buffers themselves.
+
+
+def _chunk_ring_set(buf, row_chunks, row_slots, row_vals, row_active):
+    """buf[s, row_chunks[s], row_slots[s]] = row_vals[s] where active."""
+
+    def upd(b, v):
+        def one(bs, k, slot, vs, act):   # bs: [V, R, ...]
+            sub = jax.lax.dynamic_index_in_dim(bs, k, 0, keepdims=False)
+            new = jax.lax.dynamic_update_index_in_dim(
+                sub, vs.astype(bs.dtype), slot, 0
+            )
+            new = jnp.where(act, new, sub)
+            return jax.lax.dynamic_update_index_in_dim(bs, new, k, 0)
+
+        return jax.vmap(one)(b, row_chunks, row_slots, v, row_active)
+
+    return jax.tree_util.tree_map(upd, buf, row_vals)
+
+
+def _chunk_ring_get(buf, row_chunks, row_slots):
+    def one(bs, k, slot):
+        sub = jax.lax.dynamic_index_in_dim(bs, k, 0, keepdims=False)
+        return jax.lax.dynamic_index_in_dim(sub, slot, 0, keepdims=False)
+
+    return jax.tree_util.tree_map(
+        lambda b: jax.vmap(one)(b, row_chunks, row_slots), buf
+    )
+
+
+def _chunk_outbuf_set(buf, row_slots, row_vals, row_active):
+    def upd(b, v):
+        def one(bs, slot, vs, act):
+            new = jax.lax.dynamic_update_index_in_dim(
+                bs, vs.astype(bs.dtype), slot, 0
+            )
+            return jnp.where(act, new, bs)
+
+        return jax.vmap(one)(b, row_slots, v, row_active)
+
+    return jax.tree_util.tree_map(upd, buf, row_vals)
+
+
+def _chunk_scatter_add_mb(buf, m, val, active):
+    def upd(b, v):
+        cur = jax.lax.dynamic_index_in_dim(b, m, 0, keepdims=False)
+        new = cur + jnp.where(active, v.astype(b.dtype), jnp.zeros_like(cur))
+        return jax.lax.dynamic_update_index_in_dim(b, new, m, 0)
+
+    return jax.tree_util.tree_map(upd, buf, val)
+
+
+def _chunk_scatter_set_mb(buf, m, val, active):
+    def upd(b, v):
+        cur = jax.lax.dynamic_index_in_dim(b, m, 0, keepdims=False)
+        new = jnp.where(active, v.astype(b.dtype), cur)
+        return jax.lax.dynamic_update_index_in_dim(b, new, m, 0)
+
+    return jax.tree_util.tree_map(upd, buf, val)
+
+
+def _chunk_scatter_add_leaf(buf, m, val, active):
+    cur = jax.lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
+    new = cur + jnp.where(active, val.astype(buf.dtype), jnp.zeros_like(cur))
+    return jax.lax.dynamic_update_index_in_dim(buf, new, m, 0)
+
+
+def _chunk_scatter_stat(acc, krow, vals, act, op):
+    """acc[s, krow[s]] = op(acc[s, krow[s]], vals[s]) where act[s];
+    acc is [S, V] (per-stage per-chunk health stats)."""
+
+    def one(av, k, vv, m):
+        cur = jax.lax.dynamic_index_in_dim(av, k, 0, keepdims=False)
+        new = jnp.where(m, op(cur, vv), cur)
+        return jax.lax.dynamic_update_index_in_dim(av, new, k, 0)
+
+    return jax.vmap(one)(acc, krow, vals, act)
+
+
+def _chunk_acc_rows(acc, rows, krow, act):
+    """Accumulate [S, ...] grad rows into the per-(stage, chunk) slot."""
+
+    def upd(a, r):
+        def one(av, k, rv, m):
+            cur = jax.lax.dynamic_index_in_dim(av, k, 0, keepdims=False)
+            new = cur + jnp.where(m, rv.astype(av.dtype), 0)
+            return jax.lax.dynamic_update_index_in_dim(av, new, k, 0)
+
+        return jax.vmap(one)(a, krow, r, act)
+
+    return jax.tree_util.tree_map(upd, acc, rows)
 
 
 def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
@@ -264,6 +527,13 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     spec = model._pipeline_spec
     cfg = state.cfg
     virtual = int(getattr(cfg, "virtual_pipeline_degree", 1) or 1)
+    if getattr(cfg, "pipeline", "interleaved") == "zero_bubble":
+        # ZB-H1: backward split into input-grad/weight-grad passes; the
+        # executor is chunk-generalized for any v >= 1.
+        return _pipeline_zero_bubble(
+            model, params, stacked_inputs, rng, mb_loss_fn, loss_seed_scale,
+            virtual,
+        )
     if virtual > 1:
         # Interleaved virtual stages take the generalized executor; the
         # default path below stays byte-for-byte the v=1 program.
@@ -1128,74 +1398,15 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
         * jnp.asarray(loss_seed_scale, jnp.float32)
     )
 
-    def set_ring(buf, row_chunks, row_slots, row_vals, row_active):
-        """buf[s, row_chunks[s], row_slots[s]] = row_vals[s] where active."""
-
-        def upd(b, v):
-            def one(bs, k, slot, vs, act):   # bs: [V, W1, ...]
-                sub = jax.lax.dynamic_index_in_dim(bs, k, 0, keepdims=False)
-                new = jax.lax.dynamic_update_index_in_dim(
-                    sub, vs.astype(bs.dtype), slot, 0
-                )
-                new = jnp.where(act, new, sub)
-                return jax.lax.dynamic_update_index_in_dim(bs, new, k, 0)
-
-            return jax.vmap(one)(b, row_chunks, row_slots, v, row_active)
-
-        return jax.tree_util.tree_map(upd, buf, row_vals)
-
-    def get_ring(buf, row_chunks, row_slots):
-        def one(bs, k, slot):
-            sub = jax.lax.dynamic_index_in_dim(bs, k, 0, keepdims=False)
-            return jax.lax.dynamic_index_in_dim(sub, slot, 0, keepdims=False)
-
-        return jax.tree_util.tree_map(
-            lambda b: jax.vmap(one)(b, row_chunks, row_slots), buf
-        )
-
-    def set_outbuf(buf, row_slots, row_vals, row_active):
-        def upd(b, v):
-            def one(bs, slot, vs, act):
-                new = jax.lax.dynamic_update_index_in_dim(
-                    bs, vs.astype(bs.dtype), slot, 0
-                )
-                return jnp.where(act, new, bs)
-
-            return jax.vmap(one)(b, row_slots, v, row_active)
-
-        return jax.tree_util.tree_map(upd, buf, row_vals)
-
-    def scatter_add_mb(buf, m, val, active):
-        def upd(b, v):
-            cur = jax.lax.dynamic_index_in_dim(b, m, 0, keepdims=False)
-            new = cur + jnp.where(active, v.astype(b.dtype), jnp.zeros_like(cur))
-            return jax.lax.dynamic_update_index_in_dim(b, new, m, 0)
-
-        return jax.tree_util.tree_map(upd, buf, val)
-
-    def scatter_set_mb(buf, m, val, active):
-        def upd(b, v):
-            cur = jax.lax.dynamic_index_in_dim(b, m, 0, keepdims=False)
-            new = jnp.where(active, v.astype(b.dtype), cur)
-            return jax.lax.dynamic_update_index_in_dim(b, new, m, 0)
-
-        return jax.tree_util.tree_map(upd, buf, val)
-
-    def _scatter_add_leaf(buf, m, val, active):
-        cur = jax.lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
-        new = cur + jnp.where(active, val.astype(buf.dtype), jnp.zeros_like(cur))
-        return jax.lax.dynamic_update_index_in_dim(buf, new, m, 0)
-
-    def scatter_chunk_stat(acc, krow, vals, act, op):
-        """acc[s, krow[s]] = op(acc[s, krow[s]], vals[s]) where act[s];
-        acc is [S, V] (per-stage per-chunk health stats)."""
-
-        def one(av, k, vv, m):
-            cur = jax.lax.dynamic_index_in_dim(av, k, 0, keepdims=False)
-            new = jnp.where(m, op(cur, vv), cur)
-            return jax.lax.dynamic_update_index_in_dim(av, new, k, 0)
-
-        return jax.vmap(one)(acc, krow, vals, act)
+    # Ring/scatter primitives shared with the zero-bubble executor
+    # (module level — see _chunk_ring_set and friends above).
+    set_ring = _chunk_ring_set
+    get_ring = _chunk_ring_get
+    set_outbuf = _chunk_outbuf_set
+    scatter_add_mb = _chunk_scatter_add_mb
+    scatter_set_mb = _chunk_scatter_set_mb
+    _scatter_add_leaf = _chunk_scatter_add_leaf
+    scatter_chunk_stat = _chunk_scatter_stat
 
     hc = health.active()
 
@@ -1387,18 +1598,7 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
             d_x_rows = pin_stage_axis(d_x_rows)
 
             # Accumulate layer grads into the per-(stage, chunk) slot.
-            def acc_chunk_rows(acc, rows):
-                def upd(a, r):
-                    def one(av, k, rv, m):
-                        cur = jax.lax.dynamic_index_in_dim(av, k, 0, keepdims=False)
-                        new = cur + jnp.where(m, rv.astype(av.dtype), 0)
-                        return jax.lax.dynamic_update_index_in_dim(av, new, k, 0)
-
-                    return jax.vmap(one)(a, bkc, r, b_active)
-
-                return jax.tree_util.tree_map(upd, acc, rows)
-
-            dlay = acc_chunk_rows(dlay, d_lp_rows)
+            dlay = _chunk_acc_rows(dlay, d_lp_rows, bkc, b_active)
 
             drep = jax.tree_util.tree_map(
                 lambda a, g: a + jnp.where(is_lastk, g.astype(a.dtype), 0),
@@ -1521,6 +1721,732 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
     # [S, V, maxp, ...] accumulated chunk grads -> [L, ...]. The chunked
     # placement interleaves the layer axis across stages, so this is
     # always a scatter-add (the v=1 dense-reshape shortcut cannot apply).
+    flat_idx = jnp.asarray(idx_np.reshape(-1))
+    flat_mask = active_np.reshape(-1)
+
+    def to_layers(g):
+        gf = g.reshape((S * V * maxp,) + g.shape[3:])
+        gf = gf * flat_mask.reshape((-1,) + (1,) * (gf.ndim - 1))
+        return jnp.zeros((L,) + g.shape[3:], g.dtype).at[flat_idx].add(gf)
+
+    layer_grads = jax.tree_util.tree_map(to_layers, dlay)
+    if demb_params is not None:
+        drep = jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(a.dtype), drep, demb_params
+        )
+    grads = _set_subtree(drep, spec.layer_path, layer_grads)
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(jnp.result_type(p)), grads, params
+    )
+    return grads, losses, outs
+
+
+def _pipeline_zero_bubble(model, params, stacked_inputs, rng, mb_loss_fn,
+                          loss_seed_scale, virtual):
+    """ZB-H1 executor: backward split into B (input-grad) and W
+    (weight-grad) passes over (chunk, microbatch, pass) schedule units.
+
+    Same numerical contract as the 1F1B executors (grads/losses/outputs
+    interchangeable with the fill-drain path at any (pp, v, mb, window));
+    the schedule shape differs from ``_pipeline_1f1b_virtual`` in one
+    way: each tick has up to THREE sub-steps — F, B, W — and the
+    monolithic per-chunk VJP is split:
+
+    - the B sub-step re-runs the chunk forward from the stashed input
+      under ``jax.vjp`` w.r.t. (input, sides) ONLY: the input cotangent
+      ships upstream immediately (it is the critical path) and the
+      weight cotangent is never formed;
+    - the W sub-step re-runs the same forward under ``jax.vjp`` w.r.t.
+      the chunk params at a LATER tick, re-reading the stashed input and
+      the retained output cotangent — the deferred weight-grad work that
+      fills the B-drain cooldown, where the monolithic schedule idles;
+    - the ring buffers double as the W-queue: stash/cotangent entries
+      stay live until the W pass consumes them, so the ring slot count
+      comes from ``parallel/memory.py::zero_bubble_ring_plan`` (exact
+      alive-depth over the static schedule; == window+1 at the default
+      window, i.e. ZB's same-activation-memory claim holds exactly);
+    - the head/loss VJP stays monolithic at the last chunk's B tick (it
+      produces the cotangent B needs; its param grads are replicated
+      work, not a pipeline stage) and its output cotangent is written
+      INTO the cotangent ring so the last chunk's W can re-read it.
+
+    The tick loop compiles one scan per contiguous segment of active
+    passes (``_zb_segments``): warmup ticks are F-only, the B-drain
+    cooldown compiles B+W, and a possible W-only tail drains the queue —
+    out-of-span sub-steps never enter the program, which is what the
+    occupancy accounting (2*(pp-1)/(3*v*mb + 2*(pp-1)) at the packed
+    configs) assumes. GSPMD stage-axis pins and the double-buffered
+    transfer registers carry over from the virtual executor unchanged
+    (W produces no transfers: weight grads stay stage-local).
+    """
+    spec = model._pipeline_spec
+    cfg = state.cfg
+    S = cfg.pipeline_parallel_degree
+    M = cfg.microbatches
+    L = spec.num_layers
+    V = virtual
+    W = min(cfg.active_microbatches or (S + 1), M)
+    from smdistributed_modelparallel_tpu.nn.auto_distribute import unwrap_hooks
+
+    module = unwrap_hooks(model.module)
+    layer_module = spec.layer_module
+    half = cfg.half_dtype
+
+    (fwd_k_np, fwd_m_np, bwd_k_np, bwd_m_np, wgt_k_np,
+     wgt_m_np) = build_zero_bubble_schedule(S, M, W, V)
+    n_ticks = fwd_m_np.shape[0]
+    f_span, b_span, w_span = zero_bubble_phase_bounds(
+        fwd_m_np, bwd_m_np, wgt_m_np
+    )
+    segments = _zb_segments(f_span, b_span, w_span, n_ticks)
+
+    from smdistributed_modelparallel_tpu.parallel.memory import (
+        zero_bubble_ring_plan,
+    )
+
+    plan = zero_bubble_ring_plan(
+        fwd_k_np, fwd_m_np, bwd_k_np, bwd_m_np, wgt_k_np, wgt_m_np,
+        num_stages=S, virtual=V, window=W,
+    )
+    R1 = plan["ring_slots"]
+
+    from smdistributed_modelparallel_tpu.utils import health
+    from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+        flight_recorder,
+    )
+    from smdistributed_modelparallel_tpu.utils.telemetry import (
+        record_pipeline_occupancy,
+        telemetry,
+    )
+
+    f_len = f_span[1] - f_span[0]
+    b_len = b_span[1] - b_span[0]
+    w_len = w_span[1] - w_span[0]
+    busy, total = schedule_occupancy(
+        fwd_m_np, bwd_m_np, fwd_ticks=f_len, bwd_ticks=b_len,
+        wgt=wgt_m_np, wgt_ticks=w_len,
+    )
+    record_pipeline_occupancy(
+        "zb", S, M, busy_slots=busy, total_slots=total, virtual=V,
+        passes=3,
+        pass_ticks={"fwd": f_len, "bwd_input": b_len, "bwd_weight": w_len},
+    )
+    # W-queue accounting next to the occupancy gauges: ring slots actually
+    # allocated per (stage, chunk) and the peak number of deferred
+    # weight-grad units — the memory side of the bubble trade.
+    _ring_gauge = telemetry.gauge(
+        "smp_pipeline_ring_slots",
+        "per-(stage, chunk) ring-buffer slots of the pipeline executor",
+    )
+    _ring_gauge.labels(schedule="zb").set(R1)
+    telemetry.gauge(
+        "smp_pipeline_wqueue_peak",
+        "peak deferred weight-grad units per (stage, chunk) [zero-bubble]",
+    ).labels(schedule="zb").set(plan["w_queue_peak"])
+    flight_recorder.record_schedule(
+        "zb",
+        ((t, s, d, int(m_arr[t, s]), int(k_arr[t, s]) * S + s, p)
+         for t in range(n_ticks) for s in range(S)
+         for d, p, k_arr, m_arr in (
+             ("fwd", "F", fwd_k_np, fwd_m_np),
+             ("bwd_input", "B", bwd_k_np, bwd_m_np),
+             ("bwd_weight", "W", wgt_k_np, wgt_m_np))
+         if m_arr[t, s] >= 0),
+    )
+    fwd_k_sched = jnp.asarray(fwd_k_np)
+    fwd_m_sched = jnp.asarray(fwd_m_np)
+    bwd_k_sched = jnp.asarray(bwd_k_np)
+    bwd_m_sched = jnp.asarray(bwd_m_np)
+    wgt_k_sched = jnp.asarray(wgt_k_np)
+    wgt_m_sched = jnp.asarray(wgt_m_np)
+
+    from smdistributed_modelparallel_tpu.parallel.pipeline import (
+        _get_subtree,
+        _mk_rngs,
+        _scan_map,
+        chunk_layout,
+        staged_chunk_views,
+    )
+
+    def cast_half(tree):
+        from smdistributed_modelparallel_tpu.nn.utils import half_cast
+
+        return half_cast(tree, half)
+
+    layer_params = _get_subtree(params, spec.layer_path)
+    staged_params, staged_xs, active_rows = staged_chunk_views(
+        spec, layer_params, S, V
+    )
+
+    # Stage-axis sharding pins: same rationale as the virtual executor
+    # (the chunked gather breaks GSPMD's propagation; pin ONLY dim 0).
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    from smdistributed_modelparallel_tpu.backend.topology import PP_AXIS
+
+    mesh = state.mesh
+    _pp_size = dict(mesh.shape).get(PP_AXIS, 1) if mesh is not None else 1
+
+    def pin_stage_axis(tree):
+        if mesh is None or _pp_size <= 1:
+            return tree
+
+        def pin(x):
+            if getattr(x, "ndim", 0) < 1 or x.shape[0] != S:
+                return x
+            rest = [_P.UNCONSTRAINED] * (x.ndim - 1)
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, _P(PP_AXIS, *rest))
+            )
+
+        return jax.tree_util.tree_map(pin, tree)
+
+    staged_params = pin_stage_axis(staged_params)
+    staged_xs = pin_stage_axis(staged_xs)
+    params_rest = _set_subtree(params, spec.layer_path, {})
+
+    def with_layers(p_rest):
+        return _set_subtree(p_rest, spec.layer_path, layer_params)
+
+    idx_np, active_np, maxp = chunk_layout(spec, S, V)
+
+    mb_keys = jax.random.split(rng, M)
+
+    # ---- embed all microbatches (the input queue) --------------------
+
+    def embed_mb(mb_input, key):
+        args, kwargs = mb_input
+        if spec.embed_method is None:
+            return args[0]
+        return module.apply(
+            {"params": cast_half(params)}, *args,
+            rngs=_mk_rngs(model, key, "embed"),
+            method=spec.embed_method, **kwargs,
+        )
+
+    with named_region("smp/pipeline/embed"):
+        embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
+
+    if spec.carry_is_tuple:
+        hidden_q = embedded[0]
+        sides = embedded[1:]
+    else:
+        hidden_q = embedded
+        sides = None
+
+    carry_aval = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), hidden_q
+    )
+
+    # ---- per-chunk forward (pure in chunk params and carry) ----------
+
+    from smdistributed_modelparallel_tpu.parallel.memory import remat_policy
+    from smdistributed_modelparallel_tpu.parallel.pipeline import (
+        apply_collecting_aux,
+        make_layer_apply,
+    )
+
+    apply_one_layer = make_layer_apply(
+        model, spec, layer_module, side_in_carry=False
+    )
+
+    if spec.carry_remat:
+        apply_one_layer = jax.checkpoint(apply_one_layer, policy=remat_policy())
+
+    def chunk_fwd(chunk_lp, chunk_lxs, x, side, c_idx, m_idx, act_row):
+        """Apply one chunk's layer slots; keys derived from (global chunk,
+        mb), so the B and W recomputes reproduce the forward (dropout
+        included) exactly. Returns (carry, summed MoE aux)."""
+        base = jax.random.fold_in(jax.random.fold_in(rng, c_idx), m_idx)
+        chunk_lp = cast_half(chunk_lp)
+
+        def body(c, xs):
+            lp, lxs, i, act = xs
+            new_c, aux = apply_one_layer(
+                lp, c, lxs, jax.random.fold_in(base, i), side
+            )
+            out_c = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(act, n, o), new_c, c
+            )
+            return out_c, jnp.where(act, aux, 0.0)
+
+        idx = jnp.arange(maxp)
+        out, auxs = jax.lax.scan(body, x, (chunk_lp, chunk_lxs, idx, act_row))
+        return out, jnp.sum(auxs)
+
+    def gather_mb(tree, m):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+            tree,
+        )
+
+    def gather_sides_rows(ms):
+        if sides is None:
+            return None
+        return tuple(
+            jax.tree_util.tree_map(
+                lambda a: jax.vmap(
+                    lambda i: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+                )(ms),
+                s,
+            )
+            for s in sides
+        )
+
+    def select_chunk(tree, krow):
+        """Per-stage view of one chunk: [S, V, ...] -> [S, ...] at krow[s]."""
+        return jax.tree_util.tree_map(
+            lambda a: jax.vmap(
+                lambda av, k: jax.lax.dynamic_index_in_dim(av, k, 0, keepdims=False)
+            )(a, krow),
+            tree,
+        )
+
+    # ---- head + user loss (last stage, last chunk only) ---------------
+
+    def head_apply_aux(p, carry, key):
+        if spec.head_method is None:
+            return carry, jnp.zeros((), jnp.float32)
+        return apply_collecting_aux(
+            module, {"params": cast_half(p)}, carry,
+            rngs=_mk_rngs(model, key, "head"), method=spec.head_method,
+        )
+
+    def head_apply(p, carry, key):
+        return head_apply_aux(p, carry, key)[0]
+
+    loss_out_aval = jax.eval_shape(
+        lambda c: mb_loss_fn(head_apply(params, c, mb_keys[0]), 0, mb_keys[0]),
+        jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), carry_aval),
+    )
+
+    # ---- buffers ------------------------------------------------------
+
+    def zeros_chunk_ring(n):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros((S, V, n) + a.shape, a.dtype), carry_aval
+        )
+
+    def zeros_stage_rows():
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros((S,) + a.shape, a.dtype), carry_aval
+        )
+
+    grad_dtype = jnp.float32
+
+    def _acc_dtype(dtype):
+        if jnp.issubdtype(dtype, jnp.floating) and cfg._fp32_grad_accumulation:
+            return jnp.float32
+        return dtype
+
+    def param_grad_zeros(tree):
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, _acc_dtype(p.dtype)), tree
+        )
+
+    # Ring slot count R1 comes from the memory plan: stash and cotangent
+    # entries live until the W pass (not just B), so the alive depth can
+    # exceed the 1F1B executors' window+1 — but never does at the default
+    # window (the deferral hides inside the slack the in-flight cap
+    # already paid for).
+    inbuf0 = zeros_chunk_ring(R1)    # inbuf[s, k, m % R1]: fwd input of (k, m)
+    stash0 = zeros_chunk_ring(R1)    # consumed fwd inputs (B AND W recompute)
+    cotbuf0 = zeros_chunk_ring(R1)   # output cotangent of (k, m); W re-reads
+    outbuf0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((S, R1) + a.shape, a.dtype), carry_aval
+    )                                # last chunk's fwd output (row S-1 only)
+    xfer_f0 = zeros_stage_rows()     # tick t's raw fwd outputs, rolled at t+1
+    xfer_b0 = zeros_stage_rows()     # tick t's raw input cotangents, ditto
+    dlay0 = param_grad_zeros(staged_params)
+    drep0 = param_grad_zeros(params_rest)
+    dembed0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((M,) + a.shape, grad_dtype), carry_aval
+    )
+    side_leaves = side_treedef = side_idx = None
+    dsides0 = None
+    if sides is not None:
+        side_leaves, side_treedef, side_idx = _inexact_leaves(
+            tuple(jax.tree_util.tree_map(lambda a: a[0], s) for s in sides)
+        )
+        dsides0 = [
+            jnp.zeros((M,) + side_leaves[i].shape, grad_dtype) for i in side_idx
+        ]
+    losses0 = jnp.zeros((M,), jnp.float32)
+    outs0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((M,) + a.shape, a.dtype), loss_out_aval[1]
+    )
+
+    stage_ids = jnp.arange(S)
+    aux_w = float(getattr(cfg, "moe_aux_loss_weight", 1.0))
+    aux_seed = (
+        jnp.asarray(aux_w, jnp.float32)
+        * jnp.asarray(loss_seed_scale, jnp.float32)
+    )
+
+    # Ring/scatter primitives are the module-level _chunk_* helpers,
+    # shared with the virtual executor.
+    hc = health.active()
+
+    def tick_impl(carry, t, do_fwd, do_bwd, do_wgt):
+        """One schedule tick. The pass flags are STATIC per segment:
+        out-of-span sub-steps are never compiled. Sub-step order within a
+        tick is F -> B -> W, which is what legalizes same-tick B(c,m)
+        after F(c,m) (last chunk) and W(c,m) after B(c,m)."""
+        if hc is not None:
+            (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, dlay, drep,
+             dembed, dsides, losses, outs, hstats) = carry
+            ((hbad, habs, hmb), (hbad_b, habs_b, hmb_b)) = hstats
+        else:
+            (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, dlay, drep,
+             dembed, dsides, losses, outs) = carry
+
+        # ---------------- deferred stage transfers ----------------
+        # Tick t-1's fwd outputs / input cotangents cross the pp axis
+        # here (jnp.roll -> collective-permute), exactly as in the
+        # virtual executor. Gating on the CURRENT segment's flags is
+        # legal for the same reason as there: the last F tick can only
+        # contain last-chunk forwards (routed to outbuf) and the last B
+        # tick only chunk-0 backwards (routed to the embedding), so the
+        # first tick outside a span has nothing to merge. W produces no
+        # transfers at all — weight grads stay stage-local.
+        prev = jnp.maximum(t - 1, 0)
+        was_prev = t > 0
+        if do_fwd:
+            pk = fwd_k_sched[prev]
+            pm = fwd_m_sched[prev]
+            p_act = (pm >= 0) & was_prev
+            dst_k = jnp.roll(pk, 1) + (stage_ids == 0)
+            dst_m = jnp.roll(jnp.maximum(pm, 0), 1)
+            dst_act = jnp.roll(p_act, 1) & (dst_k < V)
+            inbuf = _chunk_ring_set(
+                inbuf, jnp.clip(dst_k, 0, V - 1), dst_m % R1,
+                jax.tree_util.tree_map(lambda o: jnp.roll(o, 1, axis=0), xfer_f),
+                dst_act,
+            )
+        if do_bwd:
+            pbk = bwd_k_sched[prev]
+            pbm = bwd_m_sched[prev]
+            pb_act = (pbm >= 0) & was_prev
+            dst_bk = jnp.roll(pbk, -1) - (stage_ids == S - 1)
+            dst_bm = jnp.roll(jnp.maximum(pbm, 0), -1)
+            dst_b_act = jnp.roll(pb_act, -1) & (dst_bk >= 0)
+            cotbuf = _chunk_ring_set(
+                cotbuf, jnp.clip(dst_bk, 0, V - 1), dst_bm % R1,
+                jax.tree_util.tree_map(lambda o: jnp.roll(o, -1, axis=0), xfer_b),
+                dst_b_act,
+            )
+
+        # ---------------- forward sub-step ----------------
+        if do_fwd:
+            fk = fwd_k_sched[t]
+            fm = fwd_m_sched[t]
+            f_active = fm >= 0
+            fkc = jnp.clip(fk, 0, V - 1)
+            fmc = jnp.maximum(fm, 0)
+            f_slots = fmc % R1
+            ch_params = select_chunk(staged_params, fkc)
+            ch_xs = select_chunk(staged_xs, fkc)
+            ch_act = select_chunk(active_rows, fkc)
+            from_q = gather_mb(hidden_q, fmc[0])
+            buf_in = _chunk_ring_get(inbuf, fkc, f_slots)
+            x_in = jax.tree_util.tree_map(
+                lambda q, b: b.at[0].set(jnp.where(fkc[0] == 0, q, b[0])),
+                from_q, buf_in,
+            )
+            f_sides = gather_sides_rows(fmc)
+            c_ids = fkc * S + stage_ids
+            with named_region("smp/pipeline/tick_fwd"):
+                outs_f, _aux_f = jax.vmap(
+                    chunk_fwd,
+                    in_axes=(0, 0, 0, 0 if sides is not None else None,
+                             0, 0, 0),
+                )(ch_params, ch_xs, x_in, f_sides, c_ids, fmc, ch_act)
+            outs_f = pin_stage_axis(outs_f)
+            stash = _chunk_ring_set(stash, fkc, f_slots, x_in, f_active)
+            if hc is not None:
+                brow, arow = health.stage_row_stats(outs_f, S)
+                brow = jnp.where(f_active, brow, 0.0)
+                arow = jnp.where(f_active, arow, 0.0)
+                hmb = _chunk_scatter_stat(
+                    hmb, fkc, fmc.astype(jnp.float32),
+                    f_active & (brow > 0),
+                    lambda cur, mb: jnp.where(cur < 0, mb, cur),
+                )
+                hbad = _chunk_scatter_stat(
+                    hbad, fkc, brow, f_active, lambda cur, v: cur + v
+                )
+                habs = _chunk_scatter_stat(
+                    habs, fkc, arow, f_active, jnp.maximum
+                )
+            last_row_active = f_active & (stage_ids == S - 1) & (fkc == V - 1)
+            outbuf = _chunk_outbuf_set(outbuf, f_slots, outs_f, last_row_active)
+            xfer_f = outs_f
+
+        # ---------------- backward-input sub-step ----------------
+        if do_bwd:
+            bk = bwd_k_sched[t]
+            bm = bwd_m_sched[t]
+            b_active = bm >= 0
+            bkc = jnp.clip(bk, 0, V - 1)
+            bmc = jnp.maximum(bm, 0)
+            b_slots = bmc % R1
+
+            is_lastk = b_active[S - 1] & (bkc[S - 1] == V - 1)
+            m_last = bmc[S - 1]
+            key_last = jax.lax.dynamic_index_in_dim(
+                mb_keys, m_last, 0, keepdims=False
+            )
+            out_last = jax.tree_util.tree_map(
+                lambda ob: jax.lax.dynamic_index_in_dim(
+                    ob[S - 1], b_slots[S - 1], 0, keepdims=False
+                ),
+                outbuf,
+            )
+
+            def head_loss(p_rest, out):
+                final, h_aux = head_apply_aux(with_layers(p_rest), out, key_last)
+                loss, user_out = mb_loss_fn(final, m_last, key_last)
+                loss = loss + jnp.asarray(aux_w, loss.dtype) * h_aux.astype(
+                    loss.dtype
+                )
+                return loss, user_out
+
+            def run_head():
+                loss_m, head_vjp, user_out = jax.vjp(
+                    head_loss, params_rest, out_last, has_aux=True
+                )
+                seed = jnp.asarray(loss_seed_scale, loss_m.dtype)
+                d_rep, d_out_last = head_vjp(seed)
+                return loss_m.astype(jnp.float32), d_rep, d_out_last, user_out
+
+            head_aval = jax.eval_shape(run_head)
+            with named_region("smp/pipeline/head"):
+                loss_m, d_rep, d_out_last, user_out = jax.lax.cond(
+                    is_lastk,
+                    run_head,
+                    lambda: jax.tree_util.tree_map(
+                        lambda a: jnp.zeros(a.shape, a.dtype), head_aval
+                    ),
+                )
+
+            cot_in = _chunk_ring_get(cotbuf, bkc, b_slots)
+            cot_in = jax.tree_util.tree_map(
+                lambda c, d: c.at[S - 1].set(
+                    jnp.where(is_lastk, d.astype(c.dtype), c[S - 1])
+                ),
+                cot_in, d_out_last,
+            )
+            # Retain the head cotangent in the ring: unlike the fused
+            # executors, the last chunk's backward touches its cotangent
+            # TWICE (B now, W later) and only B gets it from the head
+            # VJP. Masked to the producing row so other stages' ring
+            # entries are untouched.
+            cotbuf = _chunk_ring_set(
+                cotbuf, bkc, b_slots, cot_in,
+                b_active & (stage_ids == S - 1) & (bkc == V - 1),
+            )
+            b_sides = gather_sides_rows(bmc)
+            stash_in = _chunk_ring_get(stash, bkc, b_slots)
+            ch_params_b = select_chunk(staged_params, bkc)
+            ch_xs_b = select_chunk(staged_xs, bkc)
+            ch_act_b = select_chunk(active_rows, bkc)
+            c_ids_b = bkc * S + stage_ids
+
+            def chunk_bwd_input(lp, lxs, x, side, cot, c_idx, m_idx, act_row):
+                """Input-grad pass: VJP w.r.t. (input, sides) only — the
+                weight cotangent is never formed here."""
+
+                def f(x_, side_):
+                    return chunk_fwd(lp, lxs, x_, side_, c_idx, m_idx, act_row)
+
+                _, vjp = jax.vjp(f, x, side)
+                return vjp((cot, aux_seed))
+
+            with named_region("smp/pipeline/tick_bwd_input"):
+                d_x_rows, d_side_rows = jax.vmap(
+                    chunk_bwd_input,
+                    in_axes=(0, 0, 0, 0 if sides is not None else None,
+                             0, 0, 0, 0),
+                )(ch_params_b, ch_xs_b, stash_in,
+                  b_sides, cot_in, c_ids_b, bmc, ch_act_b)
+            d_x_rows = pin_stage_axis(d_x_rows)
+
+            if hc is not None:
+                brow_b, arow_b = health.stage_row_stats(d_x_rows, S)
+                brow_b = jnp.where(b_active, brow_b, 0.0)
+                arow_b = jnp.where(b_active, arow_b, 0.0)
+                hmb_b = _chunk_scatter_stat(
+                    hmb_b, bkc, bmc.astype(jnp.float32),
+                    b_active & (brow_b > 0),
+                    lambda cur, mb: jnp.where(cur < 0, mb, cur),
+                )
+                hbad_b = _chunk_scatter_stat(
+                    hbad_b, bkc, brow_b, b_active, lambda cur, v: cur + v
+                )
+                habs_b = _chunk_scatter_stat(
+                    habs_b, bkc, arow_b, b_active, jnp.maximum
+                )
+
+            drep = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(is_lastk, g.astype(a.dtype), 0),
+                drep, d_rep,
+            )
+
+            dembed = _chunk_scatter_add_mb(
+                dembed, bmc[0],
+                jax.tree_util.tree_map(lambda r: r[0], d_x_rows),
+                b_active[0] & (bkc[0] == 0),
+            )
+
+            if sides is not None and dsides is not None:
+                def one_stage_side_add(ds, s):
+                    row_leaves, _, _ = _inexact_leaves(
+                        jax.tree_util.tree_map(lambda r: r[s], d_side_rows)
+                    )
+                    vals = [row_leaves[i] for i in side_idx]
+                    return [
+                        _chunk_scatter_add_leaf(d, bmc[s], v, b_active[s])
+                        for d, v in zip(ds, vals)
+                    ]
+
+                for s in range(S):
+                    dsides = one_stage_side_add(dsides, s)
+
+            losses = losses.at[m_last].set(
+                jnp.where(is_lastk, loss_m.astype(jnp.float32), losses[m_last])
+            )
+            outs = _chunk_scatter_set_mb(outs, m_last, user_out, is_lastk)
+            xfer_b = d_x_rows
+
+        # ---------------- weight-grad sub-step ----------------
+        if do_wgt:
+            wk = wgt_k_sched[t]
+            wm = wgt_m_sched[t]
+            w_active = wm >= 0
+            wkc = jnp.clip(wk, 0, V - 1)
+            wmc = jnp.maximum(wm, 0)
+            w_slots = wmc % R1
+
+            w_sides = gather_sides_rows(wmc)
+            stash_w = _chunk_ring_get(stash, wkc, w_slots)
+            cot_w = _chunk_ring_get(cotbuf, wkc, w_slots)
+            ch_params_w = select_chunk(staged_params, wkc)
+            ch_xs_w = select_chunk(staged_xs, wkc)
+            ch_act_w = select_chunk(active_rows, wkc)
+            c_ids_w = wkc * S + stage_ids
+
+            def chunk_bwd_weight(lp, lxs, x, side, cot, c_idx, m_idx,
+                                 act_row):
+                """Weight-grad pass: VJP w.r.t. the chunk params only,
+                re-reading the stashed input and retained cotangent."""
+
+                def f(lp_):
+                    return chunk_fwd(lp_, lxs, x, side, c_idx, m_idx, act_row)
+
+                _, vjp = jax.vjp(f, lp)
+                (d_lp,) = vjp((cot, aux_seed))
+                return d_lp
+
+            with named_region("smp/pipeline/tick_bwd_weight"):
+                d_lp_rows = jax.vmap(
+                    chunk_bwd_weight,
+                    in_axes=(0, 0, 0, 0 if sides is not None else None,
+                             0, 0, 0, 0),
+                )(ch_params_w, ch_xs_w, stash_w,
+                  w_sides, cot_w, c_ids_w, wmc, ch_act_w)
+            d_lp_rows = pin_stage_axis(d_lp_rows)
+            dlay = _chunk_acc_rows(dlay, d_lp_rows, wkc, w_active)
+
+        new_carry = (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, dlay,
+                     drep, dembed, dsides, losses, outs)
+        if hc is not None:
+            new_carry = new_carry + (
+                ((hbad, habs, hmb), (hbad_b, habs_b, hmb_b)),
+            )
+        return new_carry, None
+
+    carry0 = (
+        pin_stage_axis(inbuf0), pin_stage_axis(stash0),
+        pin_stage_axis(cotbuf0), pin_stage_axis(outbuf0),
+        pin_stage_axis(xfer_f0), pin_stage_axis(xfer_b0),
+        pin_stage_axis(dlay0), drep0, dembed0, dsides0, losses0, outs0,
+    )
+    if hc is not None:
+
+        def hgrids():
+            return (
+                jnp.zeros((S, V), jnp.float32), jnp.zeros((S, V), jnp.float32),
+                jnp.full((S, V), -1.0, jnp.float32),
+            )
+
+        carry0 = carry0 + ((hgrids(), hgrids()),)
+
+    carry_end = carry0
+    for a, b, (do_f, do_b, do_w) in segments:
+        with named_region(_zb_segment_region(do_f, do_b, do_w)):
+            carry_end, _ = jax.lax.scan(
+                lambda c, t, f=do_f, bb=do_b, w=do_w: tick_impl(
+                    c, t, f, bb, w
+                ),
+                carry_end, jnp.arange(a, b),
+            )
+    if hc is not None:
+        (_, _, _, _, _, _, dlay, drep, dembed, dsides, losses, outs,
+         hstats) = carry_end
+        ((hbad, habs, hmb), (hbad_b, habs_b, hmb_b)) = hstats
+        # Grid position (s, k) holds GLOBAL chunk k*S + s; tags carry the
+        # pass coordinate so a tripped sentinel attributes to the exact
+        # (chunk, pass) — forward activations vs input cotangents.
+        chunk_ids = np.arange(V)[None, :] * S + np.arange(S)[:, None]
+        hc.add_stage_stats("zb", hbad, habs, hmb, chunk_ids=chunk_ids,
+                           pass_name="fwd")
+        hc.add_stage_stats("zb", hbad_b, habs_b, hmb_b, chunk_ids=chunk_ids,
+                           pass_name="bwd_input")
+    else:
+        (_, _, _, _, _, _, dlay, drep, dembed, dsides, losses,
+         outs) = carry_end
+
+    # ---- embedding backward ------------------------------------------
+
+    def embed_bwd(acc, xs):
+        mb_input, key, dcarry, dside_row = xs
+
+        def embed_inexact(p_rest):
+            args, kwargs = mb_input
+            out, aux = apply_collecting_aux(
+                module, {"params": cast_half(with_layers(p_rest))}, *args,
+                rngs=_mk_rngs(model, key, "embed"),
+                method=spec.embed_method, **kwargs,
+            )
+            leaves, _, idx = _inexact_leaves(out)
+            return [leaves[i] for i in idx] + [aux]
+
+        out_aval = jax.eval_shape(embed_inexact, params_rest)
+        if sides is not None:
+            cots = list(jax.tree_util.tree_leaves(dcarry)) + list(dside_row)
+        else:
+            cots = jax.tree_util.tree_leaves(dcarry)
+        cots = cots + [aux_seed]
+        cots = [c.astype(a.dtype) for c, a in zip(cots, out_aval)]
+        _, vjp = jax.vjp(embed_inexact, params_rest)
+        (dp,) = vjp(cots)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), acc, dp
+        )
+        return acc, None
+
+    if spec.embed_method is not None:
+        demb_params0 = param_grad_zeros(params_rest)
+        dside_stack = tuple(dsides) if dsides is not None else ()
+        demb_params, _ = jax.lax.scan(
+            embed_bwd, demb_params0,
+            (stacked_inputs, mb_keys, dembed, dside_stack),
+        )
+    else:
+        demb_params = None
+
+    # ---- assemble the full gradient tree -----------------------------
+
     flat_idx = jnp.asarray(idx_np.reshape(-1))
     flat_mask = active_np.reshape(-1)
 

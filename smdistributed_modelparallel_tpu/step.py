@@ -600,9 +600,11 @@ class StepFunction:
         the 1F1B executor with bounded in-flight microbatches
         (``parallel/pipeline_1f1b.py``; ``virtual_pipeline_degree > 1``
         selects its interleaved virtual-stage generalization inside the
-        same entry point); ``simple`` / forward-only steps use the
-        fill-drain executor (``parallel/pipeline.py``, which runs chunked
-        layouts as sequential logical stages).
+        same entry point); ``zero_bubble`` takes the same entry point and
+        selects the ZB-H1 split-backward executor (input-grad/weight-grad
+        passes scheduled separately); ``simple`` / forward-only steps use
+        the fill-drain executor (``parallel/pipeline.py``, which runs
+        chunked layouts as sequential logical stages).
         """
         from smdistributed_modelparallel_tpu.parallel.pipeline import pipeline_forward
 
@@ -614,7 +616,8 @@ class StepFunction:
         reconstruct = self._make_reconstruct(model, treedef, scan_idx, bcast_idx, static)
 
         use_scaler = cfg.fp16
-        use_1f1b = has_backward and cfg.pipeline == "interleaved"
+        use_1f1b = has_backward and cfg.pipeline in ("interleaved",
+                                                     "zero_bubble")
 
         def capture_inputs(scan_leaves, bcast_leaves, keys):
             def cap_body(_, xs):

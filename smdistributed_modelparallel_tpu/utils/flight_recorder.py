@@ -76,7 +76,8 @@ _FIELDS = {
     COLLECTIVE: ("op", "group", "nbytes", "group_size", "seq"),
     SYNC: ("name", "group", "seq", "wall_us"),
     WAIT: ("what", "peer", "tx", "outcome", "elapsed_us"),
-    SLOT: ("schedule", "tick", "stage", "direction", "microbatch", "chunk"),
+    SLOT: ("schedule", "tick", "stage", "direction", "microbatch", "chunk",
+           "pass"),
     PHASE: ("phase",),
     STEP: ("event", "step"),
     COMPILE: ("event", "name", "elapsed_us"),
@@ -171,25 +172,33 @@ class FlightRecorder:
                     int(elapsed_s * 1e6))
 
     def record_slot(self, schedule, tick, stage, direction, microbatch,
-                    chunk=None):
+                    chunk=None, pipe_pass=None):
         """``chunk`` is the virtual-pipeline chunk coordinate (interleaved
         schedules only); plain schedules omit it and their events keep the
-        pre-chunk field layout."""
+        pre-chunk field layout. ``pipe_pass`` (dumped as ``pass``) is the
+        schedule pass coordinate of split-backward schedules — "F", "B"
+        (input-grad) or "W" (weight-grad); it requires ``chunk`` (the
+        zero-bubble executor is always chunk-generalized)."""
         if chunk is None:
             self.record(SLOT, schedule, int(tick), int(stage), direction,
                         int(microbatch))
-        else:
+        elif pipe_pass is None:
             self.record(SLOT, schedule, int(tick), int(stage), direction,
                         int(microbatch), int(chunk))
+        else:
+            self.record(SLOT, schedule, int(tick), int(stage), direction,
+                        int(microbatch), int(chunk), str(pipe_pass))
 
     def record_schedule(self, schedule, slots, cap=512):
         """Record a static pipeline schedule's busy slots (once, at
         build/trace time — the compiled program replays it every step).
-        ``slots``: iterable of (tick, stage, direction, microbatch) or
+        ``slots``: iterable of (tick, stage, direction, microbatch),
         (tick, stage, direction, microbatch, chunk) for interleaved
-        virtual-stage schedules. Bounded to ``cap`` events so a huge
-        schedule cannot evict the whole collective/wait history from the
-        ring; truncation leaves an explicit marker."""
+        virtual-stage schedules, or (tick, stage, direction, microbatch,
+        chunk, pass) for zero-bubble split-backward schedules. Bounded to
+        ``cap`` events so a huge schedule cannot evict the whole
+        collective/wait history from the ring; truncation leaves an
+        explicit marker."""
         if not self.enabled:
             return
         n = 0

@@ -162,7 +162,7 @@ class HealthCollector:
         self.add(name, bad, mx, first)
 
     def add_stage_stats(self, schedule, bad, absmax, first_mb,
-                        chunk_ids=None):
+                        chunk_ids=None, pass_name=None):
         """Per-pipeline-stage entries from an executor's accumulated
         boundary-activation stats ([S] vectors; static S). Under virtual
         pipeline chunks the executors pass [S, V] grids plus a matching
@@ -171,20 +171,25 @@ class HealthCollector:
         exact model chunk, the stage says where it physically ran, and
         the two executors' tags for the same layers reconcile even though
         their placements differ (1F1B interleaves chunks, the fill-drain
-        forward path runs them sequentially)."""
+        forward path runs them sequentially). Split-backward schedules
+        additionally pass ``pass_name`` and the tags gain the pass
+        coordinate (``.../fwd`` boundary activations vs ``.../bwd_input``
+        cotangents — the zero-bubble executor monitors both)."""
+        suffix = f"/{pass_name}" if pass_name else ""
         if getattr(bad, "ndim", 1) == 2:
             num_stages, virtual = (int(d) for d in bad.shape)
             for s in range(num_stages):
                 for k in range(virtual):
                     g = int(chunk_ids[s][k]) if chunk_ids is not None else k
                     self.add(
-                        f"pp/{schedule}/stage{s}/chunk{g}",
+                        f"pp/{schedule}/stage{s}/chunk{g}{suffix}",
                         bad[s, k], absmax[s, k], first_mb[s, k],
                     )
             return
         num_stages = int(bad.shape[0])
         for s in range(num_stages):
-            self.add(f"pp/{schedule}/stage{s}", bad[s], absmax[s], first_mb[s])
+            self.add(f"pp/{schedule}/stage{s}{suffix}",
+                     bad[s], absmax[s], first_mb[s])
 
     # Entries added inside an inner trace (e.g. under the fill-drain
     # executor's value_and_grad) must travel OUT through that transform's

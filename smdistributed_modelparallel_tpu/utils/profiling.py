@@ -17,10 +17,13 @@ ad-hoc probes. Four cooperating pieces:
    skew). ``named_region(name)`` is the in-graph twin: a
    ``jax.named_scope`` whose name lands in the compiled HLO's op
    metadata, tagging pipeline warmup/steady/cooldown phases, per-tick
-   fwd/bwd sub-steps, and the optimizer update inside the device
-   timeline. Wired through the step engine (trace/compile/dispatch/
-   fetch), both pipeline executors, host collectives, and
-   ``optimizer.step``.
+   sub-steps — with the pass coordinate under split-backward schedules:
+   ``smp/pipeline/tick_fwd``, ``tick_bwd`` (fused executors) vs
+   ``tick_bwd_input`` / ``tick_bwd_weight`` (zero-bubble), plus the
+   ZB-only ``cooldown_weight`` drain segment — and the optimizer update
+   inside the device timeline. Wired through the step engine
+   (trace/compile/dispatch/fetch), all pipeline executors, host
+   collectives, and ``optimizer.step``.
 
 2. **On-demand capture** — ``SMP_PROFILE=steps=N:M`` brackets
    ``jax.profiler.start_trace``/``stop_trace`` around exactly steps

@@ -570,7 +570,8 @@ def record_comm(op, group, nbytes, group_size):
 
 
 def record_pipeline_occupancy(schedule, num_stages, num_microbatches,
-                              busy_slots, total_slots, virtual=1):
+                              busy_slots, total_slots, virtual=1,
+                              passes=2, pass_ticks=None):
     """Record measured schedule occupancy -> bubble fraction gauges.
 
     ``busy_slots``/``total_slots`` count (tick, stage[, sub-step]) slots of
@@ -578,14 +579,38 @@ def record_pipeline_occupancy(schedule, num_stages, num_microbatches,
     theoretical bound is ``(pp-1)/(mb+pp-1)`` for the plain schedules and
     the interleaved ``(pp-1)/(v*mb+pp-1)`` when ``virtual > 1`` (each rank
     owns ``v`` model chunks, so a schedule slot is a chunk sub-step and
-    the fill/drain ramps shrink by ``v``). Gauges (not counters):
-    executors trace more than once per compile and gauge sets are
-    idempotent.
+    the fill/drain ramps shrink by ``v``). Zero-bubble schedules pass
+    ``passes=3`` (forward / input-grad / weight-grad sub-steps): a slot
+    is then a (chunk, microbatch, pass) unit and the bound drops to
+    ``2*(pp-1)/(3*v*mb + 2*(pp-1))`` — the deferred weight-grad pass
+    packs gapless, leaving only the F and B ramps as bubble. Gauges (not
+    counters): executors trace more than once per compile and gauge sets
+    are idempotent.
+
+    ``pass_ticks`` (optional): {pass name: executed tick-span length}.
+    Emitted as ``smp_pipeline_phase_ticks{phase="executed", pass=...}``
+    — the per-pass denominators behind ``measured``, so the
+    measured-vs-theoretical gate can audit a 3-pass schedule's occupancy
+    accounting the same way the interleaved phase split is audited.
     """
     measured = 1.0 - (busy_slots / total_slots) if total_slots else 0.0
-    denom = virtual * num_microbatches + num_stages - 1
-    theoretical = (num_stages - 1) / denom if denom > 0 else 0.0
+    if passes >= 3:
+        denom = 3 * virtual * num_microbatches + 2 * (num_stages - 1)
+        theoretical = 2 * (num_stages - 1) / denom if denom > 0 else 0.0
+    else:
+        denom = virtual * num_microbatches + num_stages - 1
+        theoretical = (num_stages - 1) / denom if denom > 0 else 0.0
     lab = dict(schedule=schedule)
+    if pass_ticks:
+        phase_gauge = telemetry.gauge(
+            "smp_pipeline_phase_ticks",
+            "ticks per schedule phase (warmup/steady/cooldown) or per "
+            "executed pass span (pass label)",
+        )
+        for pass_name, ticks in pass_ticks.items():
+            phase_gauge.labels(
+                phase="executed", schedule=schedule, **{"pass": pass_name}
+            ).set(ticks)
     telemetry.gauge(
         "smp_pipeline_bubble_fraction",
         "measured idle fraction of pipeline schedule slots",

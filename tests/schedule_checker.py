@@ -1,0 +1,112 @@
+"""Reusable static-pipeline-schedule property checker.
+
+One checker for every schedule builder in ``parallel/pipeline_1f1b.py``
+(plain 1F1B, interleaved virtual-stage, zero-bubble split-backward): it
+replays a (stage, tick) grid and verifies the executor-level invariants
+that make a baked schedule legal —
+
+- **no double execution / no loss**: every (chunk, microbatch) unit runs
+  exactly once per pass (F, B, and W when present);
+- **dependency order**: F(c, m) strictly after F(c-1, m); B(c, m)
+  strictly after B(c+1, m) and not before its own F (same tick legal
+  only on the LAST chunk, whose cotangent comes from the loss, not a
+  neighbor); W(c, m) not before B(c, m) (same tick legal everywhere —
+  the executors order sub-steps F -> B -> W within a tick);
+- **no deadlock**: the builder terminated with every unit scheduled
+  (implied by the completeness check — a deadlocked greedy builder
+  raises or drops units);
+- **window cap**: per (stage, chunk), at most ``window`` microbatches
+  in flight (forwarded, not input-graded) at any tick.
+
+Pass plain schedules as ``(fwd_mb, bwd_mb)`` with no chunk arrays (the
+chunk coordinate defaults to the stage id), interleaved ones with
+``fwd_chunk``/``bwd_chunk``, and zero-bubble ones additionally with
+``wgt_chunk``/``wgt_mb``.
+"""
+
+import numpy as np
+
+
+def _unit_ticks(m_arr, k_arr, S, direction):
+    """{(global_chunk, mb): tick} with a no-double-execution assert."""
+    ticks = {}
+    n_ticks = m_arr.shape[0]
+    for t in range(n_ticks):
+        for s in range(S):
+            m = int(m_arr[t, s])
+            if m < 0:
+                continue
+            c = (int(k_arr[t, s]) * S + s) if k_arr is not None else s
+            key = (c, m)
+            assert key not in ticks, (
+                f"{direction} of chunk {c}, mb {m} executed twice "
+                f"(ticks {ticks[key]} and {t})"
+            )
+            ticks[key] = t
+    return ticks
+
+
+def check_schedule(num_stages, num_microbatches, fwd_mb, bwd_mb,
+                   fwd_chunk=None, bwd_chunk=None, wgt_mb=None,
+                   wgt_chunk=None, virtual=1, window=None):
+    """Assert every schedule invariant; return the per-pass tick maps
+    ``{"F": {(chunk, mb): tick}, "B": ..., "W": ...}`` ("W" only for
+    split-backward schedules) so callers can layer exact-shape checks
+    (occupancy, reductions) on top without re-walking the grid."""
+    S, M, V = int(num_stages), int(num_microbatches), int(virtual)
+    C = S * V
+    want = {(c, m) for c in range(C) for m in range(M)}
+
+    f_tick = _unit_ticks(np.asarray(fwd_mb), fwd_chunk, S, "F")
+    b_tick = _unit_ticks(np.asarray(bwd_mb), bwd_chunk, S, "B")
+    assert set(f_tick) == want, "forward pass lost/invented units"
+    assert set(b_tick) == want, "backward(-input) pass lost/invented units"
+
+    w_tick = None
+    if wgt_mb is not None:
+        w_tick = _unit_ticks(np.asarray(wgt_mb), wgt_chunk, S, "W")
+        assert set(w_tick) == want, "weight-grad pass lost/invented units"
+
+    for c in range(C):
+        for m in range(M):
+            if c > 0:
+                assert f_tick[(c - 1, m)] < f_tick[(c, m)], (
+                    f"F({c},{m}) not strictly after F({c - 1},{m})"
+                )
+            if c < C - 1:
+                assert b_tick[(c + 1, m)] < b_tick[(c, m)], (
+                    f"B({c},{m}) not strictly after B({c + 1},{m})"
+                )
+            assert f_tick[(c, m)] <= b_tick[(c, m)], (
+                f"B({c},{m}) before its own forward"
+            )
+            if f_tick[(c, m)] == b_tick[(c, m)]:
+                assert c == C - 1, (
+                    f"same-tick F/B on non-last chunk {c} (cotangent "
+                    "would not exist yet)"
+                )
+            if w_tick is not None:
+                assert b_tick[(c, m)] <= w_tick[(c, m)], (
+                    f"W({c},{m}) before its input-grad pass"
+                )
+
+    if window is not None:
+        n_ticks = max(np.asarray(fwd_mb).shape[0],
+                      np.asarray(bwd_mb).shape[0])
+        for c in range(C):
+            fs = sorted(f_tick[(c, m)] for m in range(M))
+            bs = sorted(b_tick[(c, m)] for m in range(M))
+            for t in range(n_ticks):
+                fdone = np.searchsorted(fs, t, side="right")
+                bdone = np.searchsorted(bs, t, side="right")
+                assert fdone - bdone <= window, (
+                    f"chunk {c}: {fdone - bdone} in flight at tick {t} "
+                    f"exceeds window {window}"
+                )
+
+    # Per-stage per-pass capacity: one unit per sub-step per tick is
+    # implied by the [n_ticks, S] grid shape itself (one entry per cell).
+    out = {"F": f_tick, "B": b_tick}
+    if w_tick is not None:
+        out["W"] = w_tick
+    return out

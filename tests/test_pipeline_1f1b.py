@@ -377,15 +377,17 @@ class TestVirtualHLOGuard:
     """No perf tax on the default path; permutes scale as expected."""
 
     def test_v1_explicit_knob_is_byte_identical(self):
-        """virtual_pipeline_degree=1 (explicit) vs unset: the compiled pp=2
-        step must be byte-identical — the virtual machinery must not leak
-        into the default path."""
+        """virtual_pipeline_degree=1 AND pipeline="interleaved" (explicit)
+        vs unset: the compiled pp=2 step must be byte-identical — neither
+        the virtual machinery nor the zero-bubble schedule dispatch may
+        leak into the default path."""
         step_a, step_b = _mk_step(), _mk_step()
         _train({"pipeline_parallel_degree": 2, "microbatches": 4,
                 "ddp": True}, steps=1, step_fn=step_a)
         default_hlo = _compiled_step_hlo(step_a)
         _train({"pipeline_parallel_degree": 2, "microbatches": 4,
-                "ddp": True, "virtual_pipeline_degree": 1},
+                "ddp": True, "virtual_pipeline_degree": 1,
+                "pipeline": "interleaved"},
                steps=1, step_fn=step_b)
         explicit_hlo = _compiled_step_hlo(step_b)
         assert _strip_hlo(default_hlo) == _strip_hlo(explicit_hlo)
