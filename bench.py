@@ -626,6 +626,21 @@ def main():
     except Exception as e:  # attribution must never kill the bench
         sys.stderr.write(f"bench: roofline attribution unavailable ({e!r})\n")
 
+    # Compiled-program X-ray (smp.xray): the headline program's audit
+    # summary — collective ops/bytes by kind, remat fraction, replication
+    # findings, and the program fingerprint — stamped into every
+    # BENCH_r*.json so scripts/perf_ledger.py can flag fingerprint drift
+    # between rounds (a schedule/sharding change that nobody documented).
+    hlo_audit_out = None
+    try:
+        from smdistributed_modelparallel_tpu.utils import hlo_audit
+
+        hlo_audit_out = hlo_audit.bench_summary(
+            hlo_audit.of_step_function(train_step)
+        )
+    except Exception as e:  # the audit must never kill the bench
+        sys.stderr.write(f"bench: hlo audit unavailable ({e!r})\n")
+
     # Optional component breakdown (stderr; stdout stays one JSON line).
     # SMP_BENCH_BREAKDOWN=1 localizes the MFU gap: fwd-only vs fwd+bwd vs
     # full step isolates optimizer+update cost; the attention and LM-head
@@ -723,6 +738,7 @@ def main():
         "chip_peak_bf16_tflops": peak,
         "attention_path": attn_path,
         "roofline": roofline_out,
+        "hlo_audit": hlo_audit_out,
         "final_loss": round(final_loss, 4),
     }))
 

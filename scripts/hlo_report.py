@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Render / diff compiled-program X-ray fingerprints (smp.xray).
+
+Usage:
+    python scripts/hlo_report.py show  /dumps/xray.json [--program NAME]
+    python scripts/hlo_report.py diff  old.json new.json [--program NAME]
+                                       [--semantic] [--check]
+
+``show`` pretty-prints every program audit in a dump written by
+``SMP_HLO_AUDIT_PATH`` (or a committed golden file): the collective
+census by op kind and attributed mesh axis, replication findings, remat
+fraction, and the XLA memory breakdown.
+
+``diff`` pairs programs between two dumps by step name and renders what
+changed: per-axis collective count/byte deltas, replicated-bytes
+movement, remat-fraction movement, memory/FLOPs drift, and content-hash
+changes. ``--semantic`` restricts to the environment-stable subset the
+golden regression gates use (config, collectives, replication, remat) —
+memory sizes and content hashes move with jaxlib versions, parallel
+structure only moves when the program does. ``--check`` exits nonzero
+when the (selected) diff is non-empty.
+
+Input files are either the ``{"version": 1, "programs": {id: fp}}``
+shape the audit pass persists, or a bare fingerprint object. Stdlib
+only — runnable anywhere the dumps can be copied to, no jax required
+(the diff logic is mirrored from
+``smdistributed_modelparallel_tpu/utils/hlo_audit.py``; a unit test pins
+the two implementations together).
+"""
+
+import argparse
+import json
+import sys
+
+SEMANTIC_FIELDS = ("config", "collectives", "replicated", "remat")
+
+
+def load_programs(path):
+    """{program_name: fingerprint} from a dump file (id keys are
+    ``name@keyhash``; the name part pairs programs across dumps). A dump
+    can legitimately hold several entries for one step name (recompiles
+    under different cache keys); those keep their full ``name@keyhash``
+    id — with a stderr note — instead of silently collapsing to
+    whichever entry was written last."""
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    if not isinstance(payload, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    if isinstance(payload.get("programs"), dict):
+        by_name = {}
+        for key_id, fp in payload["programs"].items():
+            name = fp.get("name") or key_id.split("@", 1)[0]
+            by_name.setdefault(name, []).append((key_id, fp))
+        out = {}
+        for name, entries in by_name.items():
+            if len(entries) == 1:
+                out[name] = entries[0][1]
+            else:
+                sys.stderr.write(
+                    f"note: {path} holds {len(entries)} programs named "
+                    f"{name!r}; keeping their full ids (pass --program "
+                    "with the id to pick one)\n"
+                )
+                for key_id, fp in entries:
+                    out[key_id] = fp
+        return out
+    if "collectives" in payload:  # bare fingerprint
+        return {payload.get("name", "program"): payload}
+    raise ValueError(f"{path}: neither an audit dump nor a fingerprint")
+
+
+def diff_fingerprints(a, b, fields=None, remat_tol=0.02):
+    """Mirror of hlo_audit.diff (kept stdlib-importable here): list of
+    ``{"field", "a", "b"}`` changes, empty when clean."""
+    def picked(name):
+        return fields is None or name in fields
+
+    changes = []
+
+    def add(field, va, vb):
+        changes.append({"field": field, "a": va, "b": vb})
+
+    if picked("config"):
+        ca, cb = a.get("config", {}), b.get("config", {})
+        for k in sorted(set(ca) | set(cb)):
+            if ca.get(k) != cb.get(k):
+                add(f"config.{k}", ca.get(k), cb.get(k))
+    if picked("collectives"):
+        colla, collb = a.get("collectives", {}), b.get("collectives", {})
+        for op in sorted(set(colla) | set(collb)):
+            ea = colla.get(op, {"count": 0, "bytes": 0, "axes": {}})
+            eb = collb.get(op, {"count": 0, "bytes": 0, "axes": {}})
+            axes = sorted(set(ea.get("axes", {})) | set(eb.get("axes", {})))
+            for axis in axes:
+                xa = ea.get("axes", {}).get(axis, {"count": 0, "bytes": 0})
+                xb = eb.get("axes", {}).get(axis, {"count": 0, "bytes": 0})
+                for k in ("count", "bytes"):
+                    if xa.get(k, 0) != xb.get(k, 0):
+                        add(f"collectives.{op}.{axis}.{k}",
+                            xa.get(k, 0), xb.get(k, 0))
+    if picked("replicated"):
+        ra = a.get("replicated_bytes", 0)
+        rb = b.get("replicated_bytes", 0)
+        if ra != rb:
+            add("replicated_bytes", ra, rb)
+        na, nb = len(a.get("replicated", [])), len(b.get("replicated", []))
+        if na != nb:
+            add("replicated_findings", na, nb)
+    if picked("remat"):
+        fa = a.get("remat", {}).get("fraction", 0.0)
+        fb = b.get("remat", {}).get("fraction", 0.0)
+        if abs((fa or 0.0) - (fb or 0.0)) > remat_tol:
+            add("remat.fraction", fa, fb)
+    if picked("memory"):
+        ma, mb = a.get("memory", {}), b.get("memory", {})
+        for k in sorted(set(ma) | set(mb)):
+            if ma.get(k) != mb.get(k):
+                add(f"memory.{k}", ma.get(k), mb.get(k))
+    if picked("flops"):
+        if a.get("flops") != b.get("flops"):
+            add("flops", a.get("flops"), b.get("flops"))
+    if picked("hlo_sha256"):
+        if a.get("hlo_sha256") != b.get("hlo_sha256"):
+            add("hlo_sha256", a.get("hlo_sha256"), b.get("hlo_sha256"))
+    return changes
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "n/a"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n):,} B"
+        n /= 1024
+    return f"{n:,.1f} TiB"
+
+
+def render_program(name, fp, out=sys.stdout):
+    w = out.write
+    cfg = fp.get("config", {})
+    shape = ", ".join(
+        f"{k}={cfg[k]}" for k in ("pipeline", "pp", "tp", "v", "mb")
+        if cfg.get(k) is not None
+    )
+    w(f"\n== {name}" + (f"  ({shape})" if shape else "") + "\n")
+    if fp.get("fingerprint"):
+        w(f"fingerprint {fp['fingerprint']}"
+          + (f"   hlo sha256 {fp['hlo_sha256'][:16]}…"
+             if fp.get("hlo_sha256") else "") + "\n")
+    coll = fp.get("collectives", {})
+    if coll:
+        w(f"{'collective':<20}{'axis':<14}{'ops':>6}{'bytes/device':>16}\n")
+        for op in sorted(coll):
+            for axis in sorted(coll[op].get("axes", {})):
+                ax = coll[op]["axes"][axis]
+                w(f"{op:<20}{axis:<14}{ax['count']:>6}"
+                  f"{_fmt_bytes(ax['bytes']):>16}\n")
+    else:
+        w("no collectives (single-device program)\n")
+    remat = fp.get("remat", {})
+    if remat:
+        w(f"remat: {100 * remat.get('fraction', 0):.1f}% recomputed FLOPs "
+          f"({remat.get('recomputed_dots', 0)}/{remat.get('dots', 0)} "
+          "dot/conv instructions are structural re-runs)\n")
+    mem = fp.get("memory", {})
+    if mem:
+        parts = [
+            f"{k.replace('_bytes', '')} {_fmt_bytes(v)}"
+            for k, v in sorted(mem.items()) if k != "total_bytes"
+        ]
+        w("memory: " + "  ".join(parts))
+        if mem.get("total_bytes") is not None:
+            w(f"  (total {_fmt_bytes(mem['total_bytes'])})")
+        w("\n")
+    for f in fp.get("replicated", []):
+        w(f"!! {f.get('kind')}: {f.get('tensor')} — "
+          f"{_fmt_bytes(f.get('bytes_wasted'))} wasted; {f.get('detail')}\n")
+    return 0
+
+
+def cmd_show(args):
+    programs = load_programs(args.path)
+    if args.program:
+        programs = {n: fp for n, fp in programs.items() if n == args.program}
+        if not programs:
+            sys.stderr.write(f"no program named {args.program!r}\n")
+            return 2
+    sys.stdout.write(f"=== SMP X-ray report: {args.path} "
+                     f"({len(programs)} program(s)) ===\n")
+    for name in sorted(programs):
+        render_program(name, programs[name])
+    return 0
+
+
+def cmd_diff(args):
+    a_progs = load_programs(args.a)
+    b_progs = load_programs(args.b)
+    names = sorted(set(a_progs) & set(b_progs))
+    if args.program:
+        names = [n for n in names if n == args.program]
+    if not names:
+        sys.stderr.write("no common program names between the two dumps "
+                         f"(a: {sorted(a_progs)}, b: {sorted(b_progs)})\n")
+        return 2
+    fields = SEMANTIC_FIELDS if args.semantic else None
+    w = sys.stdout.write
+    w(f"=== SMP X-ray diff: {args.a} -> {args.b} ===\n")
+    only_a = sorted(set(a_progs) - set(b_progs))
+    only_b = sorted(set(b_progs) - set(a_progs))
+    if only_a:
+        w(f"only in {args.a}: {', '.join(only_a)}\n")
+    if only_b:
+        w(f"only in {args.b}: {', '.join(only_b)}\n")
+    dirty = False
+    for name in names:
+        changes = diff_fingerprints(
+            a_progs[name], b_progs[name], fields=fields,
+            remat_tol=args.remat_tol,
+        )
+        w(f"\n== {name}: "
+          + (f"{len(changes)} change(s)\n" if changes else "clean\n"))
+        for c in changes:
+            w(f"  {c['field']:<44} {c['a']!r:>16} -> {c['b']!r}\n")
+        dirty = dirty or bool(changes)
+    return 1 if (dirty and args.check) else 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Render or diff smp.xray program fingerprints "
+        "(SMP_HLO_AUDIT_PATH dumps / committed goldens)."
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_show = sub.add_parser("show", help="pretty-print one audit dump")
+    p_show.add_argument("path")
+    p_show.add_argument("--program", help="only this step name")
+    p_show.set_defaults(fn=cmd_show)
+    p_diff = sub.add_parser(
+        "diff", help="what changed between two audit dumps"
+    )
+    p_diff.add_argument("a")
+    p_diff.add_argument("b")
+    p_diff.add_argument("--program", help="only this step name")
+    p_diff.add_argument(
+        "--semantic", action="store_true",
+        help="compare only the environment-stable subset "
+        "(config/collectives/replication/remat) the golden gates use",
+    )
+    p_diff.add_argument(
+        "--check", action="store_true",
+        help="exit 1 when the selected diff is non-empty",
+    )
+    p_diff.add_argument("--remat-tol", type=float, default=0.02,
+                        help="absolute tolerance on the remat fraction "
+                        "(default %(default)s)")
+    p_diff.set_defaults(fn=cmd_diff)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

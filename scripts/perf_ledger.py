@@ -38,7 +38,13 @@ CI stays unflaky):
   ``vs_baseline`` drops more than ``--threshold`` (default 5%) below the
   previous on-chip evidence must have a ``## Round N`` entry in
   BENCH_NOTES.md explaining it (notes-sourced evidence is documented by
-  construction).
+  construction);
+- the ``hlo_audit`` block (bench.py >= round 9: the headline program's
+  X-ray summary — fingerprint, collective ops/bytes by kind, remat
+  fraction, replicated bytes) is schema-checked when present, and
+  fingerprint drift between consecutive same-platform rounds without a
+  ``## Round N`` notes entry is flagged: the compiled program changed
+  (schedule, sharding, remat policy) and nobody documented why.
 
 Stdlib only — runnable anywhere the repo can be copied to.
 """
@@ -127,6 +133,32 @@ def _is_on_chip(parsed):
     return "CPU smoke" not in metric
 
 
+def _audit_schema_problem(audit):
+    """Why a round's ``hlo_audit`` block is malformed, or None. Absent
+    (None) blocks are fine — rounds predating the X-ray, or a backend
+    without an AOT executable."""
+    if audit is None:
+        return None
+    if not isinstance(audit, dict):
+        return f"'hlo_audit' must be an object, got {type(audit).__name__}"
+    fp = audit.get("fingerprint")
+    if not isinstance(fp, str) or not fp:
+        return "'hlo_audit' lacks a string 'fingerprint'"
+    if not isinstance(audit.get("remat_fraction"), (int, float)):
+        return "'hlo_audit' lacks a numeric 'remat_fraction'"
+    rb = audit.get("replicated_bytes")
+    if rb is not None and not isinstance(rb, (int, float)):
+        return "'hlo_audit.replicated_bytes' must be a number when present"
+    for key in ("collective_ops", "collective_bytes"):
+        val = audit.get(key)
+        if val is not None and not (
+            isinstance(val, dict)
+            and all(isinstance(v, (int, float)) for v in val.values())
+        ):
+            return f"'hlo_audit.{key}' must map op kinds to numbers"
+    return None
+
+
 def build_ledger(repo, threshold=0.05):
     """The full trajectory + verdict dict (see module docstring)."""
     rounds = []
@@ -166,6 +198,7 @@ def build_ledger(repo, threshold=0.05):
             "step_ms": None,
             "roofline": None,
             "schedule": None,
+            "hlo_audit": None,
             "documented": n in documented,
         }
         if rc == 0:
@@ -185,6 +218,12 @@ def build_ledger(repo, threshold=0.05):
                         f"present, got {type(schedule).__name__}"
                     )
                     schedule = None
+                audit = parsed.get("hlo_audit")
+                audit_problem = _audit_schema_problem(audit)
+                if audit_problem:
+                    problems.append(f"{name}: {audit_problem}")
+                    audit = None
+                row["hlo_audit"] = audit
                 row.update(
                     on_chip=_is_on_chip(parsed),
                     vs_baseline=parsed["vs_baseline"],
@@ -226,6 +265,30 @@ def build_ledger(repo, threshold=0.05):
                 f"{cur['vs_baseline']:.3f} regressed {drop * 100:.1f}% vs "
                 f"round {prev['round']} ({prev['vs_baseline']:.3f}) with no "
                 "BENCH_NOTES.md entry"
+            )
+
+    # Fingerprint-drift gate: a round whose compiled headline program
+    # changed (different X-ray fingerprint) since the LAST round on the
+    # same platform needs a BENCH_NOTES.md round entry — the program's
+    # parallel structure moved and the trajectory reader deserves the
+    # why. Tracked per platform (CPU smoke vs chip compile different
+    # programs by design), so an interleaved off-platform round cannot
+    # silence the comparison.
+    last_by_platform = {}
+    for cur in rounds:
+        if not cur.get("hlo_audit") or cur["on_chip"] is None:
+            continue
+        prev = last_by_platform.get(cur["on_chip"])
+        last_by_platform[cur["on_chip"]] = cur
+        if prev is None:
+            continue
+        if (prev["hlo_audit"]["fingerprint"] != cur["hlo_audit"]["fingerprint"]
+                and not cur["documented"]):
+            problems.append(
+                f"round {cur['round']}: compiled-program fingerprint "
+                f"drifted ({prev['hlo_audit']['fingerprint']} -> "
+                f"{cur['hlo_audit']['fingerprint']} since round "
+                f"{prev['round']}) with no BENCH_NOTES.md entry"
             )
 
     best = max(on_chip, key=lambda r: r["vs_baseline"], default=None)
@@ -275,6 +338,17 @@ def render_table(ledger, out=sys.stdout):
             if roof.get("bound"):
                 parts.append(f"{roof['bound']}-bound")
             w(f"{'':>7}roofline: " + "  ".join(parts) + "\n")
+        audit = r.get("hlo_audit")
+        if isinstance(audit, dict):
+            parts = [f"fp {audit.get('fingerprint', '?')}"]
+            if audit.get("remat_fraction") is not None:
+                parts.append(f"remat {100 * audit['remat_fraction']:.1f}%")
+            cb = audit.get("collective_bytes") or {}
+            for op in sorted(cb):
+                parts.append(f"{op} {cb[op]:,.0f}B")
+            if audit.get("replicated_bytes"):
+                parts.append(f"!! replicated {audit['replicated_bytes']:,}B")
+            w(f"{'':>7}xray: " + "  ".join(parts) + "\n")
     if ledger["best_on_chip"]:
         b = ledger["best_on_chip"]
         w(f"\nbest on-chip:   round {b['round']}  vs_baseline "

@@ -13,7 +13,10 @@ read: throughput (tokens/sec), pipeline bubble fraction (measured vs the
 behavior and compile wall time, XLA-counted FLOPs/bytes of the compiled
 step, performance (the smp_mfu / smp_roofline_* gauges published by
 utils/profiling.py: MFU, arithmetic intensity vs the ridge point, and
-the compute/comm/bubble decomposition of the step time), training health
+the compute/comm/bubble decomposition of the step time), the
+compiled-program X-ray audit (smp_hlo_* gauges from utils/hlo_audit.py:
+collective census by mesh axis, replicated-bytes warnings, remat
+fraction), training health
 (sentinel words, loss-scale events, grad/update norms, fault
 attributions, OOM post-mortems — utils/health.py), and peak HBM per
 device.
@@ -205,6 +208,49 @@ def render(report, out=sys.stdout):
                 if bub is not None:
                     parts.append(f"bubble {100 * bub / step_s:.1f}%")
                 w("  decomposition: " + " / ".join(parts) + "\n")
+
+    # -- hlo audit (compiled-program X-ray; utils/hlo_audit.py) ----------
+    # smp_hlo_* gauges are stamped once per compiled program: the static
+    # collective census (per op kind and attributed mesh axis), the
+    # replication detector's wasted-byte estimate, and the remat census.
+    audit_names = sorted({
+        s["labels"].get("step", "?")
+        for metric in ("smp_hlo_collective_ops", "smp_hlo_remat_fraction")
+        for s in _series(report, metric)
+    })
+    if audit_names:
+        w("\n-- hlo audit --\n")
+        for name in audit_names:
+            w(f"{name}:\n")
+            ops = [
+                s for s in _series(report, "smp_hlo_collective_ops")
+                if s["labels"].get("step") == name
+            ]
+            if ops:
+                w(f"  {'collective':<20}{'axis':<14}{'ops':>6}"
+                  f"{'bytes/device':>16}\n")
+                for s in sorted(ops, key=lambda s: (
+                        s["labels"].get("op", ""),
+                        s["labels"].get("axis", ""))):
+                    op = s["labels"].get("op", "?")
+                    axis = s["labels"].get("axis", "?")
+                    nbytes = _value(
+                        report, "smp_hlo_collective_bytes",
+                        step=name, op=op, axis=axis,
+                    )
+                    w(f"  {op:<20}{axis:<14}{int(s['value']):>6}"
+                      f"{_fmt_bytes(nbytes):>16}\n")
+            else:
+                w("  no collectives (single-device program)\n")
+            remat = _value(report, "smp_hlo_remat_fraction", step=name)
+            if remat is not None:
+                w(f"  remat: {100 * remat:.1f}% recomputed FLOPs "
+                  "(static census)\n")
+            rep_bytes = _value(report, "smp_hlo_replicated_bytes", step=name)
+            rep_n = _value(report, "smp_hlo_replicated_findings", step=name)
+            if rep_n:
+                w(f"  !! replication: {int(rep_n)} finding(s), "
+                  f"{_fmt_bytes(rep_bytes)} wasted per device\n")
 
     # -- health ---------------------------------------------------------
     # Fed by utils/health.py (SMP_HEALTH_CHECK sentinel), the fp16 loss

@@ -45,6 +45,7 @@ from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
 from smdistributed_modelparallel_tpu.resilience.chaos import chaos
 from smdistributed_modelparallel_tpu.resilience.preemption import preemption
 from smdistributed_modelparallel_tpu.utils import health
+from smdistributed_modelparallel_tpu.utils import hlo_audit
 from smdistributed_modelparallel_tpu.utils import profiling
 from smdistributed_modelparallel_tpu.utils.exceptions import StepUsageError
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
@@ -334,6 +335,9 @@ class StepFunction:
                 "smp_step_trace_seconds", "step program build/trace wall time"
             ).observe(t_build)
             flight_recorder.record_compile("trace", "step", t_build)
+            # The X-ray fingerprint is keyed by this cache key: one audit
+            # per distinct compiled program, re-identifiable across runs.
+            compiled.audit_key = hlo_audit.cache_key_hash(key)
             self._cache[key] = compiled
         else:
             cache_events.labels(event="hit").inc()
@@ -886,6 +890,17 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                     "smp_step_compile_seconds", "XLA compile wall time"
                 ).observe(t_compile)
                 flight_recorder.record_compile("xla_compile", name, t_compile)
+                if compiled is not None:
+                    # Compiled-program X-ray (smp.xray): collective census
+                    # + replication detector + remat/memory fingerprint of
+                    # the program just built. SMP_HLO_AUDIT=off makes this
+                    # a no-op before the executable is touched.
+                    run.hlo_audit = hlo_audit.maybe_audit(
+                        name, compiled,
+                        key=getattr(run, "audit_key", None),
+                        params=params,
+                        expected_param_shardings=param_pin,
+                    )
                 telemetry.set_phase(f"run/{name}")
                 holder["compiled"] = compiled
             c = holder["compiled"]

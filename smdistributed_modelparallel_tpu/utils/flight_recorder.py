@@ -80,7 +80,7 @@ _FIELDS = {
            "pass"),
     PHASE: ("phase",),
     STEP: ("event", "step"),
-    COMPILE: ("event", "name", "elapsed_us"),
+    COMPILE: ("event", "name", "elapsed_us", "fingerprint"),
     WATCHDOG: ("reason",),
     HEALTH: ("event", "tag", "step", "value", "microbatch"),
     PREEMPT: ("event", "step", "detail"),
@@ -215,8 +215,15 @@ class FlightRecorder:
     def record_step(self, event, step):
         self.record(STEP, event, int(step))
 
-    def record_compile(self, event, name, elapsed_s=0.0):
-        self.record(COMPILE, event, name, int(elapsed_s * 1e6))
+    def record_compile(self, event, name, elapsed_s=0.0, fingerprint=None):
+        """``fingerprint`` ties a compile event to its program's X-ray
+        fingerprint (utils/hlo_audit.py); events recorded without one
+        keep the shorter pre-fingerprint tuple layout."""
+        if fingerprint is None:
+            self.record(COMPILE, event, name, int(elapsed_s * 1e6))
+        else:
+            self.record(COMPILE, event, name, int(elapsed_s * 1e6),
+                        str(fingerprint))
 
     def record_watchdog(self, reason):
         self.record(WATCHDOG, reason)

@@ -1,0 +1,879 @@
+"""Compiled-program X-ray (``smp.xray``): post-compile HLO audit.
+
+Runtime observability (telemetry, flight recorder, health, roofline) says
+what a run DID; this module says what the compiler BUILT. The motivating
+failure is the PR-5 class: GSPMD's sharding propagation is best-effort
+heuristics (GSPMD paper, arXiv 2105.04663), and one broken propagation
+step silently REPLICATED the entire virtual-pipeline tick loop — every
+device computing every stage, zero collective-permutes — caught only by
+hand-reading HLO text. The standing guard was a raw
+``hlo.count("collective-permute")`` in one test. This module makes that
+inspection a first-class, structured pass over EVERY compiled step:
+
+1. **Collective census** — every ``all-reduce`` / ``all-gather`` /
+   ``reduce-scatter`` / ``collective-permute`` / ``all-to-all`` in the
+   compiled module, with op counts, per-device result bytes, and
+   mesh-axis attribution: ``replica_groups`` (literal or iota form) and
+   ``source_target_pairs`` are matched against the device groups each
+   mesh-axis subset generates, so "12 permutes on ``pp``, 4 all-reduces
+   on ``rdp``" is a queryable fact, not a substring count.
+
+2. **Sharding/replication detector** — flags (a) parameters whose
+   partitioner-assigned sharding says partitioned but whose realized
+   sharding is replicated, (b) gradient outputs that come back replicated
+   where their parameter is partitioned, and (c) the PR-5 failure class
+   itself: a pipelined program (pp > 1) whose census shows ZERO pp-axis
+   collective-permutes — reported with the tick-loop ``while`` op name
+   and a wasted-bytes estimate from its carry tuple.
+
+3. **Remat census** — recomputed-FLOPs fraction: dot/convolution
+   instructions that are structural duplicates (same result/operand
+   shapes, contraction dims, source location) of an earlier instruction,
+   FLOP-weighted. Exact for double-forward recompute (activation remat,
+   the ZB split-backward's B+W forward re-runs); an upper bound when a
+   transpose dot is structurally identical to its forward. Static census:
+   multiplicities are per compiled program, not per loop trip.
+
+4. **Memory breakdown** — XLA buffer assignment by class (arguments /
+   outputs / temps / aliased / generated code) from ``memory_analysis``.
+
+Every audit folds into a **program fingerprint**: a structured summary
+(config snapshot, census, replication findings, remat fraction, memory,
+FLOPs) plus content hashes — ``hlo_sha256`` over the metadata-stripped
+HLO text and ``fingerprint`` over the canonical summary JSON. Keyed by
+the step engine's compile-cache key, persisted to ``SMP_HLO_AUDIT_PATH``
+(rank-qualified), published as ``smp_hlo_*`` telemetry gauges, and
+referenced from the flight recorder's compile event. ``diff()`` (and
+``scripts/hlo_report.py diff``) renders what changed between two
+fingerprints; committed goldens gate the canonical pipeline configs in
+the test tier.
+
+``SMP_HLO_AUDIT=off`` disables the pass entirely: ``maybe_audit``
+returns before touching the executable (no ``as_text`` call, no gauges —
+a hard no-op, tested as such).
+
+Import-hygiene contract: importing this module must never initialize an
+accelerator backend (jax is imported for tree utilities only; devices
+are touched exclusively through the mesh handed in at audit time).
+"""
+
+import hashlib
+import itertools
+import json
+import os
+import re
+import time
+
+import jax
+import numpy as np
+
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import (
+    _atomic_json_dump,
+    telemetry,
+)
+
+logger = get_logger()
+
+AUDIT_ENV = "SMP_HLO_AUDIT"
+AUDIT_PATH_ENV = "SMP_HLO_AUDIT_PATH"
+
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# `-done` halves of async pairs carry no new information (the `-start`
+# already holds the groups and the payload shape) and would double-count.
+_COLL_RE = re.compile(
+    r"=\s*(?P<shape>\([^=]*?\)|\S+)\s+"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?P<suffix>-start|-done)?\("
+)
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_GROUPS_LITERAL_RE = re.compile(r"replica_groups=\{(\{[0-9, ]*\}(?:,\s*\{[0-9, ]*\})*)\}")
+_GROUP_RE = re.compile(r"\{([0-9, ]*)\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
+)
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{\d+,\d+\},?)*)\}")
+_PAIR_RE = re.compile(r"\{(\d+),(\d+)\}")
+_DOT_RE = re.compile(r"=\s*(?P<shape>\S+)\s+(?P<op>dot|convolution)\(")
+_CONTRACT_RE = re.compile(
+    r"lhs_contracting_dims=\{([0-9,]*)\}, rhs_contracting_dims=\{([0-9,]*)\}"
+)
+_METADATA_RE = re.compile(r"metadata=\{[^}]*\}")
+_SOURCE_RE = re.compile(r'source_file="([^"]*)" source_line=(\d+)')
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+_WHILE_RE = re.compile(r"%?([\w.\-]+)\s*=\s*(\([^=]*?\))\s+while\(")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+
+def enabled():
+    """Audit gate: ``SMP_HLO_AUDIT=off``/``0`` disables (default on)."""
+    return os.environ.get(AUDIT_ENV, "on").lower() not in ("off", "0", "false")
+
+
+# ----------------------------------------------------------------------
+# HLO text parsing
+# ----------------------------------------------------------------------
+
+
+def _shape_bytes(shape_str):
+    """Total bytes of every array shape token in an HLO shape string
+    (sums tuple elements; scalars count one element)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        width = _DTYPE_BYTES.get(dtype)
+        if width is None:
+            continue  # token/opaque types carry no payload bytes
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * width
+    return total
+
+
+def _parse_replica_groups(line):
+    """The replica groups of one collective line as a list of int tuples,
+    ``"all"`` for the empty ``replica_groups={}`` (every participant in
+    one group), or None when the line carries none."""
+    if "replica_groups={}" in line:
+        return "all"
+    m = _GROUPS_LITERAL_RE.search(line)
+    if m:
+        groups = []
+        for g in _GROUP_RE.findall(m.group(1)):
+            ids = tuple(int(x) for x in g.replace(" ", "").split(",") if x)
+            if ids:
+                groups.append(ids)
+        return groups or None
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        # Iota form [g0,g1,...]<=[r0,r1,...]T(perm): arange over the
+        # reshape dims, transposed, flattened, then rows of the left
+        # shape's trailing dim are the groups.
+        left = [int(x) for x in m.group(1).split(",")]
+        reshape = [int(x) for x in m.group(2).split(",")]
+        ids = np.arange(int(np.prod(reshape))).reshape(reshape)
+        if m.group(3):
+            ids = ids.transpose([int(x) for x in m.group(3).split(",")])
+        ids = ids.ravel().reshape(-1, left[-1])
+        return [tuple(int(x) for x in row) for row in ids]
+    return None
+
+
+def _parse_pairs(line):
+    m = _PAIRS_RE.search(line)
+    if not m:
+        return None
+    return [(int(a), int(b)) for a, b in _PAIR_RE.findall(m.group(1))]
+
+
+def _mesh_coord_maps(mesh):
+    """Participant-id -> per-axis coordinate maps: ``pos`` keys by the
+    mesh's flattened device order (the SPMD partition numbering), ``id``
+    by device id (``use_global_device_ids=true`` groups)."""
+    if mesh is None:
+        return None
+    by_pos, by_id = {}, {}
+    axes = tuple(mesh.axis_names)
+    flat = list(np.asarray(mesh.devices).ravel())
+    shape = np.asarray(mesh.devices).shape
+    for pos, coords in enumerate(np.ndindex(*shape)):
+        by_pos[pos] = coords
+        dev = flat[pos]
+        did = getattr(dev, "id", pos)
+        by_id[did] = coords
+    return {"axes": axes, "pos": by_pos, "id": by_id}
+
+
+def _axis_subsets(mesh):
+    """Nontrivial mesh-axis subsets, smallest first, each with the
+    partition of participant coordinates it generates."""
+    axes = [
+        (i, a) for i, a in enumerate(mesh.axis_names)
+        if dict(mesh.shape).get(a, 1) > 1
+    ]
+    out = []
+    for size in range(1, len(axes) + 1):
+        for combo in itertools.combinations(axes, size):
+            out.append(combo)
+    return out
+
+
+def _attribute_groups(groups, mesh, maps, use_global_ids):
+    """Mesh-axis label for a replica-group set: the smallest axis subset
+    whose generated device partition matches exactly. ``"world"`` when the
+    match is every nontrivial axis, ``"self"`` for singleton groups,
+    ``"unattributed"`` when nothing matches (manual groups, sliced
+    meshes)."""
+    if maps is None:
+        return "unattributed"
+    if groups and all(len(g) == 1 for g in groups):
+        return "self"
+    coord_of = maps["id"] if use_global_ids else maps["pos"]
+    try:
+        got = {frozenset(g) for g in groups}
+    except TypeError:
+        return "unattributed"
+    if not all(i in coord_of for g in groups for i in g):
+        return "unattributed"
+    subsets = _axis_subsets(mesh)
+    n_nontrivial = max((len(s) for s in subsets), default=0)
+    for combo in subsets:
+        vary = {i for i, _ in combo}
+        buckets = {}
+        for pid, coords in coord_of.items():
+            key = tuple(c for i, c in enumerate(coords) if i not in vary)
+            buckets.setdefault(key, set()).add(pid)
+        if {frozenset(b) for b in buckets.values()} == got:
+            if len(combo) == n_nontrivial and len(combo) > 1:
+                return "world"
+            return "+".join(a for _, a in combo)
+    return "unattributed"
+
+
+def _attribute_pairs(pairs, maps, use_global_ids):
+    """Axis label for collective-permute source/target pairs: every pair
+    must step along the SAME single mesh axis."""
+    if maps is None or not pairs:
+        return "unattributed"
+    coord_of = maps["id"] if use_global_ids else maps["pos"]
+    axes = maps["axes"]
+    axis_hit = None
+    for src, dst in pairs:
+        cs, cd = coord_of.get(src), coord_of.get(dst)
+        if cs is None or cd is None:
+            return "unattributed"
+        diff = [i for i, (a, b) in enumerate(zip(cs, cd)) if a != b]
+        if len(diff) != 1:
+            return "unattributed"
+        if axis_hit is None:
+            axis_hit = diff[0]
+        elif axis_hit != diff[0]:
+            return "unattributed"
+    return axes[axis_hit] if axis_hit is not None else "unattributed"
+
+
+def collective_census(hlo_text, mesh=None):
+    """``{op: {"count", "bytes", "axes": {label: {"count", "bytes"}}}}``
+    over every collective instruction in the HLO text. ``bytes`` is the
+    per-device result payload (summed over tuple elements)."""
+    census = {}
+    maps = _mesh_coord_maps(mesh)
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.search(line)
+        if m is None or m.group("suffix") == "-done":
+            continue
+        op = m.group("op")
+        nbytes = _shape_bytes(m.group("shape"))
+        use_global = "use_global_device_ids=true" in line
+        if op == "collective-permute":
+            pairs = _parse_pairs(line)
+            axis = _attribute_pairs(pairs, maps, use_global)
+        else:
+            groups = _parse_replica_groups(line)
+            if groups is None:
+                axis = "unattributed"
+            elif groups == "all":
+                axis = "world"
+            else:
+                axis = _attribute_groups(groups, mesh, maps, use_global)
+        ent = census.setdefault(op, {"count": 0, "bytes": 0, "axes": {}})
+        ent["count"] += 1
+        ent["bytes"] += nbytes
+        ax = ent["axes"].setdefault(axis, {"count": 0, "bytes": 0})
+        ax["count"] += 1
+        ax["bytes"] += nbytes
+    return census
+
+
+def remat_census(hlo_text):
+    """``{"flops", "recomputed_flops", "fraction", "dots",
+    "recomputed_dots"}`` — FLOP-weighted structural-duplicate census of
+    dot/convolution instructions (see module docstring for exactness)."""
+    seen = {}
+    for line in hlo_text.splitlines():
+        m = _DOT_RE.search(line)
+        if m is None:
+            continue
+        shapes = _SHAPE_RE.findall(line)
+        contract = _CONTRACT_RE.search(line)
+        src = _SOURCE_RE.search(line)
+        key = (
+            m.group("op"),
+            tuple(shapes[:3]),
+            contract.groups() if contract else None,
+            src.groups() if src else None,
+        )
+        flops = _dot_flops(m.group("op"), shapes, contract)
+        seen.setdefault(key, []).append(flops)
+    total_f = recomputed_f = 0.0
+    total_n = recomputed_n = 0
+    for flops_list in seen.values():
+        total_n += len(flops_list)
+        total_f += sum(flops_list)
+        if len(flops_list) > 1:
+            recomputed_n += len(flops_list) - 1
+            recomputed_f += sum(flops_list) - flops_list[0]
+    fraction = recomputed_f / total_f if total_f else 0.0
+    return {
+        "flops": total_f,
+        "recomputed_flops": recomputed_f,
+        "fraction": round(fraction, 4),
+        "dots": total_n,
+        "recomputed_dots": recomputed_n,
+    }
+
+
+def _dot_flops(op, shapes, contract):
+    """2 * |result| * |contraction| for a dot (from its text shapes);
+    convolutions fall back to 2 * |result| (kernel size unparsed)."""
+    def _dims(shape):
+        _, dims = shape
+        return [int(d) for d in dims.split(",") if d]
+
+    if not shapes:
+        return 0.0
+    result = float(np.prod(_dims(shapes[0]))) if _dims(shapes[0]) else 1.0
+    if op == "dot" and contract is not None and len(shapes) >= 2:
+        lhs = _dims(shapes[1])
+        k = 1.0
+        for i in contract.group(1).split(","):
+            if i and int(i) < len(lhs):
+                k *= lhs[int(i)]
+        return 2.0 * result * k
+    return 2.0 * result
+
+
+def while_carries(hlo_text):
+    """``[{"name", "op_name", "bytes"}]`` for every ``while`` instruction
+    (carry-tuple bytes from its result shape), largest first."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _WHILE_RE.search(line)
+        if m is None:
+            continue
+        op_name = _OP_NAME_RE.search(line)
+        out.append({
+            "name": m.group(1),
+            "op_name": op_name.group(1) if op_name else m.group(1),
+            "bytes": _shape_bytes(m.group(2)),
+        })
+    out.sort(key=lambda w: -w["bytes"])
+    return out
+
+
+def memory_breakdown(compiled):
+    """XLA buffer-assignment byte classes of a compiled executable, or
+    ``{}`` when the backend won't say."""
+    out = {}
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return out
+    if ma is None:
+        return out
+    for attr, key in (
+        ("argument_size_in_bytes", "argument_bytes"),
+        ("output_size_in_bytes", "output_bytes"),
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("alias_size_in_bytes", "alias_bytes"),
+        ("generated_code_size_in_bytes", "generated_code_bytes"),
+    ):
+        v = getattr(ma, attr, None)
+        if v is not None:
+            out[key] = int(v)
+    if {"argument_bytes", "output_bytes", "temp_bytes"} <= out.keys():
+        out["total_bytes"] = (
+            out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+        )
+    return out
+
+
+# ----------------------------------------------------------------------
+# Sharding / replication detector
+# ----------------------------------------------------------------------
+
+
+def _spec_partitions(sharding, mesh):
+    """How many ways a NamedSharding's spec splits the value (1 ==
+    effectively replicated intent)."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None or mesh is None:
+        return 1
+    n = 1
+    sizes = dict(mesh.shape)
+    for entry in spec:
+        if entry is None:
+            continue
+        for axis in entry if isinstance(entry, tuple) else (entry,):
+            if isinstance(axis, str):
+                n *= sizes.get(axis, 1)
+    return n
+
+
+def _leaf_path(path):
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def _param_findings(params, expected_shardings, mesh, min_bytes):
+    """Partitioner said partitioned, realized array is replicated."""
+    findings = []
+    if params is None or expected_shardings is None:
+        return findings
+    try:
+        exp_leaves = jax.tree_util.tree_leaves(expected_shardings)
+        par = jax.tree_util.tree_flatten_with_path(params)[0]
+    except Exception:
+        return findings
+    if len(exp_leaves) != len(par):
+        return findings
+    for (path, leaf), want in zip(par, exp_leaves):
+        nparts = _spec_partitions(want, mesh)
+        if nparts <= 1:
+            continue
+        realized = getattr(leaf, "sharding", None)
+        nbytes = int(getattr(leaf, "nbytes", 0) or 0)
+        if realized is None or nbytes < min_bytes:
+            continue
+        try:
+            replicated = realized.is_fully_replicated
+        except Exception:
+            continue
+        if replicated:
+            findings.append({
+                "kind": "replicated_param",
+                "tensor": _leaf_path(path),
+                "bytes": nbytes,
+                "bytes_wasted": int(nbytes * (nparts - 1) / nparts),
+                "detail": f"partitioner assigned {nparts}-way sharding; "
+                          "realized input is fully replicated",
+            })
+    return findings
+
+
+def _grads_findings(compiled, params, expected_shardings, mesh, min_bytes):
+    """Gradient outputs replicated where their parameter is partitioned.
+    The step runner's first output is the grads tree (mirrors params)."""
+    findings = []
+    if params is None or expected_shardings is None:
+        return findings
+    try:
+        out_shardings = compiled.output_shardings
+        grads_sub = out_shardings[0]
+        if grads_sub is None:
+            return findings
+        grads_leaves = jax.tree_util.tree_leaves(
+            grads_sub, is_leaf=lambda x: hasattr(x, "is_fully_replicated")
+        )
+        exp_leaves = jax.tree_util.tree_leaves(expected_shardings)
+        par = jax.tree_util.tree_flatten_with_path(params)[0]
+    except Exception:
+        return findings
+    if len(grads_leaves) != len(par) or len(exp_leaves) != len(par):
+        return findings
+    for (path, leaf), want, got in zip(par, exp_leaves, grads_leaves):
+        nparts = _spec_partitions(want, mesh)
+        nbytes = int(getattr(leaf, "nbytes", 0) or 0)
+        if nparts <= 1 or nbytes < min_bytes:
+            continue
+        try:
+            replicated = got.is_fully_replicated
+        except Exception:
+            continue
+        if replicated:
+            findings.append({
+                "kind": "replicated_grad_output",
+                "tensor": _leaf_path(path),
+                "bytes": nbytes,
+                "bytes_wasted": int(nbytes * (nparts - 1) / nparts),
+                "detail": f"parameter is {nparts}-way partitioned but its "
+                          "gradient output is fully replicated",
+            })
+    return findings
+
+
+def _loop_findings(hlo_text, census, cfg, mesh):
+    """The PR-5 class: pipelined program with zero pp-axis permutes ->
+    the tick loop is replicated across the pipeline axis."""
+    from smdistributed_modelparallel_tpu.backend.topology import PP_AXIS
+
+    findings = []
+    pp = int(getattr(cfg, "pipeline_parallel_degree", 1) or 1) if cfg else 1
+    mesh_pp = dict(mesh.shape).get(PP_AXIS, 1) if mesh is not None else 1
+    if pp <= 1 or mesh_pp <= 1:
+        return findings
+    permutes = census.get("collective-permute", {})
+    pp_permutes = permutes.get("axes", {}).get(PP_AXIS, {}).get("count", 0)
+    if pp_permutes > 0:
+        return findings
+    carries = while_carries(hlo_text)
+    carry = carries[0] if carries else None
+    carry_bytes = carry["bytes"] if carry else 0
+    findings.append({
+        "kind": "replicated_loop_carry",
+        "tensor": carry["op_name"] if carry else "(no while found)",
+        "bytes": carry_bytes,
+        "bytes_wasted": int(carry_bytes * (pp - 1) / pp),
+        "detail": (
+            f"pipeline_parallel_degree={pp} but the compiled program has "
+            "0 pp-axis collective-permutes: GSPMD replicated the tick "
+            "loop (every device computes every stage)"
+        ),
+    })
+    return findings
+
+
+# ----------------------------------------------------------------------
+# The audit itself
+# ----------------------------------------------------------------------
+
+
+class ProgramAudit:
+    """Structured audit of one compiled step program."""
+
+    def __init__(self, name, key, census, remat, memory, findings,
+                 flops, bytes_accessed, hlo_sha256, config):
+        self.name = name
+        self.key = key
+        self.census = census
+        self.remat = remat
+        self.memory = memory
+        self.findings = findings
+        self.flops = flops
+        self.bytes_accessed = bytes_accessed
+        self.hlo_sha256 = hlo_sha256
+        self.config = config
+        self.fingerprint = self._fingerprint()
+        self.fingerprint_hash = fingerprint_hash(self.fingerprint)
+
+    # -- census queries -------------------------------------------------
+
+    def collective_count(self, op, axis=None):
+        ent = self.census.get(op, {})
+        if axis is None:
+            return ent.get("count", 0)
+        return ent.get("axes", {}).get(axis, {}).get("count", 0)
+
+    def collective_bytes(self, op, axis=None):
+        ent = self.census.get(op, {})
+        if axis is None:
+            return ent.get("bytes", 0)
+        return ent.get("axes", {}).get(axis, {}).get("bytes", 0)
+
+    @property
+    def replicated_bytes(self):
+        return sum(f.get("bytes_wasted", 0) for f in self.findings)
+
+    # -- export ---------------------------------------------------------
+
+    def _fingerprint(self):
+        return {
+            "name": self.name,
+            "key": self.key,
+            "config": self.config,
+            "collectives": self.census,
+            "replicated": self.findings,
+            "replicated_bytes": self.replicated_bytes,
+            "remat": self.remat,
+            "memory": self.memory,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "hlo_sha256": self.hlo_sha256,
+        }
+
+    def as_dict(self):
+        d = dict(self.fingerprint)
+        d["fingerprint"] = self.fingerprint_hash
+        return d
+
+
+def _config_snapshot(cfg):
+    if cfg is None:
+        return {}
+    return {
+        "pipeline": getattr(cfg, "pipeline", None),
+        "pp": getattr(cfg, "pipeline_parallel_degree", 1),
+        "tp": getattr(cfg, "tensor_parallel_degree", 1),
+        "v": getattr(cfg, "virtual_pipeline_degree", 1),
+        "mb": getattr(cfg, "microbatches", 1),
+    }
+
+
+def fingerprint_hash(fp):
+    """Short stable hash of the structured summary. Content-hash fields
+    (``hlo_sha256``) are folded in; byte-identical programs hash equal,
+    and any census/finding/memory movement changes it."""
+    payload = json.dumps(fp, sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def cache_key_hash(key):
+    """Stable-enough digest of the step engine's compile-cache key (its
+    repr covers treedefs, shapes, flags)."""
+    return hashlib.sha256(repr(key).encode()).hexdigest()[:16]
+
+
+def audit_compiled(name, compiled, key=None, params=None,
+                   expected_param_shardings=None, mesh=None, cfg=None,
+                   min_bytes=1024, publish=True, persist=True):
+    """Run the full audit over one compiled executable. Explicit calls
+    always run (the ``SMP_HLO_AUDIT`` gate lives in ``maybe_audit``)."""
+    from smdistributed_modelparallel_tpu.backend.state import state
+
+    mesh = mesh if mesh is not None else state.mesh
+    cfg = cfg if cfg is not None else state.cfg
+    text = compiled.as_text()
+    census = collective_census(text, mesh=mesh)
+    remat = remat_census(text)
+    memory = memory_breakdown(compiled)
+    findings = []
+    findings += _param_findings(
+        params, expected_param_shardings, mesh, min_bytes
+    )
+    findings += _grads_findings(
+        compiled, params, expected_param_shardings, mesh, min_bytes
+    )
+    findings += _loop_findings(text, census, cfg, mesh)
+    flops = bytes_accessed = None
+    try:
+        from smdistributed_modelparallel_tpu.utils.profiling import cost_of
+
+        flops, bytes_accessed = cost_of(compiled)
+    except Exception:
+        pass
+    hlo_sha = hashlib.sha256(
+        _METADATA_RE.sub("", text).encode()
+    ).hexdigest()
+    audit = ProgramAudit(
+        name, key, census, remat, memory, findings, flops, bytes_accessed,
+        hlo_sha, _config_snapshot(cfg),
+    )
+    audits[name] = audit
+    if publish:
+        _publish(audit)
+    if persist:
+        _persist(audit)
+    for f in findings:
+        logger.warning(
+            "[xray] %s: %s %s (%s wasted bytes): %s",
+            name, f["kind"], f["tensor"], f.get("bytes_wasted"), f["detail"],
+        )
+    return audit
+
+
+def maybe_audit(name, compiled, key=None, params=None,
+                expected_param_shardings=None):
+    """Post-compile hook from the step engine. ``SMP_HLO_AUDIT=off`` is a
+    hard no-op (returns before touching the executable); failures are
+    logged, never raised into the step path."""
+    if not enabled():
+        return None
+    t0 = time.perf_counter()
+    try:
+        audit = audit_compiled(
+            name, compiled, key=key, params=params,
+            expected_param_shardings=expected_param_shardings,
+        )
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning("[xray] hlo audit of %s failed: %s", name, e)
+        return None
+    dt = time.perf_counter() - t0
+    telemetry.counter(
+        "smp_hlo_audits_total", "completed post-compile HLO audits"
+    ).inc()
+    telemetry.counter(
+        "smp_hlo_audit_seconds_total",
+        "host seconds spent in post-compile HLO audits",
+    ).inc(dt)
+    from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+        flight_recorder,
+    )
+
+    flight_recorder.record_compile(
+        "hlo_audit", name, dt, fingerprint=audit.fingerprint_hash
+    )
+    return audit
+
+
+#: Latest audit per program name (``step``, ``step_pipeline_1f1b``, ...).
+audits = {}
+
+
+def of_step_function(step_fn):
+    """The audit of a ``@smp.step`` function's single compiled program —
+    the stored post-compile audit when the pass ran, else computed on
+    demand from the cached runner's executable. Returns None when no AOT
+    executable exists (jit-fallback backends)."""
+    runners = list(getattr(step_fn, "_cache", {}).values())
+    if len(runners) != 1:
+        raise ValueError(
+            f"expected exactly one compiled program, found {len(runners)}"
+        )
+    runner = runners[0]
+    audit = getattr(runner, "hlo_audit", None)
+    if audit is not None:
+        return audit
+    compiled = runner.holder.get("compiled")
+    if compiled is None:
+        return None
+    return audit_compiled(
+        getattr(runner, "step_name", "step"), compiled,
+        key=getattr(runner, "audit_key", None),
+        publish=False, persist=False,
+    )
+
+
+def bench_summary(audit):
+    """The compact block bench.py stamps into BENCH_r*.json."""
+    if audit is None:
+        return None
+    return {
+        "fingerprint": audit.fingerprint_hash,
+        "collective_ops": {
+            op: ent["count"] for op, ent in sorted(audit.census.items())
+        },
+        "collective_bytes": {
+            op: ent["bytes"] for op, ent in sorted(audit.census.items())
+        },
+        "remat_fraction": audit.remat.get("fraction", 0.0),
+        "replicated_bytes": audit.replicated_bytes,
+    }
+
+
+# ----------------------------------------------------------------------
+# Fingerprint diff
+# ----------------------------------------------------------------------
+
+#: The environment-stable fingerprint subset the golden regression gates
+#: compare (memory/FLOPs/hashes move with jaxlib versions; these move
+#: only when the program's parallel structure does).
+SEMANTIC_FIELDS = ("config", "collectives", "replicated", "remat")
+
+
+def diff(a, b, fields=None, remat_tol=0.02):
+    """What changed between two fingerprints, as a list of
+    ``{"field", "a", "b"}`` rows (empty == clean). ``fields`` restricts
+    the comparison (e.g. ``SEMANTIC_FIELDS`` for the golden gates);
+    ``remat_tol`` is the absolute tolerance on the remat fraction."""
+    def picked(name):
+        return fields is None or name in fields
+
+    changes = []
+
+    def add(field, va, vb):
+        changes.append({"field": field, "a": va, "b": vb})
+
+    if picked("config"):
+        ca, cb = a.get("config", {}), b.get("config", {})
+        for k in sorted(set(ca) | set(cb)):
+            if ca.get(k) != cb.get(k):
+                add(f"config.{k}", ca.get(k), cb.get(k))
+    if picked("collectives"):
+        colla, collb = a.get("collectives", {}), b.get("collectives", {})
+        for op in sorted(set(colla) | set(collb)):
+            ea = colla.get(op, {"count": 0, "bytes": 0, "axes": {}})
+            eb = collb.get(op, {"count": 0, "bytes": 0, "axes": {}})
+            axes = sorted(set(ea.get("axes", {})) | set(eb.get("axes", {})))
+            for axis in axes:
+                xa = ea.get("axes", {}).get(axis, {"count": 0, "bytes": 0})
+                xb = eb.get("axes", {}).get(axis, {"count": 0, "bytes": 0})
+                for k in ("count", "bytes"):
+                    if xa.get(k, 0) != xb.get(k, 0):
+                        add(f"collectives.{op}.{axis}.{k}",
+                            xa.get(k, 0), xb.get(k, 0))
+    if picked("replicated"):
+        ra = a.get("replicated_bytes", 0)
+        rb = b.get("replicated_bytes", 0)
+        if ra != rb:
+            add("replicated_bytes", ra, rb)
+        na, nb = len(a.get("replicated", [])), len(b.get("replicated", []))
+        if na != nb:
+            add("replicated_findings", na, nb)
+    if picked("remat"):
+        fa = a.get("remat", {}).get("fraction", 0.0)
+        fb = b.get("remat", {}).get("fraction", 0.0)
+        if abs((fa or 0.0) - (fb or 0.0)) > remat_tol:
+            add("remat.fraction", fa, fb)
+    if picked("memory"):
+        ma, mb = a.get("memory", {}), b.get("memory", {})
+        for k in sorted(set(ma) | set(mb)):
+            if ma.get(k) != mb.get(k):
+                add(f"memory.{k}", ma.get(k), mb.get(k))
+    if picked("flops"):
+        if a.get("flops") != b.get("flops"):
+            add("flops", a.get("flops"), b.get("flops"))
+    if picked("hlo_sha256"):
+        if a.get("hlo_sha256") != b.get("hlo_sha256"):
+            add("hlo_sha256", a.get("hlo_sha256"), b.get("hlo_sha256"))
+    return changes
+
+
+# ----------------------------------------------------------------------
+# Telemetry + persistence
+# ----------------------------------------------------------------------
+
+
+def _publish(audit):
+    lab = dict(step=audit.name)
+    for op, ent in audit.census.items():
+        for axis, ax in ent["axes"].items():
+            telemetry.gauge(
+                "smp_hlo_collective_ops",
+                "collective instruction count in the compiled program, "
+                "by op kind and attributed mesh axis",
+            ).labels(op=op, axis=axis, **lab).set(ax["count"])
+            telemetry.gauge(
+                "smp_hlo_collective_bytes",
+                "per-device collective result bytes in the compiled "
+                "program, by op kind and attributed mesh axis",
+            ).labels(op=op, axis=axis, **lab).set(ax["bytes"])
+    telemetry.gauge(
+        "smp_hlo_replicated_bytes",
+        "estimated per-device bytes wasted to detected replication",
+    ).labels(**lab).set(audit.replicated_bytes)
+    telemetry.gauge(
+        "smp_hlo_replicated_findings",
+        "sharding/replication findings in the compiled program",
+    ).labels(**lab).set(len(audit.findings))
+    telemetry.gauge(
+        "smp_hlo_remat_fraction",
+        "recomputed-FLOPs fraction of dot/conv instructions (static, "
+        "structural-duplicate census)",
+    ).labels(**lab).set(audit.remat.get("fraction", 0.0))
+    for k, v in audit.memory.items():
+        telemetry.gauge(
+            "smp_hlo_memory_bytes",
+            "XLA buffer-assignment bytes of the compiled program by class",
+        ).labels(kind=k, **lab).set(v)
+
+
+def _persist(audit):
+    path = os.environ.get(AUDIT_PATH_ENV)
+    if not path:
+        return None
+    path = telemetry._rank_path(path)
+    data = {"version": 1, "programs": {}}
+    try:
+        with open(path, encoding="utf-8") as f:
+            prev = json.load(f)
+        if isinstance(prev, dict) and isinstance(prev.get("programs"), dict):
+            data = prev
+    except (OSError, ValueError):
+        pass
+    key_id = audit.name if not audit.key else f"{audit.name}@{audit.key}"
+    data["programs"][key_id] = audit.as_dict()
+    return _atomic_json_dump(data, path, "hlo-audit dump")

@@ -177,3 +177,37 @@ def _reset_smp():
     import smdistributed_modelparallel_tpu as smp
 
     smp.reset()
+
+
+# -- committed smp.xray golden fingerprints (tests/goldens/) ------------
+# Shared by the HLO regression gates in test_pipeline_1f1b.py and
+# test_pipeline_zero_bubble.py; regenerate with
+# ``python tests/goldens/generate_hlo_fingerprints.py`` after an
+# INTENDED program-structure change.
+
+
+def golden_hlo_fingerprint(name):
+    import json
+
+    path = os.path.join(
+        os.path.dirname(__file__), "goldens", "hlo_fingerprints.json"
+    )
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)["programs"][name]
+
+
+def assert_matches_hlo_golden(audit, golden_name):
+    """Semantic-fingerprint gate: config, per-axis collective census,
+    replication findings, and remat fraction must diff clean against the
+    committed golden (memory sizes / content hashes are excluded — they
+    move with jaxlib versions; parallel structure only moves when the
+    program does)."""
+    from smdistributed_modelparallel_tpu.utils import hlo_audit
+
+    changes = hlo_audit.diff(
+        audit.fingerprint, golden_hlo_fingerprint(golden_name),
+        fields=hlo_audit.SEMANTIC_FIELDS,
+    )
+    assert changes == [], (
+        f"compiled program drifted from golden {golden_name!r}: {changes}"
+    )

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Regenerate tests/goldens/hlo_fingerprints.json.
+
+Compiles the three canonical pipeline configs the HLO regression gates
+guard (plain 1F1B, interleaved v=2, zero-bubble — all at pp=2, mb=4 on
+the 8-virtual-CPU-device test mesh, the exact configs
+``tests/test_pipeline_1f1b.py`` / ``tests/test_pipeline_zero_bubble.py``
+compile) and writes their full ``smp.xray`` fingerprints. Run after an
+INTENDED program-structure change (new schedule, changed sharding pins,
+remat policy move) and commit the result together with a note explaining
+the movement; the gates diff the SEMANTIC subset (config, per-axis
+collective census, replication findings, remat fraction), so memory or
+content-hash churn from a jaxlib bump alone does not require
+regeneration.
+
+Usage:  python tests/goldens/generate_hlo_fingerprints.py
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
+
+CONFIGS = {
+    "1f1b_pp2_mb4": {
+        "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+    },
+    "interleaved_v2_pp2_mb4": {
+        "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+        "virtual_pipeline_degree": 2,
+    },
+    "zero_bubble_pp2_mb4": {
+        "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+        "pipeline": "zero_bubble",
+    },
+}
+
+
+def fingerprint_of(cfg):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import smdistributed_modelparallel_tpu as smp
+    from smdistributed_modelparallel_tpu.models.transformer_lm import (
+        TransformerLM,
+    )
+    from smdistributed_modelparallel_tpu.utils import hlo_audit
+    from tests.models import softmax_xent
+
+    smp.reset()
+    smp.init(cfg)
+    model = smp.DistributedModel(TransformerLM(
+        vocab_size=32, max_len=12, d_model=16, n_layers=4, n_heads=2,
+    ))
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    ids = jax.random.randint(jax.random.key(0), (8, 12), 0, 32)
+
+    @smp.step
+    def train_step(model, batch):
+        logits = model(batch)
+        loss = jnp.mean(softmax_xent(logits[:, :-1], batch[:, 1:]))
+        model.backward(loss)
+        return loss
+
+    train_step(model, ids)
+    optimizer.step()
+    audit = hlo_audit.of_step_function(train_step)
+    if audit is None:
+        raise RuntimeError("no AOT executable — cannot build goldens here")
+    return audit.as_dict()
+
+
+def main():
+    jax_cfg = None
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # Match the test harness exactly (conftest pins matmul precision).
+    jax.config.update("jax_default_matmul_precision", "highest")
+    programs = {}
+    for name, cfg in CONFIGS.items():
+        sys.stderr.write(f"compiling {name} ...\n")
+        fp = fingerprint_of(cfg)
+        # The golden id, not the step name, keys diffs of this file (all
+        # three programs share the step name "step_pipeline_1f1b").
+        fp["name"] = name
+        programs[name] = fp
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "hlo_fingerprints.json")
+    with open(out, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "programs": programs}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+    sys.stderr.write(f"wrote {out}\n")
+
+
+if __name__ == "__main__":
+    main()

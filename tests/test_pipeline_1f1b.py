@@ -5,8 +5,9 @@ interleaving) and ``torch/server_queue.py:629-676`` (``active_microbatches``
 in-flight cap). Covers: static-schedule invariants (plain and virtual-stage
 interleaved), interleaved-vs-simple loss/grad parity, virtual-stage
 (``virtual_pipeline_degree``) parity + bubble accounting + HLO regression
-guards, the peak-memory advantage (compiled-HLO temp buffer sizes), and
-window sensitivity.
+guards (the ``smp.xray`` census + committed golden fingerprints), the
+peak-memory advantage (compiled-HLO temp buffer sizes), and window
+sensitivity.
 """
 
 import re
@@ -20,6 +21,7 @@ import optax
 
 import smdistributed_modelparallel_tpu as smp
 from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.utils import hlo_audit
 from smdistributed_modelparallel_tpu.parallel.pipeline_1f1b import (
     build_1f1b_schedule,
     build_interleaved_1f1b_schedule,
@@ -373,8 +375,23 @@ def _compiled_step_hlo(step_fn):
     return compiled.as_text()
 
 
+def _audit_of(step_fn):
+    """The smp.xray audit of the step's single compiled program."""
+    audit = hlo_audit.of_step_function(step_fn)
+    if audit is None:
+        pytest.skip("AOT step executable unavailable on this backend")
+    return audit
+
+
 class TestVirtualHLOGuard:
-    """No perf tax on the default path; permutes scale as expected."""
+    """No perf tax on the default path; permutes scale as expected.
+
+    Replication guard (the PR-5 failure class) now goes through the
+    ``smp.xray`` census — per-axis attributed counts instead of raw HLO
+    substring counting — plus the committed golden fingerprints, so the
+    gate survives HLO text-format drift and catches any unexplained
+    structural change, not just a vanished permute.
+    """
 
     def test_v1_explicit_knob_is_byte_identical(self):
         """virtual_pipeline_degree=1 AND pipeline="interleaved" (explicit)
@@ -393,29 +410,42 @@ class TestVirtualHLOGuard:
         assert _strip_hlo(default_hlo) == _strip_hlo(explicit_hlo)
         # The pp permutes are present in the default program (the guard
         # below compares against this count).
-        assert default_hlo.count("collective-permute") > 0
+        assert _audit_of(step_b).collective_count(
+            "collective-permute", axis="pp"
+        ) > 0
 
     def test_v2_keeps_pipeline_permutes(self):
         """The v=2 program must still be pipeline-partitioned: the chunked
         gather breaks GSPMD's sharding propagation, and without the
         executor's stage-axis pins XLA silently replicates the whole tick
-        loop (0 collective-permutes — each device computing every stage).
-        Static permute count is bounded: the double-buffered transfers add
-        no per-chunk permutes (rolls stay one-per-direction-per-tick; the
-        tick count, not the op count, scales with v)."""
+        loop (0 pp-axis collective-permutes — each device computing every
+        stage). Static permute count is bounded: the double-buffered
+        transfers add no per-chunk permutes (rolls stay
+        one-per-direction-per-tick; the tick count, not the op count,
+        scales with v). Both programs must also recompile to a clean
+        semantic diff against their committed golden fingerprints."""
         step_a, step_b = _mk_step(), _mk_step()
         _train({"pipeline_parallel_degree": 2, "microbatches": 4,
                 "ddp": True}, steps=1, step_fn=step_a)
-        v1_count = _compiled_step_hlo(step_a).count("collective-permute")
+        audit_v1 = _audit_of(step_a)
         _train({"pipeline_parallel_degree": 2, "microbatches": 4,
                 "ddp": True, "virtual_pipeline_degree": 2},
                steps=1, step_fn=step_b)
-        v2_count = _compiled_step_hlo(step_b).count("collective-permute")
+        audit_v2 = _audit_of(step_b)
+        v1_count = audit_v1.collective_count("collective-permute", axis="pp")
+        v2_count = audit_v2.collective_count("collective-permute", axis="pp")
         assert v1_count > 0
         assert v2_count > 0, "v=2 program lost its pipeline partitioning"
         # Three scan bodies (warmup/steady/cooldown) instead of one, each
         # with the same per-tick permute pair: bounded static growth.
         assert v2_count <= 10 * v1_count
+        # The detector agrees: no replication findings on either program.
+        assert audit_v1.findings == []
+        assert audit_v2.findings == []
+        from tests.conftest import assert_matches_hlo_golden
+
+        assert_matches_hlo_golden(audit_v1, "1f1b_pp2_mb4")
+        assert_matches_hlo_golden(audit_v2, "interleaved_v2_pp2_mb4")
 
 
 class TestVirtualParity:

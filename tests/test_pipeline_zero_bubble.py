@@ -7,10 +7,13 @@ interleaved schedule, the bubble bound (strictly below interleaved at the
 same (pp, v, mb) and matching the measured occupancy gauge on the CPU
 mesh — the PR-5-style acceptance gate), the W-queue/ring memory plan,
 split-VJP numerical parity against the pp=1 baseline and the fill-drain
-executor, the default-path byte-identity guard, and the HLO
-permute-count guard for the ZB program.
+executor, the default-path byte-identity guard, and the ZB program's
+replication guard (``smp.xray`` per-axis permute census + committed
+golden fingerprint).
 """
 
+import json
+import os
 import re
 
 import numpy as np
@@ -21,6 +24,7 @@ import jax.numpy as jnp
 import optax
 
 import smdistributed_modelparallel_tpu as smp
+from smdistributed_modelparallel_tpu.utils import hlo_audit
 from smdistributed_modelparallel_tpu.parallel.memory import (
     zero_bubble_ring_plan,
 )
@@ -392,10 +396,6 @@ class TestTraceFusePassSlots:
                    for n in names), names
 
 
-def _strip_hlo(text):
-    return re.sub(r"metadata=\{[^}]*\}", "", text)
-
-
 def _mk_step():
     @smp.step
     def train_step(model, batch):
@@ -407,13 +407,11 @@ def _mk_step():
     return train_step
 
 
-def _compiled_step_hlo(step_fn):
-    runners = list(step_fn._cache.values())
-    assert len(runners) == 1
-    compiled = runners[0].holder.get("compiled")
-    if compiled is None:
+def _audit_of(step_fn):
+    audit = hlo_audit.of_step_function(step_fn)
+    if audit is None:
         pytest.skip("AOT step executable unavailable on this backend")
-    return compiled.as_text()
+    return audit
 
 
 class TestDefaultPathGuard:
@@ -429,18 +427,29 @@ class TestDefaultPathGuard:
         survive the split-VJP path) with bounded static permute growth:
         the per-tick transfer rolls stay one-per-direction and the W
         sub-step adds none (weight grads are stage-local), so the op
-        count scales with the segment count, not with mb or v."""
+        count scales with the segment count, not with mb or v. Guarded
+        through the smp.xray census (per-axis attributed counts, robust
+        to HLO text-format drift) plus the committed golden fingerprint."""
         step_a, step_b = _mk_step(), _mk_step()
         _train({"pipeline_parallel_degree": 2, "microbatches": 4,
                 "ddp": True}, steps=1, step_fn=step_a)
-        v1_count = _compiled_step_hlo(step_a).count("collective-permute")
+        audit_v1 = _audit_of(step_a)
         _train({"pipeline_parallel_degree": 2, "microbatches": 4,
                 "ddp": True, "pipeline": "zero_bubble"},
                steps=1, step_fn=step_b)
-        zb_count = _compiled_step_hlo(step_b).count("collective-permute")
+        audit_zb = _audit_of(step_b)
+        v1_count = audit_v1.collective_count("collective-permute", axis="pp")
+        zb_count = audit_zb.collective_count("collective-permute", axis="pp")
         assert v1_count > 0
         assert zb_count > 0, "zero-bubble program lost its pipeline partitioning"
         assert zb_count <= 10 * v1_count
+        assert audit_zb.findings == []
+        # Semantic regression gate against the committed golden: the ZB
+        # double-forward's remat fraction, per-axis census, and findings
+        # must recompile to a clean diff.
+        from tests.conftest import assert_matches_hlo_golden
+
+        assert_matches_hlo_golden(audit_zb, "zero_bubble_pp2_mb4")
 
 
 class TestZeroBubbleParity:

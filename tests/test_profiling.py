@@ -412,6 +412,66 @@ class TestLedger:
             f.write("## Round 2\n\nknown slow path probe; expected.\n")
         assert ledger_mod.build_ledger(repo)["ok"]
 
+    def test_hlo_audit_block_carried_and_schema_checked(self, tmp_path,
+                                                        ledger_mod):
+        repo = str(tmp_path)
+        with open(os.path.join(repo, "BASELINE.json"), "w") as f:
+            json.dump({"metric": "m"}, f)
+        good = _tpu_parsed(1.0)
+        good["hlo_audit"] = {
+            "fingerprint": "abc123", "remat_fraction": 0.22,
+            "collective_ops": {"collective-permute": 10},
+            "collective_bytes": {"collective-permute": 10771},
+            "replicated_bytes": 0,
+        }
+        _write_round(repo, 1, 0, good)
+        ledger = ledger_mod.build_ledger(repo)
+        assert ledger["ok"], ledger["problems"]
+        assert ledger["rounds"][0]["hlo_audit"]["fingerprint"] == "abc123"
+        # Malformed block -> schema problem, block dropped from the row.
+        bad = _tpu_parsed(1.0)
+        bad["hlo_audit"] = {"remat_fraction": "not a number"}
+        _write_round(repo, 2, 0, bad)
+        ledger = ledger_mod.build_ledger(repo)
+        assert any("hlo_audit" in p for p in ledger["problems"])
+        assert ledger["rounds"][1]["hlo_audit"] is None
+
+    def test_fingerprint_drift_needs_notes_entry(self, tmp_path,
+                                                 ledger_mod):
+        repo = str(tmp_path)
+        with open(os.path.join(repo, "BASELINE.json"), "w") as f:
+            json.dump({"metric": "m"}, f)
+
+        def parsed(fp):
+            p = _tpu_parsed(1.0)
+            p["hlo_audit"] = {"fingerprint": fp, "remat_fraction": 0.2}
+            return p
+
+        _write_round(repo, 1, 0, parsed("aaaa"))
+        _write_round(repo, 2, 0, parsed("bbbb"))
+        ledger = ledger_mod.build_ledger(repo)
+        assert any("fingerprint" in p and "drifted" in p
+                   for p in ledger["problems"])
+        # An interleaved CPU-smoke round must NOT silence the gate: the
+        # comparison tracks the last fingerprint PER platform.
+        cpu = _tpu_parsed(1.0)
+        cpu["metric"] += " (CPU smoke, reduced model)"
+        cpu["hlo_audit"] = {"fingerprint": "cpu1", "remat_fraction": 0.1}
+        _write_round(repo, 2, 0, cpu)
+        _write_round(repo, 3, 0, parsed("bbbb"))
+        ledger = ledger_mod.build_ledger(repo)
+        assert any("round 3" in p and "drifted" in p
+                   for p in ledger["problems"]), ledger["problems"]
+        os.unlink(os.path.join(repo, "BENCH_r03.json"))
+        # Same fingerprint: clean.
+        _write_round(repo, 2, 0, parsed("aaaa"))
+        assert ledger_mod.build_ledger(repo)["ok"]
+        # Drift WITH a notes entry for the round: documented, clean.
+        _write_round(repo, 2, 0, parsed("bbbb"))
+        with open(os.path.join(repo, "BENCH_NOTES.md"), "w") as f:
+            f.write("## Round 2\n\nnew schedule landed; program moved.\n")
+        assert ledger_mod.build_ledger(repo)["ok"]
+
     def test_numbering_and_schema_invariants(self, tmp_path, ledger_mod):
         repo = str(tmp_path)
         with open(os.path.join(repo, "BASELINE.json"), "w") as f:
