@@ -37,6 +37,7 @@
 #include <deque>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -214,10 +215,56 @@ class MessageBus {
     inbox_.erase(Key(src, tx));
   }
 
+  // Has the link to `peer` been marked down in EITHER direction?  Send
+  // side: this process's sender thread gave up (connect budget exhausted
+  // / write failed).  Receive side: a connection that had been carrying
+  // `peer`'s frames hit EOF/error while the bus was still running (the
+  // peer's process died — its kernel closed the socket).  A fresh frame
+  // from the peer (restart, transient) clears the receive-side mark, and
+  // the send side has its own revival cool-down in AsyncSend.
+  bool PeerDown(int peer) {
+    if (peer == rank_) return false;
+    if (connected_ && peer >= 0 && peer < world_ &&
+        send_queues_[peer].dead.load())
+      return true;
+    std::lock_guard<std::mutex> lk(down_mu_);
+    return recv_down_.count(peer) > 0;
+  }
+
+  // WaitRecv sliced with a peer-death probe between slices: a wait on a
+  // frame that can never arrive (the sender is dead) returns -100-src
+  // immediately instead of burning the full timeout.  Frames already
+  // delivered before the death are still handed out first.  `probe`
+  // (optional) extends the death check beyond `src` — a barrier member
+  // waiting for the ROOT's release must also fail when any OTHER member
+  // died, because the root will never release in that case.
+  int64_t WaitRecvOrPeerLost(int src, int64_t tx, int timeout_ms,
+                             const std::vector<int>* probe = nullptr) {
+    int64_t deadline = NowMs() + timeout_ms;
+    while (true) {
+      if (PollRecv(src, tx) == 0) {
+        if (PeerDown(src)) return -100 - src;
+        if (probe != nullptr) {
+          for (int r : *probe) {
+            if (r != rank_ && PeerDown(r)) return -100 - r;
+          }
+        }
+      }
+      int64_t left = deadline - NowMs();
+      if (left <= 0) return -1;
+      int slice = static_cast<int>(std::min<int64_t>(left, 200));
+      int64_t n = WaitRecv(src, tx, slice);
+      if (n != -1) return n;
+    }
+  }
+
   // Group barrier over the bus.  Every member sends a token to the lowest
   // member; the lowest waits for all, then sends a release to each.  Tx ids
   // live in a reserved negative namespace keyed by a per-group counter so
   // interleaved barriers on different groups never collide.
+  // Returns 0 on success, -1 on timeout/misuse, -100-r when member `r`'s
+  // link is known dead (so the caller can raise a TYPED peer-lost error
+  // instead of a generic timeout).
   int Barrier(const int* ranks, int n, int timeout_ms) {
     if (n <= 1) return 0;
     std::vector<int> group(ranks, ranks + n);
@@ -238,16 +285,24 @@ class MessageBus {
     if (rank_ == root) {
       for (int r : group) {
         if (r == root) continue;
-        if (WaitRecv(r, base, timeout_ms) < 0) return -1;
+        int64_t w = WaitRecvOrPeerLost(r, base, timeout_ms);
+        if (w <= -100) return static_cast<int>(w);
+        if (w < 0) return -1;
         Retrieve(r, base, &token, 1);
       }
       for (int r : group) {
         if (r == root) continue;
-        if (AsyncSend(r, &token, 1, base - 1) != 0) return -1;
+        int s = AsyncSend(r, &token, 1, base - 1);
+        if (s == -2) return -100 - r;
+        if (s != 0) return -1;
       }
     } else {
-      if (AsyncSend(root, &token, 1, base) != 0) return -1;
-      if (WaitRecv(root, base - 1, timeout_ms) < 0) return -1;
+      int s = AsyncSend(root, &token, 1, base);
+      if (s == -2) return -100 - root;
+      if (s != 0) return -1;
+      int64_t w = WaitRecvOrPeerLost(root, base - 1, timeout_ms, &group);
+      if (w <= -100) return static_cast<int>(w);
+      if (w < 0) return -1;
       Retrieve(root, base - 1, &token, 1);
     }
     return 0;
@@ -329,6 +384,13 @@ class MessageBus {
 
   void Deliver(Frame&& f) {
     {
+      // A live frame from `src` is proof the peer is (again) reachable:
+      // clear a receive-side down mark so a restarted/flapping peer is
+      // not reported dead forever.
+      std::lock_guard<std::mutex> lk(down_mu_);
+      recv_down_.erase(f.src);
+    }
+    {
       std::lock_guard<std::mutex> lk(recv_mu_);
       inbox_[Key(f.src, f.tx)].push_back(std::move(f.payload));
     }
@@ -352,6 +414,9 @@ class MessageBus {
   void RecvLoop(int fd) {
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // The source rank this connection carries, learned from its frames
+    // (each sender thread owns one connection; frames all bear one src).
+    int last_src = -1;
     while (running_.load()) {
       FrameHeader h{};
       if (!read_exact(fd, &h, sizeof(h)) || h.magic != kMagic) break;
@@ -361,7 +426,20 @@ class MessageBus {
       f.payload.resize(static_cast<size_t>(h.len));
       if (h.len > 0 && !read_exact(fd, f.payload.data(), f.payload.size()))
         break;
+      last_src = f.src;
       Deliver(std::move(f));
+    }
+    // EOF/error while the bus is still running and the peer had
+    // identified itself: its process died (or at least closed the
+    // stream) — surface it to PeerDown so waits fail typed and fast
+    // instead of burning their full timeout.
+    if (running_.load() && !shut_.load() && last_src >= 0 &&
+        last_src != rank_) {
+      {
+        std::lock_guard<std::mutex> lk(down_mu_);
+        recv_down_.insert(last_src);
+      }
+      recv_cv_.notify_all();
     }
   }
 
@@ -453,6 +531,9 @@ class MessageBus {
   std::condition_variable recv_cv_;
   std::map<uint64_t, std::deque<std::vector<uint8_t>>> inbox_;
 
+  std::mutex down_mu_;
+  std::set<int> recv_down_;
+
   std::mutex barrier_mu_;
   std::map<uint64_t, int64_t> barrier_seq_;
 };
@@ -503,6 +584,11 @@ void smp_clean_recv_resources(int src, int64_t tx) {
 int smp_bus_barrier(const int* ranks, int n, int timeout_ms) {
   if (g_bus == nullptr) return -1;
   return g_bus->Barrier(ranks, n, timeout_ms);
+}
+
+int smp_peer_down(int peer) {
+  if (g_bus == nullptr) return 0;
+  return g_bus->PeerDown(peer) ? 1 : 0;
 }
 
 void smp_bus_shutdown() {

@@ -1,12 +1,15 @@
 #!/usr/bin/env python3
-"""Inspect a checkpoint directory for resilience / elastic-resume health.
+"""Inspect a checkpoint directory for resilience / elastic-resume health,
+or (``--recovery``) join telemetry + flight-recorder dumps into an in-job
+recovery report.
 
 Stdlib-only (no numpy/jax import — runnable on a login node or in CI
 without the training environment): shard ``.npz`` files are read as zip
 archives and each member's ``.npy`` header is parsed by hand for shape and
 dtype.
 
-Reports, per checkpoint directory under the given root:
+Default (checkpoint) mode reports, per checkpoint directory under the
+given root:
 
 - committed vs orphaned (uncommitted) ``{tag}_partial/`` dirs — orphans
   are the debris of a rank killed mid-save (swept by retention GC once
@@ -20,13 +23,25 @@ Reports, per checkpoint directory under the given root:
   ``--pp/--tp/--rdp`` layout — the probe verifies this without loading a
   single array.
 
-Exit status: 0 when the selected checkpoint is loadable, 2 when not,
-1 on usage errors.
+``--recovery`` mode takes a directory of per-rank dumps instead
+(``SMP_TELEMETRY_PATH`` JSON + ``SMP_FLIGHT_RECORDER_PATH`` JSONL files,
+rank-suffixed or not) and reports the failure-recovery story: detections
+by kind (``smp_failures_detected_total``), completed recoveries, and a
+per-recovery MTTR breakdown (detect → rendezvous → reshard-load → first
+step) reconstructed from the supervisor's flight-recorder events.
+``--check`` turns it into a CI gate: non-zero exit on recovery aborts,
+inconsistent telemetry-vs-ring recovery counts, unbounded/absent MTTR, or
+fewer than ``--min-recoveries`` completed recoveries.
+
+Exit status: 0 when the selected checkpoint is loadable (or the recovery
+gate passes), 2 when not, 1 on usage errors.
 
 Usage::
 
     python scripts/resilience_probe.py /ckpts [--tag step_100]
         [--pp 2 --tp 2 --rdp 1] [--json]
+    python scripts/resilience_probe.py /dumps --recovery [--check]
+        [--max-mttr 600] [--min-recoveries 1] [--json]
 """
 
 import argparse
@@ -220,22 +235,246 @@ def inspect_partial_dir(ckpt_dir):
     return info
 
 
+# ----------------------------------------------------------------------
+# --recovery mode: telemetry + flight-recorder dumps -> recovery report
+# ----------------------------------------------------------------------
+
+
+def _load_dumps(root):
+    """Classify every file directly under `root` as a telemetry dump
+    (JSON object with "metrics"), a flight-recorder dump (JSONL whose
+    first line is the ring meta), or neither. Returns (telemetry_list,
+    flight_list) of (filename, payload) pairs; flight payloads are event
+    lists."""
+    telemetry, flights = [], []
+    for name in sorted(os.listdir(root)):
+        path = os.path.join(root, name)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path, encoding="utf-8") as fh:
+                first = fh.readline()
+                rest = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        try:
+            head = json.loads(first)
+        except json.JSONDecodeError:
+            try:
+                whole = json.loads(first + rest)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(whole, dict) and "metrics" in whole:
+                telemetry.append((name, whole))
+            continue
+        if isinstance(head, dict) and head.get("kind") == "meta":
+            events = []
+            for line in rest.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+            flights.append((name, {"meta": head, "events": events}))
+        elif isinstance(head, dict) and "metrics" in head and not rest.strip():
+            telemetry.append((name, head))
+    return telemetry, flights
+
+
+def _counter_series(dump, metric):
+    fam = dump.get("metrics", {}).get(metric)
+    if not fam:
+        return []
+    return fam.get("series", [])
+
+
+_PHASE_ORDER = ("detect", "rendezvous", "reshard_load", "first_step")
+
+
+def _parse_recovery_detail(detail):
+    """'mttr=4.2s detect=1.0 rendezvous=0.1 ...' -> {phase: seconds}."""
+    out = {}
+    for part in str(detail).split():
+        k, sep, v = part.partition("=")
+        if not sep:
+            continue
+        try:
+            out[k] = float(v.rstrip("s"))
+        except ValueError:
+            continue
+    return out
+
+
+def _recoveries_from_ring(events):
+    """Pair supervisor recover_begin..recovery_done spans (wall_us-stamped)
+    into per-recovery records with the phase breakdown."""
+    recoveries, aborts = [], []
+    current = None
+    for ev in events:
+        if ev.get("kind") != "supervisor":
+            continue
+        name = ev.get("event", "")
+        if name == "recover_begin":
+            current = {"begin_wall_us": ev.get("wall_us"), "marks": {}}
+        elif name in ("rendezvous_ok", "resume_done", "ckpt_agreed"):
+            if current is not None:
+                current["marks"][name] = ev.get("wall_us")
+                if name == "ckpt_agreed":
+                    current["ckpt"] = ev.get("detail", "")
+        elif name == "recovery_done":
+            phases = _parse_recovery_detail(ev.get("detail", ""))
+            rec = {
+                "mttr_s": phases.pop("mttr", None),
+                "phases": {
+                    p: phases.get(p) for p in _PHASE_ORDER if p in phases
+                },
+                "ckpt": (current or {}).get("ckpt", ""),
+                "done_wall_us": ev.get("wall_us"),
+            }
+            recoveries.append(rec)
+            current = None
+        elif name == "abort":
+            aborts.append(ev.get("detail", ""))
+            current = None
+    return recoveries, aborts
+
+
+def recovery_report(root, max_mttr=600.0):
+    telemetry, flights = _load_dumps(root)
+    report = {
+        "root": root,
+        "telemetry_files": [n for n, _ in telemetry],
+        "flight_files": [n for n, _ in flights],
+        "detections": {},
+        "recoveries_total": 0,
+        "recoveries": [],
+        "aborts": [],
+        "problems": [],
+    }
+    ring_recoveries = 0
+    for name, dump in telemetry:
+        for series in _counter_series(dump, "smp_failures_detected_total"):
+            kind = series.get("labels", {}).get("kind", "?")
+            report["detections"][kind] = (
+                report["detections"].get(kind, 0) + int(series.get("value", 0))
+            )
+        for series in _counter_series(dump, "smp_recoveries_total"):
+            report["recoveries_total"] += int(series.get("value", 0))
+    for name, dump in flights:
+        recs, aborts = _recoveries_from_ring(dump["events"])
+        rank = dump["meta"].get("rank")
+        for r in recs:
+            r["rank"] = rank
+            r["file"] = name
+        ring_recoveries += len(recs)
+        report["recoveries"].extend(recs)
+        report["aborts"].extend(
+            {"rank": rank, "file": name, "reason": a} for a in aborts
+        )
+    # Consistency gates (--check): the ring and the counters tell one
+    # story, every completed recovery has a positive, bounded MTTR with a
+    # full phase breakdown, and nothing aborted.
+    if report["aborts"]:
+        report["problems"].append(
+            f"{len(report['aborts'])} unrecoverable abort(s) recorded"
+        )
+    if telemetry and flights and report["recoveries_total"] != ring_recoveries:
+        report["problems"].append(
+            f"telemetry counts {report['recoveries_total']} recoveries but "
+            f"the flight rings record {ring_recoveries}"
+        )
+    for r in report["recoveries"]:
+        where = f"rank {r.get('rank')} ({r.get('file')})"
+        if r.get("mttr_s") is None or r["mttr_s"] <= 0:
+            report["problems"].append(f"{where}: missing/non-positive MTTR")
+        elif r["mttr_s"] > max_mttr:
+            report["problems"].append(
+                f"{where}: MTTR {r['mttr_s']:.1f}s exceeds --max-mttr "
+                f"{max_mttr:g}s"
+            )
+        missing = [p for p in _PHASE_ORDER if r["phases"].get(p) is None]
+        if missing:
+            report["problems"].append(
+                f"{where}: phase breakdown incomplete (missing "
+                f"{', '.join(missing)})"
+            )
+    return report
+
+
+def _render_recovery(report):
+    print(f"recovery report over {report['root']}")
+    print(f"  telemetry dumps: {len(report['telemetry_files'])}  "
+          f"flight dumps: {len(report['flight_files'])}")
+    if report["detections"]:
+        print("  detections by kind:")
+        for kind, n in sorted(report["detections"].items()):
+            print(f"    {kind}: {n}")
+    else:
+        print("  detections by kind: none recorded")
+    print(f"  completed recoveries (telemetry): "
+          f"{report['recoveries_total']}")
+    for r in report["recoveries"]:
+        phases = "  ".join(
+            f"{p}={r['phases'][p]:.3f}s" for p in _PHASE_ORDER
+            if r["phases"].get(p) is not None
+        )
+        mttr = f"{r['mttr_s']:.3f}s" if r.get("mttr_s") else "?"
+        print(f"  rank {r.get('rank')}: MTTR {mttr}  [{phases}]  "
+              f"{r.get('ckpt', '')}")
+    for a in report["aborts"]:
+        print(f"  ABORT rank {a.get('rank')}: {a.get('reason')}")
+    for p in report["problems"]:
+        print(f"  PROBLEM: {p}")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="Probe a checkpoint directory for elastic loadability."
+        description="Probe a checkpoint directory for elastic loadability, "
+        "or (--recovery) telemetry/flight dumps for the recovery story."
     )
-    ap.add_argument("root", help="checkpoint root (holds {tag}_partial dirs)")
+    ap.add_argument("root", help="checkpoint root (holds {tag}_partial "
+                    "dirs), or with --recovery a directory of per-rank "
+                    "telemetry/flight-recorder dumps")
     ap.add_argument("--tag", help="tag to probe (default: the `newest` pointer)")
     ap.add_argument("--pp", type=int, default=1, help="target pipeline degree")
     ap.add_argument("--tp", type=int, default=1, help="target tensor degree")
     ap.add_argument("--rdp", type=int, default=1,
                     help="target (sharded) data-parallel degree")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--recovery", action="store_true",
+                    help="recovery-report mode over telemetry + "
+                    "flight-recorder dumps")
+    ap.add_argument("--check", action="store_true",
+                    help="with --recovery: exit 2 on aborts, inconsistent "
+                    "counts, or unbounded MTTR (CI gate)")
+    ap.add_argument("--max-mttr", type=float, default=600.0,
+                    help="with --recovery --check: fail recoveries slower "
+                    "than this many seconds (default 600)")
+    ap.add_argument("--min-recoveries", type=int, default=0,
+                    help="with --recovery --check: fail when fewer "
+                    "completed recoveries were recorded")
     args = ap.parse_args(argv)
 
     if not os.path.isdir(args.root):
         print(f"error: {args.root} is not a directory", file=sys.stderr)
         return 1
+
+    if args.recovery:
+        report = recovery_report(args.root, max_mttr=args.max_mttr)
+        if args.check and len(report["recoveries"]) < args.min_recoveries:
+            report["problems"].append(
+                f"only {len(report['recoveries'])} completed recover(ies) "
+                f"recorded; --min-recoveries {args.min_recoveries}"
+            )
+        if args.json:
+            print(json.dumps(report, indent=1))
+        else:
+            _render_recovery(report)
+        if args.check and report["problems"]:
+            return 2
+        return 0
     if min(args.pp, args.tp, args.rdp) < 1:
         print("error: target degrees must be >= 1", file=sys.stderr)
         return 1

@@ -61,6 +61,7 @@ from smdistributed_modelparallel_tpu.utils import health
 from smdistributed_modelparallel_tpu.utils import hlo_audit as xray
 from smdistributed_modelparallel_tpu.utils import profiling
 from smdistributed_modelparallel_tpu import resilience
+from smdistributed_modelparallel_tpu.resilience.supervisor import supervisor
 from smdistributed_modelparallel_tpu.model import DistributedModel
 from smdistributed_modelparallel_tpu.optimizer import DistributedOptimizer
 from smdistributed_modelparallel_tpu.step import step

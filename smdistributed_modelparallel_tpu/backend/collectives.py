@@ -28,6 +28,7 @@ import atexit
 import os
 import pickle
 import socket
+import time
 from enum import Enum
 
 import numpy as np
@@ -36,7 +37,11 @@ import jax
 
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.resilience.chaos import chaos
-from smdistributed_modelparallel_tpu.utils.exceptions import SMPRuntimeError
+from smdistributed_modelparallel_tpu.utils.exceptions import (
+    SMPCollectiveTimeout,
+    SMPRuntimeError,
+    SMPWatchdogTimeout,
+)
 from smdistributed_modelparallel_tpu.utils import profiling
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
@@ -48,6 +53,30 @@ from smdistributed_modelparallel_tpu.utils.telemetry import (
 )
 
 logger = get_logger()
+
+COLLECTIVE_TIMEOUT_ENV = "SMP_COLLECTIVE_TIMEOUT"
+
+
+def _collective_timeout():
+    """Per-operation deadline (seconds) for host-bus-backed collectives,
+    or None (unbounded — the global watchdog remains the only limit).
+    Read per call so tests and operators can change it mid-run. Unlike
+    the watchdog, exceeding this raises a typed ``SMPCollectiveTimeout``
+    carrying group + phase + the group's last flight-recorder collective
+    seq — enough structure for the recovery supervisor to tell "slow"
+    from "gone". Device-side collectives (full-world broadcast/allgather,
+    WORLD barriers) are not host-interruptible and stay watchdog-only."""
+    raw = os.environ.get(COLLECTIVE_TIMEOUT_ENV, "")
+    if not raw:
+        return None
+    try:
+        t = float(raw)
+    except ValueError:
+        logger.warning(
+            "ignoring non-numeric %s=%r.", COLLECTIVE_TIMEOUT_ENV, raw
+        )
+        return None
+    return t if t > 0 else None
 
 
 def _payload_size(obj):
@@ -347,7 +376,7 @@ class CollectiveCommunicator:
                 if p != me:
                     self._int_send_bytes(p, payload)
             return obj, len(payload)
-        return self._int_recv(root)
+        return self._int_recv(root, group=group, phase="broadcast")
 
     def _subgroup_allgather(self, obj, procs, group):
         me = jax.process_index()
@@ -363,7 +392,7 @@ class CollectiveCommunicator:
                 if p == me:
                     gathered.append(obj)
                 else:
-                    o, n = self._int_recv(p)
+                    o, n = self._int_recv(p, group=group, phase="allgather")
                     gathered.append(o)
                     nbytes += n
             payload = pickle.dumps(gathered)
@@ -372,7 +401,7 @@ class CollectiveCommunicator:
                     self._int_send_bytes(p, payload)
             return gathered, nbytes + len(payload)
         self._int_send(root, obj)
-        return self._int_recv(root)
+        return self._int_recv(root, group=group, phase="allgather")
 
     # _int_send/_int_recv return the wire payload size so the comm-volume
     # counters ride the serialization the bus already pays for (no
@@ -388,10 +417,22 @@ class CollectiveCommunicator:
         self._int_send_seq[gdest] = seq + 1
         return len(payload)
 
-    def _int_recv(self, gsrc, timeout_ms=-1):
+    def _int_recv(self, gsrc, timeout_ms=-1, group=None, phase="recv"):
         bus = self._get_bus("framework collective")
         seq = self._int_recv_seq.get(gsrc, 0)
-        payload = bus.recv_bytes(gsrc, 2 * seq, timeout_ms)
+        ct = _collective_timeout()
+        if timeout_ms < 0 and ct is not None:
+            timeout_ms = max(int(ct * 1000), 1)
+        try:
+            payload = bus.recv_bytes(gsrc, 2 * seq, timeout_ms)
+        except TimeoutError:
+            # Typed deadline (SMP_COLLECTIVE_TIMEOUT): the supervisor can
+            # treat it as "peer slow/stuck at THIS coordinate" rather
+            # than the watchdog's undifferentiated stall.
+            g = getattr(group, "name", None) or str(group)
+            raise SMPCollectiveTimeout(
+                g, phase, flight_recorder.last_seq(g)
+            ) from None
         self._int_recv_seq[gsrc] = seq + 1
         return pickle.loads(payload), len(payload)
 
@@ -411,8 +452,39 @@ class CollectiveCommunicator:
         if len(procs) > 1:
             with profiling.region(f"collective/barrier/{gname}", track="host"):
                 if len(procs) < jax.process_count():
+                    ct = _collective_timeout()
+                    t0 = time.monotonic()
                     with watchdog.guard(f"barrier/{gname}"):
-                        self._get_bus(f"smp.barrier({group})").barrier(procs)
+                        try:
+                            if ct is None:
+                                self._get_bus(
+                                    f"smp.barrier({group})"
+                                ).barrier(procs)
+                            else:
+                                self._get_bus(
+                                    f"smp.barrier({group})"
+                                ).barrier(
+                                    procs,
+                                    timeout_ms=max(int(ct * 1000), 1),
+                                )
+                        except (OSError, SMPWatchdogTimeout) as e:
+                            # Only a wait that consumed the configured
+                            # deadline is a typed collective timeout;
+                            # instant failures (bus down) stay OSError.
+                            # An armed watchdog tightens the bus-level
+                            # timeout and raises its OWN type first —
+                            # when the ct deadline is what elapsed, the
+                            # typed SMPCollectiveTimeout wins (the dump,
+                            # if any, already happened).
+                            if (
+                                ct is not None
+                                and time.monotonic() - t0 >= 0.9 * ct
+                            ):
+                                raise SMPCollectiveTimeout(
+                                    gname, "barrier",
+                                    flight_recorder.last_seq(gname),
+                                ) from e
+                            raise
                 else:
                     state.core.barrier(name)
         # Sync mark AFTER the barrier: every member leaves it within

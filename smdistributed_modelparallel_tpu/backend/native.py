@@ -111,6 +111,8 @@ def _declare(lib):
     lib.smp_clean_recv_resources.restype = None
     lib.smp_bus_barrier.argtypes = [c.POINTER(c.c_int), c.c_int, c.c_int]
     lib.smp_bus_barrier.restype = c.c_int
+    lib.smp_peer_down.argtypes = [c.c_int]
+    lib.smp_peer_down.restype = c.c_int
     lib.smp_bus_shutdown.argtypes = []
     lib.smp_bus_shutdown.restype = None
 
@@ -252,25 +254,59 @@ class MessageBus:
     def poll(self, src, tx):
         return bool(self._lib.smp_poll_recv(src, tx))
 
+    def peer_down(self, peer):
+        """True when the link to `peer` is marked dead in either direction
+        (sender thread gave up, or the peer's incoming connection hit EOF
+        while the bus was running — its process died)."""
+        return bool(self._lib.smp_peer_down(peer))
+
     def _wait_recv(self, src, tx, timeout_ms):
-        """Blocking C wait, sliced under an armed watchdog: an unbounded
-        wait on a dead peer becomes a diagnostics dump + raise instead of
-        a silent wedge. Bounded waits keep their caller's timeout."""
-        wd = watchdog.timeout()
-        if timeout_ms >= 0 or wd is None:
-            return self._lib.smp_wait_recv(src, tx, timeout_ms)
-        deadline = time.monotonic() + wd
+        """Blocking C wait, sliced for two early exits: an armed watchdog
+        turns an unbounded wait into a diagnostics dump + raise instead of
+        a silent wedge, and a peer whose link the bus has marked DEAD (in
+        either direction) raises ``SMPPeerLost`` immediately — a wait on a
+        frame that can never arrive must not burn the full watchdog/caller
+        timeout. Frames already delivered before the death still drain
+        first (the probe only fires when nothing is pending)."""
+        if timeout_ms == 0:
+            return self._lib.smp_wait_recv(src, tx, 0)
+        now = time.monotonic()
+        deadline = None if timeout_ms < 0 else now + timeout_ms / 1000.0
+        # The watchdog guards UNBOUNDED waits only — a caller that chose
+        # an explicit timeout keeps it (and its TimeoutError), even when
+        # the watchdog window is shorter.
+        wd = watchdog.timeout() if timeout_ms < 0 else None
+        wd_deadline = None if wd is None else now + wd
         while True:
-            left_ms = int((deadline - time.monotonic()) * 1000)
-            if left_ms <= 0:
-                watchdog.dump(
-                    f"bus recv from process {src} (tx={tx}) stalled >{wd}s"
+            if (
+                src != self.rank
+                and not self._lib.smp_poll_recv(src, tx)
+                and self.peer_down(src)
+            ):
+                raise SMPPeerLost(
+                    src,
+                    f"bus recv from process {src} (tx={tx}): the link is "
+                    "marked dead (peer process unreachable or exited).",
                 )
-                raise SMPWatchdogTimeout(
-                    f"watchdog: bus recv from process {src} stalled for "
-                    f"more than {wd}s (diagnostics dumped)."
-                )
-            n = self._lib.smp_wait_recv(src, tx, min(left_ms, 1000))
+            now = time.monotonic()
+            slice_ms = 1000  # peer-death probe cadence
+            if deadline is not None:
+                left_ms = int((deadline - now) * 1000)
+                if left_ms <= 0:
+                    return -1  # caller's timeout
+                slice_ms = min(slice_ms, max(left_ms, 1))
+            if wd_deadline is not None:
+                wd_left = int((wd_deadline - now) * 1000)
+                if wd_left <= 0:
+                    watchdog.dump(
+                        f"bus recv from process {src} (tx={tx}) stalled >{wd}s"
+                    )
+                    raise SMPWatchdogTimeout(
+                        f"watchdog: bus recv from process {src} stalled for "
+                        f"more than {wd}s (diagnostics dumped)."
+                    )
+                slice_ms = min(slice_ms, max(wd_left, 1))
+            n = self._lib.smp_wait_recv(src, tx, slice_ms)
             if n != -1:  # -1 = slice timed out; keep waiting
                 return n
 
@@ -286,6 +322,11 @@ class MessageBus:
         except SMPWatchdogTimeout:
             flight_recorder.record_wait(
                 "bus_recv", src, tx, "watchdog", time.monotonic() - t0
+            )
+            raise
+        except SMPPeerLost:
+            flight_recorder.record_wait(
+                "bus_recv", src, tx, "peer_lost", time.monotonic() - t0
             )
             raise
         elapsed = time.monotonic() - t0
@@ -305,6 +346,31 @@ class MessageBus:
     def clean(self, src, tx):
         self._lib.smp_clean_recv_resources(src, tx)
 
+    def send_raw(self, dest, payload, tx):
+        """Single unadorned enqueue: no chaos seam, no retries, no flight
+        recording. Returns the C return code (0 ok, -1 misuse, -2 link
+        dead). The heartbeat path uses this — a periodic beat must not
+        consume chaos bus-send ordinals or flood the flight ring, and a
+        dead-link result is itself the detection signal, not an error."""
+        return self._lib.smp_async_send(dest, payload, len(payload), tx)
+
+    def drain_bytes(self, src, tx, limit=256):
+        """Drain every already-delivered frame for (src, tx) without
+        blocking or flight-recording. Heartbeat receive path: beats arrive
+        faster than the detector scans, and each scan wants *all* pending
+        beats (the freshest carries the peer's current step edge)."""
+        out = []
+        while len(out) < limit and self._lib.smp_poll_recv(src, tx):
+            n = self._lib.smp_wait_recv(src, tx, 0)
+            if n < 0:
+                break
+            buf = (ctypes.c_uint8 * int(n))()
+            got = self._lib.smp_retrieve_object(src, tx, buf, n)
+            if got != n:
+                break
+            out.append(bytes(buf))
+        return out
+
     def barrier(self, ranks, timeout_ms=600000):
         # An armed watchdog tightens the C-side timeout so a wedged peer
         # produces the dump within the configured window, not after 10 min.
@@ -314,7 +380,21 @@ class MessageBus:
         arr = (ctypes.c_int * len(ranks))(*sorted(ranks))
         flight_recorder.record_wait("bus_barrier", -1, len(ranks), "begin", 0.0)
         t0 = time.monotonic()
-        if self._lib.smp_bus_barrier(arr, len(ranks), timeout_ms) != 0:
+        rc = self._lib.smp_bus_barrier(arr, len(ranks), timeout_ms)
+        if rc <= -100:
+            # The C side identified a member whose link is marked dead:
+            # typed and immediate, not a full-timeout stall.
+            peer = -(rc + 100)
+            flight_recorder.record_wait(
+                "bus_barrier", peer, len(ranks), "peer_lost",
+                time.monotonic() - t0,
+            )
+            raise SMPPeerLost(
+                peer,
+                f"bus barrier over {sorted(ranks)}: the link to process "
+                f"{peer} is marked dead (peer unreachable or exited).",
+            )
+        if rc != 0:
             # The C side returns -1 for timeouts AND for immediate failures
             # (bus already shut down, dead peer): only a wait that actually
             # consumed the window is a stall — instant failures keep the

@@ -102,6 +102,14 @@ class ModelParallelState:
         )
 
         preemption.install()
+        from smdistributed_modelparallel_tpu.resilience.supervisor import (
+            supervisor,
+        )
+
+        # Arm the heartbeat failure detector (SMP_SUPERVISOR=on, multi-
+        # process, bus up); re-arms on a recovery's re-initialize. Off is
+        # a hard no-op: no thread, no bus traffic, step path untouched.
+        supervisor.start()
         from smdistributed_modelparallel_tpu.utils import profiling
 
         # SIGUSR2 arms a one-step profiler capture on a live run

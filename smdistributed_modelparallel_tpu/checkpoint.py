@@ -112,6 +112,10 @@ def _smp_config_snapshot():
     # scripts/resilience_probe.py; present on the RESUME side too so
     # elastic.classify_mismatches can report a world-size change.
     snapshot["num_processes"] = _process_count()
+    # The step edge this checkpoint represents: the recovery supervisor
+    # restarts the step engine from it (resilience/supervisor.py) without
+    # relying on tag-name conventions or user_content.
+    snapshot["step_count"] = state.step_count
     return snapshot
 
 

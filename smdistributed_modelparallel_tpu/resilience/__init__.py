@@ -1,6 +1,7 @@
-"""smp.resilience — preemption-aware checkpointing, elastic resume, chaos.
+"""smp.resilience — preemption checkpointing, elastic resume, chaos, and
+in-job failure recovery.
 
-Three cooperating pieces (each in its own module):
+Four cooperating pieces (each in its own module):
 
 - ``preemption``: SIGTERM / ``SMP_PREEMPTION_FILE`` listener whose flag
   the step engine checks at every step edge; a trigger leads to a
@@ -10,9 +11,15 @@ Three cooperating pieces (each in its own module):
   checkpoint saved under a *different* (pp, tp, rdp) layout by
   reassembling each leaf from logical shard bounds and re-slicing it per
   the resuming mesh (``elastic.py``; policy consumed by ``checkpoint.py``).
-- ``chaos``: the ``SMP_CHAOS`` deterministic fault injector (SIGTERM at a
-  step edge, dropped/failed bus sends, delayed collectives) that the
-  resilience tests use to prove the recovery paths recover (``chaos.py``).
+- ``supervisor``: the ``SMP_SUPERVISOR=on`` heartbeat failure detector
+  (dead / wedged / preempted classification over the native bus) and the
+  shrink-to-survivors recovery protocol — survivors rendezvous, agree on
+  the newest committed checkpoint, re-initialize ``jax.distributed`` +
+  mesh at the shrunken world, and resume in-job (``supervisor.py``).
+- ``chaos``: the ``SMP_CHAOS`` deterministic fault injector (SIGTERM /
+  SIGKILL at a step edge, an in-dispatch wedge, dropped heartbeats,
+  dropped/failed bus sends, delayed collectives) that the resilience
+  tests use to prove the recovery paths recover (``chaos.py``).
 """
 
 from smdistributed_modelparallel_tpu.resilience.chaos import chaos
@@ -20,14 +27,17 @@ from smdistributed_modelparallel_tpu.resilience.elastic import (
     classify_mismatches,
 )
 from smdistributed_modelparallel_tpu.resilience.preemption import preemption
+from smdistributed_modelparallel_tpu.resilience.supervisor import supervisor
 
 
 def reset():
     """Session-teardown hook (``state.reset`` / ``smp.shutdown``): clear
-    preemption triggers and chaos rule state, and give SIGTERM back its
-    previous disposition — ``smp.init`` installs the deferring handler, so
-    a process that has shut the session down must die normally on TERM
-    instead of flagging an edge no step loop will ever reach."""
+    preemption triggers and chaos rule state, stop the failure detector,
+    and give SIGTERM back its previous disposition — ``smp.init`` installs
+    the deferring handler, so a process that has shut the session down
+    must die normally on TERM instead of flagging an edge no step loop
+    will ever reach."""
     preemption.reset()
     preemption.uninstall()
+    supervisor.reset()
     chaos.reset()

@@ -17,9 +17,23 @@ Faults:
   listener installed (``resilience/preemption.py``) this exercises the full
   emergency-checkpoint path; without it the process dies like a real
   preemption with no grace handling.
+- ``kill@step=N[:rank=R]`` — deliver SIGKILL at the end of step ``N``: no
+  grace, no handler, no emergency checkpoint — the hard-failure case the
+  in-job recovery supervisor (``resilience/supervisor.py``) exists for.
+  Peers see missed heartbeats and a dead bus link, never a notice.
+- ``wedge@step=N[:rank=R]:ms=M`` — hang for ``M`` ms INSIDE step ``N``'s
+  dispatch (before the compiled program runs). Heartbeats keep flowing
+  (the detector thread is alive) but this rank's reported step edge stops
+  advancing: the peers' detectors classify it **wedged** once the stall
+  exceeds ``SMP_WEDGE_TIMEOUT``.
+- ``heartbeat_drop@rank=R:count=K`` — silently drop process ``R``'s next
+  ``K`` outgoing heartbeats (all peers): false-positive/flap testing for
+  the failure detector — ``K`` below the miss budget must NOT produce a
+  dead classification, above it must.
 - ``bus_drop@seq=N[:rank=R][:dest=D]`` — silently drop this process's
-  ``N``-th native-bus send (0-based ordinal over all sends). The receiver
-  never sees the message: exercises watchdog/timeout recovery.
+  ``N``-th native-bus send (0-based ordinal over all sends; heartbeats
+  ride their own seam and do not consume ordinals). The receiver never
+  sees the message: exercises watchdog/timeout recovery.
 - ``bus_error@seq=N[:rank=R][:dest=D]`` — fail the ``N``-th send at the
   enqueue edge: exercises the bounded retry/backoff and ``SMPPeerLost``
   path in ``backend/native.py``.
@@ -32,8 +46,9 @@ Faults:
 process). Rules are deterministic — ordinals and step numbers are exact,
 never sampled — so a chaos failure reproduces byte-for-byte.
 
-Seams live in ``step.py`` (``on_step_edge``), ``backend/native.py``
-(``on_bus_send``) and ``backend/collectives.py`` (``on_collective``). Every
+Seams live in ``step.py`` (``on_step_edge``, ``on_step_dispatch``),
+``backend/native.py`` (``on_bus_send``), ``backend/collectives.py``
+(``on_collective``) and ``resilience/supervisor.py`` (``on_heartbeat``). Every
 seam's disabled path is one ``os.environ.get`` — a run without ``SMP_CHAOS``
 pays nothing. Injections are counted in ``smp_chaos_injected_total`` and
 recorded as flight-recorder ``chaos`` events so a post-mortem ring always
@@ -56,7 +71,10 @@ logger = get_logger()
 
 CHAOS_ENV = "SMP_CHAOS"
 
-_KNOWN_FAULTS = ("sigterm", "bus_drop", "bus_error", "delay_collective")
+_KNOWN_FAULTS = (
+    "sigterm", "kill", "wedge", "heartbeat_drop",
+    "bus_drop", "bus_error", "delay_collective",
+)
 
 # Argument value parsers: validated at PARSE time so a typo degrades to a
 # skipped rule with a warning — never a ValueError at a seam mid-run.
@@ -166,23 +184,72 @@ class ChaosInjector:
 
     def on_step_edge(self, step):
         """step.py seam: called once per completed step with the step
-        count. May deliver SIGTERM to this process (rule ``sigterm``)."""
+        count. May deliver SIGTERM (rule ``sigterm``) — graceful, the
+        preemption listener defers it — or SIGKILL (rule ``kill``) — the
+        hard death the failure detector must notice on its own."""
         if not os.environ.get(CHAOS_ENV):
             return
         for r in self._sync():
             if (
-                r.fault == "sigterm"
+                r.fault in ("sigterm", "kill")
                 and not r.fired
                 and r.rank_matches()
                 and int(r.kv.get("step", -1)) == int(step)
             ):
                 r.fired += 1
-                record_chaos("sigterm", f"step={step}")
-                logger.warning(
-                    "chaos: delivering SIGTERM to pid %d at step %s",
-                    os.getpid(), step,
+                record_chaos(r.fault, f"step={step}")
+                signum = (
+                    signal.SIGKILL if r.fault == "kill" else signal.SIGTERM
                 )
-                os.kill(os.getpid(), signal.SIGTERM)
+                logger.warning(
+                    "chaos: delivering %s to pid %d at step %s",
+                    signum.name, os.getpid(), step,
+                )
+                os.kill(os.getpid(), signum)
+
+    def on_step_dispatch(self, step):
+        """step.py seam: called as step ``step``'s dispatch begins (before
+        the compiled program runs). May hang this rank for ``ms``
+        milliseconds (rule ``wedge``): its heartbeat thread keeps beating
+        but the reported step edge stalls — the peers' detectors must
+        classify it wedged, not dead."""
+        if not os.environ.get(CHAOS_ENV):
+            return
+        for r in self._sync():
+            if (
+                r.fault == "wedge"
+                and not r.fired
+                and r.rank_matches()
+                and int(r.kv.get("step", -1)) == int(step)
+            ):
+                r.fired += 1
+                ms = float(r.kv.get("ms", 0))
+                record_chaos("wedge", f"step={step} ms={ms:g}")
+                logger.warning(
+                    "chaos: wedging pid %d inside step %s dispatch for "
+                    "%gms", os.getpid(), step, ms,
+                )
+                if ms > 0:
+                    time.sleep(ms / 1000.0)
+
+    def on_heartbeat(self, dest):
+        """supervisor.py seam: called once per outgoing heartbeat. Returns
+        True to silently drop the beat (rule ``heartbeat_drop``; ``count``
+        beats, counted per send, any destination). Deliberately separate
+        from ``on_bus_send``: beats must not consume the deterministic
+        bus-send ordinals that ``bus_drop``/``bus_error`` rules target."""
+        if not os.environ.get(CHAOS_ENV):
+            return False
+        for r in self._sync():
+            if r.fault != "heartbeat_drop" or not r.rank_matches():
+                continue
+            count = int(r.kv.get("count", 1) or 1)
+            if r.fired >= count:
+                continue
+            r.fired += 1
+            record_chaos("heartbeat_drop", f"dest={dest} n={r.fired}/{count}")
+            return True
+        return False
 
     def on_bus_send(self, dest):
         """native.py seam: called once per bus send (consumes one send

@@ -56,6 +56,9 @@ SOFT_KEYS = (
     # different world size is the NORMAL elastic case, and the count's
     # real consumer is the shard-file completeness check, not layout.
     "num_processes",
+    # Step-edge stamp (checkpoint.py snapshot): consumed by the recovery
+    # supervisor to restart the step engine; never layout-relevant.
+    "step_count",
 )
 
 

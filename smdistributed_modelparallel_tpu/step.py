@@ -44,6 +44,7 @@ from smdistributed_modelparallel_tpu.model import DistributedModel
 from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
 from smdistributed_modelparallel_tpu.resilience.chaos import chaos
 from smdistributed_modelparallel_tpu.resilience.preemption import preemption
+from smdistributed_modelparallel_tpu.resilience.supervisor import supervisor
 from smdistributed_modelparallel_tpu.utils import health
 from smdistributed_modelparallel_tpu.utils import hlo_audit
 from smdistributed_modelparallel_tpu.utils import profiling
@@ -172,9 +173,14 @@ class StepFunction:
         # deterministically, and a pending preemption (SIGTERM, sentinel
         # file, peer notice) turns into the coordinated emergency
         # checkpoint before the next step's work begins. Both are
-        # single-flag no-ops when disarmed.
+        # single-flag no-ops when disarmed, and the failure-recovery
+        # supervisor's edge hook (close a pending recovery's MTTR, raise
+        # typed on a detected peer failure before the next dispatch can
+        # hang on it) is ONE attribute test when SMP_SUPERVISOR=off.
         chaos.on_step_edge(state.step_count)
         preemption.maybe_emergency_save()
+        if supervisor.active:
+            supervisor.on_step_edge()
         return StepOutput(outputs)
 
     # ------------------------------------------------------------------
@@ -242,6 +248,12 @@ class StepFunction:
     # ------------------------------------------------------------------
 
     def _run_compiled(self, model, stacked_args, stacked_kwargs):
+        # Chaos seam: `wedge@step=N:ms=M` hangs HERE — inside dispatch,
+        # after the step-begin edge, before the compiled program runs —
+        # so the rank keeps heartbeating (detector thread) while its
+        # reported step edge stalls: the peers' supervisors must classify
+        # it wedged, not dead. One env lookup when disarmed.
+        chaos.on_step_dispatch(state.step_count)
         cfg = state.cfg
         mesh = state.mesh
         num_mb = cfg.microbatches

@@ -70,8 +70,9 @@ class SMPWatchdogTimeout(SMPRuntimeError):
 
 class SMPPeerLost(SMPRuntimeError):
     """A native-bus peer is unreachable: the send path exhausted its
-    bounded retry/backoff budget (``SMP_BUS_SEND_RETRIES``,
-    ``backend/native.py``). Carries ``peer`` (process index) so recovery
+    bounded retry/backoff budget (``SMP_BUS_SEND_RETRIES``), or a receive
+    /barrier wait found the peer's link already marked dead
+    (``backend/native.py``). Carries ``peer`` (process index) so recovery
     logic can exclude the dead rank instead of parsing the message."""
 
     def __init__(self, peer, message=None):
@@ -79,6 +80,41 @@ class SMPPeerLost(SMPRuntimeError):
         super().__init__(
             message or f"native-bus peer (process {peer}) is unreachable."
         )
+
+
+class SMPCollectiveTimeout(SMPRuntimeError):
+    """A host collective exceeded ``SMP_COLLECTIVE_TIMEOUT``. Unlike the
+    global watchdog (which dumps and raises for ANY stall), this is a
+    per-operation deadline with enough structure for the failure-recovery
+    supervisor to distinguish "slow" from "gone": it carries the group
+    name, the phase (barrier / recv / ...), and the group's last
+    flight-recorder collective sequence number — the coordinate at which
+    this rank's collective stream stopped."""
+
+    def __init__(self, group, phase, last_seq=-1, message=None):
+        self.group = str(group)
+        self.phase = str(phase)
+        self.last_seq = int(last_seq)
+        super().__init__(
+            message
+            or f"host collective over {group} timed out in phase "
+            f"'{phase}' (last collective seq {last_seq}; bound set by "
+            "SMP_COLLECTIVE_TIMEOUT)."
+        )
+
+
+class SMPRecoveryError(SMPRuntimeError):
+    """In-job failure recovery could not complete (rendezvous failed, no
+    common committed checkpoint, world re-initialization failed). The
+    supervisor dumps its detector state + the flight-recorder ring before
+    raising this (``resilience/supervisor.py``)."""
+
+
+class SMPEvicted(SMPRuntimeError):
+    """Surviving peers reformed the world WITHOUT this rank (it was
+    classified dead/wedged — e.g. it was wedged long enough to exhaust
+    ``SMP_WEDGE_TIMEOUT`` and came back after the shrink). The rank must
+    exit instead of training on as a split-brain singleton."""
 
 
 class DelayedParamError(SMPRuntimeError):

@@ -68,6 +68,7 @@ WATCHDOG = "watchdog"
 HEALTH = "health"
 PREEMPT = "preempt"
 CHAOS = "chaos"
+SUPERVISOR = "supervisor"
 
 # Field names per kind, applied at dump time (the ring stores bare
 # tuples). Keeping the schema here — not at the record sites — is what
@@ -85,6 +86,7 @@ _FIELDS = {
     HEALTH: ("event", "tag", "step", "value", "microbatch"),
     PREEMPT: ("event", "step", "detail"),
     CHAOS: ("fault", "detail"),
+    SUPERVISOR: ("event", "peer", "detail", "wall_us"),
 }
 
 
@@ -249,6 +251,26 @@ class FlightRecorder:
         if not self.enabled:
             return
         self.record(CHAOS, str(fault), str(detail))
+
+    def record_supervisor(self, event, peer=-1, detail=""):
+        """Failure-detector / recovery-protocol events
+        (resilience/supervisor.py): detections by kind, the recovery
+        phase edges (rendezvous / reinit / resume / first step), aborts.
+        Carries a wall-clock stamp so ``resilience_probe.py --recovery``
+        can compute per-phase MTTR across dumps without ring-anchor
+        arithmetic."""
+        if not self.enabled:
+            return
+        self.record(SUPERVISOR, str(event), int(peer), str(detail),
+                    int(time.time() * 1e6))
+
+    def last_seq(self, group):
+        """The group's current collective sequence number (the seq the
+        NEXT sequenced collective would get), without consuming it: a
+        typed collective-timeout error carries it as the coordinate where
+        this rank's stream stopped."""
+        with self._seq_lock:
+            return self._seq.get(group, 0)
 
     # -- export ---------------------------------------------------------
 

@@ -742,6 +742,46 @@ def record_chaos(fault, detail=""):
     _flight().record_chaos(fault, detail)
 
 
+def record_failure_detected(kind, peer, detail=""):
+    """One failure-detector classification (resilience/supervisor.py):
+    ``kind`` is dead / wedged / preempted (or flap_cleared when a peer
+    marked dead resumed beating before recovery began)."""
+    telemetry.counter(
+        "smp_failures_detected_total",
+        "peer failures classified by the heartbeat detector",
+    ).labels(kind=kind).inc()
+    _flight().record_supervisor(f"detect_{kind}", peer=peer, detail=detail)
+
+
+def record_recovery(mttr_s, phases=None, survivors=-1):
+    """One completed in-job recovery (resilience/supervisor.py):
+    ``mttr_s`` spans detection to the first trained step in the shrunken
+    world; ``phases`` optionally breaks it down (detect / rendezvous /
+    reshard_load / first_step seconds)."""
+    telemetry.counter(
+        "smp_recoveries_total", "completed in-job shrink-to-survivors recoveries"
+    ).inc()
+    telemetry.gauge(
+        "smp_recovery_seconds",
+        "MTTR of the last recovery (detection -> first step trained)",
+    ).set(float(mttr_s))
+    if survivors >= 0:
+        telemetry.gauge(
+            "smp_recovery_survivors", "world size after the last recovery"
+        ).set(int(survivors))
+    for phase, secs in (phases or {}).items():
+        telemetry.gauge(
+            "smp_recovery_phase_seconds",
+            "per-phase breakdown of the last recovery",
+        ).labels(phase=phase).set(float(secs))
+    _flight().record_supervisor(
+        "recovery_done",
+        detail=f"mttr={mttr_s:.3f}s " + " ".join(
+            f"{k}={v:.3f}" for k, v in (phases or {}).items()
+        ),
+    )
+
+
 def record_elastic_resume(n_layout, n_soft, detail=""):
     """One elastic (topology-mismatched) checkpoint resume
     (resilience/elastic.py): counts of layout-relevant and soft config

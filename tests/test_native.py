@@ -148,6 +148,108 @@ def test_multiprocess_mesh(world):
 
 
 # ---------------------------------------------------------------------------
+# receive-side dead-peer detection (satellite of the recovery supervisor):
+# a wait blocking on a peer whose link the bus has marked dead raises a
+# typed SMPPeerLost immediately instead of burning the full timeout.
+
+
+def test_send_raw_and_drain_bytes_self():
+    bus = _make_bus()
+    port = bus.listen(0)
+    bus.connect(0, 1, [f"127.0.0.1:{port}"])
+    assert bus.send_raw(0, b"1:7", -4) == 0
+    assert bus.send_raw(0, b"2:8", -4) == 0
+    assert bus.drain_bytes(0, -4) == [b"1:7", b"2:8"]
+    assert bus.drain_bytes(0, -4) == []
+    assert not bus.peer_down(0)
+    bus.shutdown()
+
+
+def _dead_peer_victim(rank, world, ports, conn):
+    """Rank 0: receives one frame from rank 1 (establishing the inbound
+    connection + its source identity), then expects rank 1's death to
+    surface as SMPPeerLost on both a recv wait and a group barrier —
+    quickly, not after the 30s timeouts."""
+    import time as _time
+
+    from smdistributed_modelparallel_tpu.backend import native as nat
+    from smdistributed_modelparallel_tpu.utils.exceptions import SMPPeerLost
+
+    lib = nat.load()
+    bus = nat.MessageBus(lib)
+    bus.listen(ports[rank])
+    bus.connect(rank, world, [f"127.0.0.1:{p}" for p in ports])
+    try:
+        assert bus.recv_bytes(1, 500, timeout_ms=30000) == b"hello"
+        # Peer dies now (no second message ever sent). The recv must fail
+        # typed and fast once the EOF lands, and so must a barrier.
+        t0 = _time.monotonic()
+        try:
+            bus.recv_bytes(1, 501, timeout_ms=30000)
+            conn.send(("err", "recv returned instead of raising"))
+            return
+        except SMPPeerLost as e:
+            assert e.peer == 1, e.peer
+        recv_s = _time.monotonic() - t0
+        t0 = _time.monotonic()
+        try:
+            bus.barrier([0, 1], timeout_ms=30000)
+            conn.send(("err", "barrier returned instead of raising"))
+            return
+        except SMPPeerLost as e:
+            assert e.peer == 1, e.peer
+        barrier_s = _time.monotonic() - t0
+        assert bus.peer_down(1)
+        # "Immediately": well under the 30s waits (EOF + one probe slice).
+        assert recv_s < 15 and barrier_s < 15, (recv_s, barrier_s)
+        conn.send(("ok", rank))
+    except Exception as e:  # pragma: no cover - surfaced in parent
+        conn.send(("err", f"rank {rank}: {type(e).__name__}: {e}"))
+    finally:
+        bus.shutdown()
+
+
+def _dead_peer_casualty(rank, world, ports, conn):
+    """Rank 1: send one frame (so rank 0 learns this connection's source),
+    then die hard — os._exit with no bus shutdown, like a SIGKILL."""
+    import os as _os
+    import time as _time
+
+    from smdistributed_modelparallel_tpu.backend import native as nat
+
+    lib = nat.load()
+    bus = nat.MessageBus(lib)
+    bus.listen(ports[rank])
+    bus.connect(rank, world, [f"127.0.0.1:{p}" for p in ports])
+    bus.send_bytes(0, b"hello", 500)
+    _time.sleep(1.0)  # let the frame land before dying
+    conn.send(("ok", rank))
+    _os._exit(0)  # hard exit: kernel closes the sockets, no goodbye
+
+
+def test_recv_and_barrier_raise_peer_lost_on_dead_peer():
+    ctx = mp.get_context("spawn")
+    ports = _free_ports(2)
+    targets = [_dead_peer_victim, _dead_peer_casualty]
+    parents, procs = [], []
+    for rank in range(2):
+        parent, child = ctx.Pipe()
+        p = ctx.Process(
+            target=targets[rank], args=(rank, 2, ports, child), daemon=True
+        )
+        p.start()
+        parents.append(parent)
+        procs.append(p)
+    results = []
+    for parent, p in zip(parents, procs):
+        assert parent.poll(120), "worker timed out"
+        results.append(parent.recv())
+        p.join(timeout=30)
+    errs = [r for r in results if r[0] != "ok"]
+    assert not errs, errs
+
+
+# ---------------------------------------------------------------------------
 # communicator integration (single process)
 
 

@@ -1,0 +1,697 @@
+"""In-job failure recovery: heartbeat detector classification, chaos
+faults that drive it, host-collective deadlines, checkpoint agreement,
+and the recovery-report tooling.
+
+The detector units run against a fake bus with a manual clock — every
+boundary (missed-beat budget, wedged-vs-slow, flap suppression) is a pure
+function of (beats, steps, time), so no processes or sleeps are needed.
+The real 2-process SIGKILL E2E lives in tests/test_multiprocess.py; the
+real dead-link plumbing in tests/test_native.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from smdistributed_modelparallel_tpu.resilience.chaos import chaos
+from smdistributed_modelparallel_tpu.resilience.supervisor import (
+    DEAD,
+    HEARTBEAT_TX,
+    PREEMPTED,
+    WEDGED,
+    FailureDetector,
+    Supervisor,
+    latest_committed_checkpoint,
+    supervisor,
+)
+from smdistributed_modelparallel_tpu.resilience.preemption import (
+    PREEMPT_NOTICE_TX,
+)
+from smdistributed_modelparallel_tpu.utils.exceptions import (
+    SMPCollectiveTimeout,
+    SMPRecoveryError,
+)
+from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeBus:
+    def __init__(self, world=2, rank=0):
+        self.world, self.rank = world, rank
+        self.sent = []        # (dest, payload, tx) of send_raw
+        self.inbox = {}       # (src, tx) -> [payload, ...]
+        self.down = set()
+        self.send_rc = {}     # dest -> forced send_raw rc
+
+    def send_raw(self, dest, payload, tx):
+        self.sent.append((dest, payload, tx))
+        return self.send_rc.get(dest, 0)
+
+    def drain_bytes(self, src, tx, limit=256):
+        return self.inbox.pop((src, tx), [])
+
+    def poll(self, src, tx):
+        return bool(self.inbox.get((src, tx)))
+
+    def peer_down(self, peer):
+        return peer in self.down
+
+    def beat(self, src, seq, step):
+        self.inbox.setdefault((src, HEARTBEAT_TX), []).append(
+            b"%d:%d" % (seq, step)
+        )
+
+
+def make_detector(bus, my_step=0, interval=0.1, budget=5, wedge=1.0):
+    steps = {"n": my_step}
+    det = FailureDetector(
+        bus, my_step=lambda: steps["n"], interval=interval, budget=budget,
+        wedge_s=wedge, clock=lambda: 0.0,
+    )
+    det._steps = steps  # test hook to advance "my" step edge
+    return det
+
+
+class TestDetectorClassification:
+    def test_healthy_peer_stays_healthy(self):
+        bus = FakeBus()
+        det = make_detector(bus)
+        for i in range(10):
+            bus.beat(1, i, i)
+            det._tick(now=i * 0.1)
+        assert det.failures() == {}
+        assert det.peers[1].beats == 10
+
+    def test_missed_beat_budget_exhausted_is_dead(self):
+        bus = FakeBus()
+        det = make_detector(bus, interval=0.1, budget=5)
+        bus.beat(1, 0, 0)
+        det._tick(now=0.0)
+        det._tick(now=0.4)   # 0.4 < 0.5 budget: still healthy
+        assert det.failures() == {}
+        det._tick(now=0.6)   # budget exhausted
+        assert det.failures() == {1: DEAD}
+
+    def test_flap_below_budget_never_classifies(self):
+        """heartbeat_drop-style gap shorter than the budget: no event."""
+        bus = FakeBus()
+        det = make_detector(bus, interval=0.1, budget=5)
+        bus.beat(1, 0, 0)
+        det._tick(now=0.0)
+        det._tick(now=0.2)   # two beats dropped
+        det._tick(now=0.45)  # still inside the budget
+        assert det.failures() == {}
+        bus.beat(1, 1, 1)
+        det._tick(now=0.5)   # beats resumed before exhaustion
+        det._tick(now=0.9)
+        assert det.failures() == {}
+
+    def test_dead_then_revived_is_flap_cleared(self):
+        bus = FakeBus()
+        det = make_detector(bus, interval=0.1, budget=5)
+        bus.beat(1, 0, 0)
+        det._tick(now=0.0)
+        det._tick(now=1.0)
+        assert det.failures() == {1: DEAD}
+        assert det.marked_count == 1
+        bus.beat(1, 1, 1)
+        det._tick(now=1.1)   # fresh life BEFORE recovery began: cleared
+        assert det.failures() == {}
+        assert det.marked_count == 0  # step-edge short-circuit re-engages
+
+    def test_no_flap_clear_once_recovering(self):
+        bus = FakeBus()
+        det = make_detector(bus, interval=0.1, budget=5)
+        bus.beat(1, 0, 0)
+        det._tick(now=0.0)
+        det._tick(now=1.0)
+        assert det.failures() == {1: DEAD}
+        det.recovering = True
+        bus.beat(1, 1, 1)
+        det._tick(now=1.1)
+        assert det.failures() == {1: DEAD}  # stays excluded
+
+    def test_link_dead_classifies_immediately(self):
+        bus = FakeBus()
+        det = make_detector(bus)
+        bus.send_rc[1] = -2  # sender thread gave up
+        det._tick(now=0.0)
+        assert det.failures() == {1: DEAD}
+
+    def test_recv_side_down_classifies_immediately(self):
+        bus = FakeBus()
+        det = make_detector(bus)
+        bus.down.add(1)
+        det._tick(now=0.0)
+        assert det.failures() == {1: DEAD}
+
+    def test_wedged_step_edge_stalls_past_timeout(self):
+        bus = FakeBus()
+        det = make_detector(bus, my_step=0, interval=0.1, wedge=1.0)
+        t = 0.0
+        for i in range(25):  # beats keep arriving, step stuck at 3
+            bus.beat(1, i, 3)
+            det._steps["n"] = 3 + i  # our own edge races ahead
+            det._tick(now=t)
+            t += 0.1
+        assert det.failures() == {1: WEDGED}
+
+    def test_slow_but_advancing_is_not_wedged(self):
+        """Wedged-vs-slow boundary: the edge moves (slowly) within the
+        timeout, so the peer is slow, not stuck."""
+        bus = FakeBus()
+        det = make_detector(bus, interval=0.1, wedge=1.0)
+        t = 0.0
+        for i in range(25):
+            bus.beat(1, i, i // 8)  # advances every 0.8s < 1.0s timeout
+            det._steps["n"] = i
+            det._tick(now=t)
+            t += 0.1
+        assert det.failures() == {}
+
+    def test_globally_idle_world_wedges_nobody(self):
+        """Our own edge never moved past the peer's: watchdog territory,
+        not a peer failure."""
+        bus = FakeBus()
+        det = make_detector(bus, my_step=3, interval=0.1, wedge=1.0)
+        t = 0.0
+        for i in range(25):
+            bus.beat(1, i, 3)
+            det._tick(now=t)
+            t += 0.1
+        assert det.failures() == {}
+
+    def test_preempt_notice_classifies_preempted_not_failed(self):
+        bus = FakeBus()
+        det = make_detector(bus)
+        bus.inbox[(1, PREEMPT_NOTICE_TX)] = [b"preempt"]
+        det._tick(now=0.0)
+        assert det.peers[1].kind == PREEMPTED
+        # Not a recovery target, and the notice is left for the
+        # preemption listener to consume.
+        assert det.failures() == {}
+        assert bus.inbox[(1, PREEMPT_NOTICE_TX)] == [b"preempt"]
+
+    def test_heartbeats_ride_reserved_tx(self):
+        bus = FakeBus()
+        det = make_detector(bus)
+        det._tick(now=0.0)
+        assert bus.sent and all(tx == HEARTBEAT_TX for _, _, tx in bus.sent)
+        seq, _, step = bus.sent[0][1].partition(b":")
+        assert int(seq) == 1 and int(step) == 0
+
+    def test_force_dead_marks_only_healthy_peers(self):
+        bus = FakeBus()
+        det = make_detector(bus)
+        det.force_dead(1, why="caller evidence")
+        assert det.failures() == {1: DEAD}
+        det.force_dead(1, why="again")  # no double-marking
+        assert det.failures() == {1: DEAD}
+
+
+class TestChaosFaults:
+    def setup_method(self):
+        os.environ.pop("SMP_CHAOS", None)
+        chaos.reset()
+
+    teardown_method = setup_method
+
+    def test_kill_rule_delivers_sigkill(self, monkeypatch):
+        import signal
+
+        calls = []
+        monkeypatch.setattr(os, "kill", lambda pid, sig: calls.append(sig))
+        os.environ["SMP_CHAOS"] = "kill@step=2"
+        chaos.on_step_edge(1)
+        assert calls == []
+        chaos.on_step_edge(2)
+        assert calls == [signal.SIGKILL]
+        chaos.on_step_edge(2)  # fires once
+        assert calls == [signal.SIGKILL]
+
+    def test_wedge_rule_hangs_dispatch(self):
+        os.environ["SMP_CHAOS"] = "wedge@step=1:ms=80"
+        t0 = time.monotonic()
+        chaos.on_step_dispatch(0)
+        assert time.monotonic() - t0 < 0.05  # wrong step: no hang
+        t0 = time.monotonic()
+        chaos.on_step_dispatch(1)
+        assert time.monotonic() - t0 >= 0.08
+        t0 = time.monotonic()
+        chaos.on_step_dispatch(1)  # fires once
+        assert time.monotonic() - t0 < 0.05
+
+    def test_heartbeat_drop_drops_count_beats(self):
+        os.environ["SMP_CHAOS"] = "heartbeat_drop@rank=0:count=3"
+        drops = [chaos.on_heartbeat(1) for _ in range(5)]
+        assert drops == [True, True, True, False, False]
+
+    def test_heartbeat_drop_other_rank_is_noop(self):
+        os.environ["SMP_CHAOS"] = "heartbeat_drop@rank=7:count=3"
+        assert chaos.on_heartbeat(1) is False
+
+    def test_detector_integration_drop_below_budget_no_false_positive(self):
+        """The detector sends through the chaos seam: a drop burst below
+        the miss budget must not classify the peer dead."""
+        os.environ["SMP_CHAOS"] = "heartbeat_drop@rank=0:count=2"
+        bus = FakeBus()
+        det = make_detector(bus, interval=0.1, budget=5)
+        for i in range(6):
+            bus.beat(1, i, i)
+            det._tick(now=i * 0.1)
+        assert len(bus.sent) == 4  # 2 of 6 beats dropped
+        assert det.failures() == {}
+
+    def test_injections_counted(self):
+        os.environ["SMP_CHAOS"] = "heartbeat_drop@rank=0:count=1"
+        chaos.on_heartbeat(1)
+        rep = telemetry.report()["metrics"]["smp_chaos_injected_total"]
+        kinds = {
+            s["labels"].get("fault"): s["value"] for s in rep["series"]
+        }
+        assert kinds.get("heartbeat_drop", 0) >= 1
+
+
+class TestCollectiveTimeout:
+    def test_int_recv_times_out_typed(self, monkeypatch):
+        from smdistributed_modelparallel_tpu.backend.collectives import (
+            CollectiveCommunicator,
+        )
+
+        class NeverBus:
+            def recv_bytes(self, src, tx, timeout_ms=-1):
+                assert timeout_ms == 100  # the env deadline, not -1
+                raise TimeoutError("nothing")
+
+        comm = CollectiveCommunicator()
+        monkeypatch.setattr(comm, "_get_bus", lambda what: NeverBus())
+        monkeypatch.setenv("SMP_COLLECTIVE_TIMEOUT", "0.1")
+        with pytest.raises(SMPCollectiveTimeout) as ei:
+            comm._int_recv(1, group="TP_GROUP", phase="allgather")
+        assert ei.value.group == "TP_GROUP"
+        assert ei.value.phase == "allgather"
+        assert ei.value.last_seq >= 0
+
+    def test_unset_env_keeps_unbounded_wait(self, monkeypatch):
+        from smdistributed_modelparallel_tpu.backend.collectives import (
+            CollectiveCommunicator,
+        )
+
+        class EchoBus:
+            def recv_bytes(self, src, tx, timeout_ms=-1):
+                assert timeout_ms == -1
+                import pickle
+
+                return pickle.dumps("ok")
+
+        comm = CollectiveCommunicator()
+        monkeypatch.setattr(comm, "_get_bus", lambda what: EchoBus())
+        monkeypatch.delenv("SMP_COLLECTIVE_TIMEOUT", raising=False)
+        out, _ = comm._int_recv(1, group="TP_GROUP")
+        assert out == "ok"
+
+    def test_barrier_deadline_is_typed(self, monkeypatch):
+        import jax
+
+        from smdistributed_modelparallel_tpu.backend import collectives
+
+        comm = collectives.CollectiveCommunicator()
+
+        class SlowBus:
+            def barrier(self, ranks, timeout_ms=600000):
+                time.sleep(timeout_ms / 1000.0)
+                raise OSError("bus barrier over [0, 1] failed")
+
+        monkeypatch.setattr(comm, "_get_bus", lambda what: SlowBus())
+        monkeypatch.setattr(
+            comm, "group_processes", lambda group=None: [0, 1]
+        )
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        monkeypatch.setenv("SMP_COLLECTIVE_TIMEOUT", "0.1")
+        from smdistributed_modelparallel_tpu.backend.collectives import (
+            CommGroup,
+        )
+
+        with pytest.raises(SMPCollectiveTimeout) as ei:
+            comm.barrier(group=CommGroup.TP_GROUP)
+        assert ei.value.phase == "barrier"
+        assert ei.value.group == "TP_GROUP"
+
+
+class TestSupervisorOffIsFree:
+    def test_off_by_default_no_thread_no_traffic(self, monkeypatch):
+        monkeypatch.delenv("SMP_SUPERVISOR", raising=False)
+        sup = Supervisor()
+        assert sup.start() is False
+        assert sup.active is False
+        assert sup.detector is None
+
+    def test_step_seam_is_one_attribute_test(self):
+        """step.py guards the edge hook with `supervisor.active` — when
+        off, on_step_edge is never entered."""
+        src = open(os.path.join(
+            _REPO, "smdistributed_modelparallel_tpu", "step.py"
+        )).read()
+        assert "if supervisor.active:" in src
+
+    def test_recover_without_detector_reraises(self):
+        sup = Supervisor()
+        err = ValueError("boom")
+        with pytest.raises(ValueError):
+            sup.recover(error=err)
+        with pytest.raises(SMPRecoveryError):
+            sup.recover()
+
+
+class TestCheckpointAgreement:
+    def _mk_ckpt(self, root, tag, step, committed=True):
+        import pickle
+
+        d = os.path.join(root, f"{tag}_partial")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "smp_config.pt"), "wb") as fh:
+            pickle.dump({"step_count": step}, fh)
+        if committed:
+            with open(os.path.join(d, ".committed"), "w") as fh:
+                fh.write(tag)
+
+    def test_latest_committed_prefers_newest_pointer(self, tmp_path):
+        root = str(tmp_path)
+        self._mk_ckpt(root, "a", 5)
+        self._mk_ckpt(root, "b", 7)
+        with open(os.path.join(root, "newest"), "w") as fh:
+            fh.write("a")
+        assert latest_committed_checkpoint(root) == ("a", 5)
+
+    def test_latest_committed_falls_back_to_highest_step(self, tmp_path):
+        root = str(tmp_path)
+        self._mk_ckpt(root, "a", 5)
+        self._mk_ckpt(root, "b", 7)
+        self._mk_ckpt(root, "c", 9, committed=False)  # interrupted: skip
+        assert latest_committed_checkpoint(root) == ("b", 7)
+
+    def test_latest_committed_tag_parse_fallback(self, tmp_path):
+        import pickle
+
+        root = str(tmp_path)
+        d = os.path.join(root, "step_12_partial")
+        os.makedirs(d)
+        with open(os.path.join(d, "smp_config.pt"), "wb") as fh:
+            pickle.dump({}, fh)  # no step_count stamp (old checkpoint)
+        with open(os.path.join(d, ".committed"), "w") as fh:
+            fh.write("step_12")
+        assert latest_committed_checkpoint(root) == ("step_12", 12)
+
+    def test_latest_committed_empty(self, tmp_path):
+        assert latest_committed_checkpoint(str(tmp_path)) is None
+        assert latest_committed_checkpoint(None) is None
+
+    def test_agreement_takes_weakest_report(self):
+        sup = Supervisor()
+        infos = {
+            0: {"ckpt": ["step_7", 7]},
+            2: {"ckpt": ["step_5", 5]},
+        }
+        assert sup._agree_checkpoint(infos, [0, 2]) == ("step_5", 5)
+
+    def test_agreement_requires_every_survivor(self):
+        sup = Supervisor()
+        sup._recover_ckpt_path = "/nonexistent"
+        infos = {0: {"ckpt": ["step_7", 7]}, 2: {"ckpt": None}}
+        with pytest.raises(SMPRecoveryError):
+            sup._agree_checkpoint(infos, [0, 2])
+
+
+class RendezvousBus(FakeBus):
+    """FakeBus + the barrier/exchange surface the rendezvous uses."""
+
+    def __init__(self, world=3, rank=0):
+        super().__init__(world=world, rank=rank)
+        self.barrier_script = []   # per-call: None=ok, exc=raise
+        self.barriers = []
+        self.after_barrier = []    # (src, tx, obj) delivered post-barrier
+
+    def barrier(self, ranks, timeout_ms=600000):
+        self.barriers.append(list(ranks))
+        if self.barrier_script:
+            exc = self.barrier_script.pop(0)
+            if exc is not None:
+                raise exc
+        # Peers' exchange frames land AFTER the barrier in the real
+        # protocol (they are sent post-barrier) — pre-loaded frames would
+        # be wiped by the rendezvous's stale-frame drain.
+        for src, tx, obj in self.after_barrier:
+            self.put(src, tx, obj)
+
+    def send_bytes(self, dest, payload, tx):
+        self.sent.append((dest, payload, tx))
+
+    def recv_bytes(self, src, tx, timeout_ms=-1):
+        q = self.inbox.get((src, tx))
+        if q:
+            return q.pop(0)
+        raise TimeoutError(f"nothing from {src}")
+
+    def put(self, src, tx, obj):
+        self.inbox.setdefault((src, tx), []).append(
+            json.dumps(obj).encode()
+        )
+
+
+class TestRendezvous:
+    def _sup(self, tmp_path):
+        sup = Supervisor()
+        sup._recover_ckpt_path = str(tmp_path)
+        return sup
+
+    def test_exchange_converges(self, tmp_path):
+        from smdistributed_modelparallel_tpu.resilience.supervisor import (
+            RECOVERY_TX,
+        )
+
+        sup = self._sup(tmp_path)
+        bus = RendezvousBus(world=3, rank=0)
+        bus.after_barrier = [(2, RECOVERY_TX, {
+            "rank": 2, "failed": [1], "step": 4, "ckpt": ["step_3", 3],
+        })]
+        survivors, infos = sup._rendezvous(bus, [0, 2], {1: DEAD}, 5.0)
+        assert survivors == [0, 2]
+        assert set(infos) == {0, 2}
+        assert "coord" in infos[0]  # me == min survivor picks the endpoint
+
+    def test_survivor_dying_at_barrier_is_dropped(self, tmp_path):
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            SMPPeerLost,
+        )
+
+        sup = self._sup(tmp_path)
+        bus = RendezvousBus(world=3, rank=0)
+        bus.barrier_script = [SMPPeerLost(2)]
+        failures = {1: DEAD}
+        survivors, infos = sup._rendezvous(bus, [0, 2], failures, 5.0)
+        assert survivors == [0]
+        assert failures == {1: DEAD, 2: DEAD}
+        assert 0 in infos  # solo fallback still reports a view
+
+    def test_survivor_dying_before_info_is_dropped(self, tmp_path):
+        """The exchange recv failing (timeout / peer lost) drops that
+        peer and retries instead of aborting the whole recovery — and
+        never leaves the return value unbound."""
+        sup = self._sup(tmp_path)
+        bus = RendezvousBus(world=3, rank=0)
+        # Barrier always passes; peer 2's info never arrives.
+        survivors, infos = sup._rendezvous(bus, [0, 2], {1: DEAD}, 5.0)
+        assert survivors == [0]
+        assert 0 in infos
+
+    def test_self_in_failed_union_raises_evicted(self, tmp_path):
+        from smdistributed_modelparallel_tpu.resilience.supervisor import (
+            RECOVERY_TX,
+        )
+        from smdistributed_modelparallel_tpu.utils.exceptions import (
+            SMPEvicted,
+        )
+
+        sup = self._sup(tmp_path)
+        bus = RendezvousBus(world=3, rank=0)
+        bus.after_barrier = [(2, RECOVERY_TX, {
+            "rank": 2, "failed": [0, 1], "step": 4, "ckpt": ["step_3", 3],
+        })]
+        with pytest.raises(SMPEvicted):
+            sup._rendezvous(bus, [0, 2], {1: DEAD}, 5.0)
+
+
+class TestRecoverErrorHandling:
+    def _armed(self):
+        sup = Supervisor()
+        bus = FakeBus(world=2, rank=0)
+        sup.detector = FailureDetector(
+            bus, my_step=lambda: 0, interval=0.01, budget=1, wedge_s=1.0,
+            clock=time.monotonic,
+        )
+        return sup
+
+    def test_non_peer_error_reraised_untouched(self, monkeypatch):
+        """A step error with no peer failure behind it comes back as the
+        ORIGINAL exception — no SMPRecoveryError wrapper, no abort dump —
+        and the detector's flap-clearing is re-enabled afterwards."""
+        monkeypatch.setenv("SMP_EMERGENCY_CKPT_PATH", "/nonexistent")
+        sup = self._armed()
+        boom = ValueError("oom-ish")
+        aborts = []
+        monkeypatch.setattr(sup, "_abort", lambda r: aborts.append(r))
+        with pytest.raises(ValueError) as ei:
+            sup.recover(error=boom)
+        assert ei.value is boom
+        assert aborts == []
+        assert sup.detector.recovering is False
+        assert sup._recovering is False
+
+    def test_failed_recovery_reenables_flap_clearing(self, monkeypatch):
+        monkeypatch.setenv("SMP_EMERGENCY_CKPT_PATH", "/nonexistent")
+        sup = self._armed()
+        sup.detector.force_dead(1, why="test")
+        # ckpt root has no committed checkpoint -> rendezvous/agreement
+        # fails -> SMPRecoveryError; the detector must come back usable.
+        monkeypatch.setattr(sup, "_abort", lambda r: None)
+        with pytest.raises(SMPRecoveryError):
+            sup.recover()
+        assert sup.detector is not None
+        assert sup.detector.recovering is False
+
+
+class TestRecoveryReportTool:
+    def _write_dumps(self, root, with_abort=False, with_recovery=True):
+        os.makedirs(root, exist_ok=True)
+        tele = {
+            "meta": {"rank": 0, "world": 2},
+            "metrics": {
+                "smp_failures_detected_total": {
+                    "kind": "counter", "help": "", "series": [
+                        {"labels": {"kind": "dead"}, "value": 1},
+                    ],
+                },
+                "smp_recoveries_total": {
+                    "kind": "counter", "help": "", "series": [
+                        {"labels": {}, "value": 1 if with_recovery else 0},
+                    ],
+                },
+            },
+        }
+        with open(os.path.join(root, "tm.json.rank0"), "w") as fh:
+            json.dump(tele, fh)
+        events = [
+            {"kind": "meta", "rank": 0, "world": 2},
+            {"kind": "supervisor", "event": "detect_dead", "peer": 1,
+             "detail": "missed-beat budget", "wall_us": 1_000_000},
+            {"kind": "supervisor", "event": "recover_begin", "peer": -1,
+             "detail": "world=2", "wall_us": 2_000_000},
+            {"kind": "supervisor", "event": "ckpt_agreed", "peer": -1,
+             "detail": "tag=step_2 step=2", "wall_us": 2_100_000},
+            {"kind": "supervisor", "event": "rendezvous_ok", "peer": -1,
+             "detail": "survivors=[0]", "wall_us": 2_200_000},
+            {"kind": "supervisor", "event": "resume_done", "peer": -1,
+             "detail": "tag=step_2", "wall_us": 3_000_000},
+        ]
+        if with_recovery:
+            events.append(
+                {"kind": "supervisor", "event": "recovery_done", "peer": -1,
+                 "detail": "mttr=4.200s detect=1.000 rendezvous=0.200 "
+                           "reshard_load=2.000 first_step=1.000",
+                 "wall_us": 4_000_000}
+            )
+        if with_abort:
+            events.append(
+                {"kind": "supervisor", "event": "abort", "peer": -1,
+                 "detail": "no committed checkpoint", "wall_us": 5_000_000}
+            )
+        with open(os.path.join(root, "fr.jsonl.rank0"), "w") as fh:
+            for ev in events:
+                fh.write(json.dumps(ev) + "\n")
+
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "scripts", "resilience_probe.py"),
+             *args],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_report_joins_dumps(self, tmp_path):
+        root = str(tmp_path / "dumps")
+        self._write_dumps(root)
+        out = self._run(root, "--recovery", "--json")
+        assert out.returncode == 0, out.stderr
+        rep = json.loads(out.stdout)
+        assert rep["detections"] == {"dead": 1}
+        assert rep["recoveries_total"] == 1
+        assert len(rep["recoveries"]) == 1
+        rec = rep["recoveries"][0]
+        assert rec["mttr_s"] == pytest.approx(4.2)
+        assert rec["phases"] == {
+            "detect": 1.0, "rendezvous": 0.2,
+            "reshard_load": 2.0, "first_step": 1.0,
+        }
+        assert rep["problems"] == []
+
+    def test_check_gate_passes_clean(self, tmp_path):
+        root = str(tmp_path / "dumps")
+        self._write_dumps(root)
+        out = self._run(root, "--recovery", "--check",
+                        "--min-recoveries", "1")
+        assert out.returncode == 0, out.stdout
+
+    def test_check_gate_fails_on_abort(self, tmp_path):
+        root = str(tmp_path / "dumps")
+        self._write_dumps(root, with_abort=True)
+        out = self._run(root, "--recovery", "--check")
+        assert out.returncode == 2
+        assert "abort" in out.stdout.lower()
+
+    def test_check_gate_fails_on_count_mismatch(self, tmp_path):
+        root = str(tmp_path / "dumps")
+        self._write_dumps(root, with_recovery=False)
+        # telemetry says 0 recoveries, ring has none either -> consistent;
+        # min-recoveries makes it fail.
+        out = self._run(root, "--recovery", "--check",
+                        "--min-recoveries", "1")
+        assert out.returncode == 2
+
+    def test_check_gate_fails_on_slow_mttr(self, tmp_path):
+        root = str(tmp_path / "dumps")
+        self._write_dumps(root)
+        out = self._run(root, "--recovery", "--check", "--max-mttr", "1")
+        assert out.returncode == 2
+        assert "exceeds" in out.stdout
+
+
+class TestStepEdgeClosure:
+    def test_pending_recovery_closes_at_first_step(self):
+        sup = Supervisor()
+        now = time.monotonic()
+        sup._await_first_step = {
+            "survivors": 1, "t_detect": now - 4.0,
+            "t_resume_done": now - 1.0,
+            "phases": {"detect": 1.0, "rendezvous": 0.5,
+                       "reshard_load": 1.5},
+        }
+        sup.active = True
+        sup.on_step_edge()
+        assert sup._await_first_step is None
+        assert sup.last_report is not None
+        rep = telemetry.report()["metrics"]
+        mttr = rep["smp_recovery_seconds"]["series"][0]["value"]
+        assert 3.5 < mttr < 10.0
+        phases = {
+            s["labels"]["phase"]: s["value"]
+            for s in rep["smp_recovery_phase_seconds"]["series"]
+        }
+        assert set(phases) == {
+            "detect", "rendezvous", "reshard_load", "first_step"
+        }
+        assert phases["first_step"] >= 0.9
