@@ -405,6 +405,137 @@ def _pipeline_interleave_probe(deadline):
     sys.stderr.flush()
 
 
+def _compile_cache_probe(deadline):
+    """SMP_BENCH_COMPILE_PROBE=1: cold/warm compile A/B through the
+    persistent executable cache (smp.exec_cache).
+
+    Builds one small step config twice: the first build compiles fresh
+    and stores the executable; the second (after a full smp.reset, the
+    in-process analogue of a cold start) deserializes it from disk.
+    ``cold_s``/``warm_s`` are the compile-phase walls (XLA compile vs
+    deserialize+verify — the cost the cache removes; trace+lower is paid
+    identically by both legs and reported as ``lower_s``);
+    ``cold_wall_s``/``warm_wall_s`` are the full first-call walls a
+    recovering/resuming job actually waits. Emits one stderr JSON line
+    and returns the block stamped into BENCH_r*.json as ``"exec_cache"``
+    (schema-checked by scripts/perf_ledger.py)."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+    import optax
+
+    import smdistributed_modelparallel_tpu as smp
+    from smdistributed_modelparallel_tpu.models.gpt2 import gpt2_124m
+    from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+
+    if time.time() > deadline - 30:
+        sys.stderr.write(
+            "bench: compile probe skipped (probe window exhausted)\n"
+        )
+        return None
+    user_dir = os.environ.get("SMP_EXEC_CACHE_DIR")
+    prev_on = os.environ.get("SMP_EXEC_CACHE")
+    tmp = None
+    if user_dir is None:
+        tmp = tempfile.mkdtemp(prefix="smp_exec_cache_bench_")
+    os.environ["SMP_EXEC_CACHE"] = "on"
+    os.environ["SMP_EXEC_CACHE_DIR"] = user_dir or tmp
+    try:
+        seq, batch = 64, 4
+        ids = None
+
+        def run_once():
+            nonlocal ids
+            smp.reset()
+            smp.init({"microbatches": 2})
+            import jax as _jax
+
+            model = smp.DistributedModel(gpt2_124m(
+                max_len=seq, d_model=128, n_layers=2, n_heads=4,
+            ))
+            optimizer = smp.DistributedOptimizer(optax.adamw(1e-4), model)
+
+            @smp.step
+            def train_step(model, batch_ids):
+                logits = model(batch_ids)
+                loss = jnp.mean(logits.astype(jnp.float32) ** 2)
+                model.backward(loss)
+                return loss
+
+            if ids is None:
+                ids = _jax.random.randint(
+                    _jax.random.key(0), (batch, seq), 0, 50257
+                )
+            t0 = time.perf_counter()
+            out = train_step(model, ids)
+            optimizer.step()
+            loss = _readback(out.reduce_mean())
+            wall = time.perf_counter() - t0
+            # Per-leg telemetry (run_once reset the registry on entry, so
+            # only this leg's series exist).
+            rep = telemetry.report()["metrics"]
+
+            def _hsum(name, **labels):
+                for s in rep.get(name, {"series": []})["series"]:
+                    if all(s["labels"].get(k) == v
+                           for k, v in labels.items()):
+                        return s.get("sum", 0.0)
+                return 0.0
+
+            fam = rep.get("smp_exec_cache_total", {"series": []})
+            outcomes = {
+                s["labels"]["result"]: s["value"] for s in fam["series"]
+            }
+            return {
+                "wall": wall, "loss": loss, "outcomes": outcomes,
+                "fresh": _hsum("smp_step_compile_seconds", source="fresh"),
+                "cached": _hsum(
+                    "smp_step_compile_seconds", source="disk_cache"
+                ),
+                "lower": _hsum("smp_step_lower_seconds"),
+            }
+
+        cold = run_once()   # fresh compile + store
+        warm = run_once()   # deserialize from disk
+        hit = warm["outcomes"].get("hit", 0) >= 1
+        if not hit:
+            sys.stderr.write(
+                "bench: compile probe's warm leg did NOT hit the cache "
+                f"(outcomes {warm['outcomes']}) — speedup below reflects "
+                "a recompile, not a warm start.\n"
+            )
+        cold_s = cold["fresh"]
+        warm_s = warm["cached"] if hit else warm["fresh"]
+        result = {
+            "component": "exec_cache",
+            "cold_s": round(cold_s, 3),
+            "warm_s": round(warm_s, 3),
+            "speedup": round(cold_s / warm_s, 3) if warm_s > 0 else None,
+            "lower_s": round(warm["lower"], 3),
+            "cold_wall_s": round(cold["wall"], 3),
+            "warm_wall_s": round(warm["wall"], 3),
+            "cache_hit": bool(hit),
+            "bit_identical": bool(cold["loss"] == warm["loss"]),
+        }
+        sys.stderr.write(json.dumps(result) + "\n")
+        sys.stderr.flush()
+        return result
+    except Exception as e:  # the probe must never kill the bench
+        sys.stderr.write(f"bench: compile probe failed ({e!r})\n")
+        return None
+    finally:
+        smp.reset()
+        if prev_on is None:
+            os.environ.pop("SMP_EXEC_CACHE", None)
+        else:
+            os.environ["SMP_EXEC_CACHE"] = prev_on
+        if user_dir is None:
+            os.environ.pop("SMP_EXEC_CACHE_DIR", None)
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     start_time = time.time()
     probe_window = int(os.environ.get("SMP_BENCH_PROBE_WINDOW", 1200))
@@ -715,12 +846,20 @@ def main():
         # must not be used after it.
         _pipeline_interleave_probe(deadline=start_time + probe_window)
 
+    exec_cache_out = None
+    if os.environ.get("SMP_BENCH_COMPILE_PROBE", "0") == "1":
+        # Also re-inits the framework; anything after this point must not
+        # touch the headline model/step objects.
+        exec_cache_out = _compile_cache_probe(
+            deadline=start_time + probe_window
+        )
+
     from smdistributed_modelparallel_tpu.ops.attention import _pallas_ok
 
     q_probe = jnp.zeros((batch // num_mb, seq_len, 12, 64), jnp.bfloat16)
     attn_path = "pallas_flash" if _pallas_ok(q_probe, q_probe, q_probe) else "xla_jnp"
 
-    print(json.dumps({
+    result = {
         "metric": "tokens/sec/chip GPT-2-124M train step"
                   + ("" if on_tpu else " (CPU smoke, reduced model)"),
         "value": round(tok_per_sec_chip, 2),
@@ -740,7 +879,10 @@ def main():
         "roofline": roofline_out,
         "hlo_audit": hlo_audit_out,
         "final_loss": round(final_loss, 4),
-    }))
+    }
+    if exec_cache_out is not None:
+        result["exec_cache"] = exec_cache_out
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

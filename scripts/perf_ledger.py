@@ -39,6 +39,10 @@ CI stays unflaky):
   previous on-chip evidence must have a ``## Round N`` entry in
   BENCH_NOTES.md explaining it (notes-sourced evidence is documented by
   construction);
+- the ``exec_cache`` block (bench.py SMP_BENCH_COMPILE_PROBE: cold vs
+  warm compile A/B through the persistent executable cache) is
+  schema-checked when present (numeric ``cold_s``/``warm_s``/``speedup``,
+  internally consistent) and rendered per round;
 - the ``hlo_audit`` block (bench.py >= round 9: the headline program's
   X-ray summary — fingerprint, collective ops/bytes by kind, remat
   fraction, replicated bytes) is schema-checked when present, and
@@ -159,6 +163,27 @@ def _audit_schema_problem(audit):
     return None
 
 
+def _exec_cache_schema_problem(probe):
+    """Why a round's ``exec_cache`` block (bench.py
+    SMP_BENCH_COMPILE_PROBE cold/warm compile A/B) is malformed, or None.
+    Absent blocks are fine — rounds predating the cache, or probe not
+    requested."""
+    if probe is None:
+        return None
+    if not isinstance(probe, dict):
+        return f"'exec_cache' must be an object, got {type(probe).__name__}"
+    if probe.get("component") != "exec_cache":
+        return "'exec_cache.component' must be the string 'exec_cache'"
+    for key in ("cold_s", "warm_s", "speedup"):
+        if not isinstance(probe.get(key), (int, float)):
+            return f"'exec_cache' lacks a numeric '{key}'"
+    if probe["warm_s"] > 0 and abs(
+        probe["speedup"] - probe["cold_s"] / probe["warm_s"]
+    ) > max(0.05 * probe["speedup"], 0.05):
+        return "'exec_cache.speedup' inconsistent with cold_s/warm_s"
+    return None
+
+
 def build_ledger(repo, threshold=0.05):
     """The full trajectory + verdict dict (see module docstring)."""
     rounds = []
@@ -199,6 +224,7 @@ def build_ledger(repo, threshold=0.05):
             "roofline": None,
             "schedule": None,
             "hlo_audit": None,
+            "exec_cache": None,
             "documented": n in documented,
         }
         if rc == 0:
@@ -224,6 +250,12 @@ def build_ledger(repo, threshold=0.05):
                     problems.append(f"{name}: {audit_problem}")
                     audit = None
                 row["hlo_audit"] = audit
+                probe = parsed.get("exec_cache")
+                probe_problem = _exec_cache_schema_problem(probe)
+                if probe_problem:
+                    problems.append(f"{name}: {probe_problem}")
+                    probe = None
+                row["exec_cache"] = probe
                 row.update(
                     on_chip=_is_on_chip(parsed),
                     vs_baseline=parsed["vs_baseline"],
@@ -349,6 +381,10 @@ def render_table(ledger, out=sys.stdout):
             if audit.get("replicated_bytes"):
                 parts.append(f"!! replicated {audit['replicated_bytes']:,}B")
             w(f"{'':>7}xray: " + "  ".join(parts) + "\n")
+        probe = r.get("exec_cache")
+        if isinstance(probe, dict):
+            w(f"{'':>7}exec_cache: cold {probe['cold_s']:.2f}s  warm "
+              f"{probe['warm_s']:.2f}s  speedup {probe['speedup']:.1f}x\n")
     if ledger["best_on_chip"]:
         b = ledger["best_on_chip"]
         w(f"\nbest on-chip:   round {b['round']}  vs_baseline "

@@ -31,7 +31,11 @@ per-recovery MTTR breakdown (detect → rendezvous → reshard-load → first
 step) reconstructed from the supervisor's flight-recorder events.
 ``--check`` turns it into a CI gate: non-zero exit on recovery aborts,
 inconsistent telemetry-vs-ring recovery counts, unbounded/absent MTTR, or
-fewer than ``--min-recoveries`` completed recoveries.
+fewer than ``--min-recoveries`` completed recoveries. Recoveries also
+report warm-vs-cold ``first_step`` (the supervisor splits the recompile
+into ``compile_from_cache`` / ``compile_fresh`` when the persistent
+executable cache is in play); ``--max-cold-recoveries`` gates on it, so
+CI can assert recoveries actually hit the cache.
 
 Exit status: 0 when the selected checkpoint is loadable (or the recovery
 gate passes), 2 when not, 1 on usage errors.
@@ -291,6 +295,9 @@ def _counter_series(dump, metric):
 
 
 _PHASE_ORDER = ("detect", "rendezvous", "reshard_load", "first_step")
+# Optional phases stamped when the executable cache is in play: the
+# first_step compile cost split by source (resilience/supervisor.py).
+_COMPILE_PHASES = ("compile_from_cache", "compile_fresh")
 
 
 def _parse_recovery_detail(detail):
@@ -328,11 +335,21 @@ def _recoveries_from_ring(events):
             rec = {
                 "mttr_s": phases.pop("mttr", None),
                 "phases": {
-                    p: phases.get(p) for p in _PHASE_ORDER if p in phases
+                    p: phases.get(p)
+                    for p in _PHASE_ORDER + _COMPILE_PHASES
+                    if p in phases
                 },
                 "ckpt": (current or {}).get("ckpt", ""),
                 "done_wall_us": ev.get("wall_us"),
             }
+            # Warm vs cold first_step: warm means the recovery's
+            # recompile(s) all came from the executable cache. Dumps
+            # predating the cache (no compile phases) are "unknown".
+            if any(p in rec["phases"] for p in _COMPILE_PHASES):
+                cold = rec["phases"].get("compile_fresh") or 0.0
+                rec["first_step_source"] = "cold" if cold > 0 else "warm"
+            else:
+                rec["first_step_source"] = "unknown"
             recoveries.append(rec)
             current = None
         elif name == "abort":
@@ -341,7 +358,7 @@ def _recoveries_from_ring(events):
     return recoveries, aborts
 
 
-def recovery_report(root, max_mttr=600.0):
+def recovery_report(root, max_mttr=600.0, max_cold_recoveries=None):
     telemetry, flights = _load_dumps(root)
     report = {
         "root": root,
@@ -400,6 +417,21 @@ def recovery_report(root, max_mttr=600.0):
                 f"{where}: phase breakdown incomplete (missing "
                 f"{', '.join(missing)})"
             )
+    # Executable-cache gate: CI can assert recoveries actually warm-start
+    # from the cache. A recovery without compile-source phases cannot
+    # prove it was warm, so under the gate it counts as cold.
+    if max_cold_recoveries is not None:
+        cold = [
+            r for r in report["recoveries"]
+            if r.get("first_step_source") != "warm"
+        ]
+        report["cold_recoveries"] = len(cold)
+        if len(cold) > max_cold_recoveries:
+            report["problems"].append(
+                f"{len(cold)} recover(ies) compiled fresh (or could not "
+                f"prove a cache hit); --max-cold-recoveries "
+                f"{max_cold_recoveries}"
+            )
     return report
 
 
@@ -417,11 +449,14 @@ def _render_recovery(report):
           f"{report['recoveries_total']}")
     for r in report["recoveries"]:
         phases = "  ".join(
-            f"{p}={r['phases'][p]:.3f}s" for p in _PHASE_ORDER
+            f"{p}={r['phases'][p]:.3f}s"
+            for p in _PHASE_ORDER + _COMPILE_PHASES
             if r["phases"].get(p) is not None
         )
         mttr = f"{r['mttr_s']:.3f}s" if r.get("mttr_s") else "?"
-        print(f"  rank {r.get('rank')}: MTTR {mttr}  [{phases}]  "
+        src = r.get("first_step_source", "unknown")
+        tag = "" if src == "unknown" else f"  first_step={src}"
+        print(f"  rank {r.get('rank')}: MTTR {mttr}  [{phases}]{tag}  "
               f"{r.get('ckpt', '')}")
     for a in report["aborts"]:
         print(f"  ABORT rank {a.get('rank')}: {a.get('reason')}")
@@ -455,6 +490,11 @@ def main(argv=None):
     ap.add_argument("--min-recoveries", type=int, default=0,
                     help="with --recovery --check: fail when fewer "
                     "completed recoveries were recorded")
+    ap.add_argument("--max-cold-recoveries", type=int, default=None,
+                    help="with --recovery --check: fail when more than "
+                    "this many recoveries compiled fresh instead of "
+                    "warm-starting from the executable cache (recoveries "
+                    "without compile-source phases count as cold)")
     args = ap.parse_args(argv)
 
     if not os.path.isdir(args.root):
@@ -462,7 +502,10 @@ def main(argv=None):
         return 1
 
     if args.recovery:
-        report = recovery_report(args.root, max_mttr=args.max_mttr)
+        report = recovery_report(
+            args.root, max_mttr=args.max_mttr,
+            max_cold_recoveries=args.max_cold_recoveries,
+        )
         if args.check and len(report["recoveries"]) < args.min_recoveries:
             report["problems"].append(
                 f"only {len(report['recoveries'])} completed recover(ies) "

@@ -145,17 +145,54 @@ def render(report, out=sys.stdout):
     hits = _value(report, "smp_step_compile_cache_total", 0, event="hit")
     misses = _value(report, "smp_step_compile_cache_total", 0, event="miss")
     comp_sum, comp_count = _hist_totals(report, "smp_step_compile_seconds")
+    lower_sum, lower_count = _hist_totals(report, "smp_step_lower_seconds")
     if hits or misses or comp_count:
         w("\n-- compilation --\n")
         w(f"step cache: {int(hits or 0)} hits / {int(misses or 0)} misses\n")
         if comp_count:
             w(f"XLA compile wall: {comp_sum:.1f}s over {comp_count} compiles\n")
+        if lower_count:
+            w(f"trace+lower wall: {lower_sum:.1f}s over {lower_count} "
+              "programs\n")
     for s in _series(report, "smp_compiled_step_flops"):
         name = s["labels"].get("step", "?")
         ba = _value(report, "smp_compiled_step_bytes_accessed", step=name)
         tmp = _value(report, "smp_compiled_step_temp_bytes", step=name)
         w(f"compiled {name}: {_fmt_num(s['value'])} FLOPs, "
           f"{_fmt_bytes(ba)} accessed, {_fmt_bytes(tmp)} temp\n")
+
+    # -- executable cache (persistent AOT cache; utils/exec_cache.py) ----
+    # Lookup outcomes + compile wall split by source: the availability
+    # story (warm starts replacing recompiles) measured, not assumed.
+    # Gated on actual cache lookups — every run carries source="fresh"
+    # compile series, but without SMP_EXEC_CACHE there is no cache story
+    # to tell.
+    ec = _series(report, "smp_exec_cache_total")
+    by_source = {
+        s["labels"].get("source"): (s.get("count", 0), s.get("sum", 0.0))
+        for s in _series(report, "smp_step_compile_seconds")
+        if s["labels"].get("source")
+    }
+    if ec:
+        w("\n-- executable cache --\n")
+        outcomes = "  ".join(
+            f"{s['labels'].get('result', '?')}={int(s['value'])}"
+            for s in sorted(
+                ec, key=lambda s: s["labels"].get("result", "")
+            )
+        )
+        w(f"lookups: {outcomes}\n")
+        for src in sorted(by_source):
+            cnt, secs = by_source[src]
+            if cnt:
+                w(f"compile wall ({src}): {secs:.2f}s over {int(cnt)} "
+                  f"compile(s) ({secs / cnt:.2f}s each)\n")
+        entries = _value(report, "smp_exec_cache_entries")
+        if entries is not None:
+            w(f"entries at last warm-start consult: {int(entries)}\n")
+        hit_s = _value(report, "smp_exec_cache_hit_seconds")
+        if hit_s is not None:
+            w(f"last hit deserialize+verify: {hit_s:.3f}s\n")
 
     # -- performance (roofline/MFU; utils/profiling.py) ------------------
     # Programs with a known peak carry smp_mfu; programs attributed on an

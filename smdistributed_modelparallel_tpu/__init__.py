@@ -59,6 +59,7 @@ from smdistributed_modelparallel_tpu.utils.telemetry import telemetry, watchdog
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils import health
 from smdistributed_modelparallel_tpu.utils import hlo_audit as xray
+from smdistributed_modelparallel_tpu.utils import exec_cache
 from smdistributed_modelparallel_tpu.utils import profiling
 from smdistributed_modelparallel_tpu import resilience
 from smdistributed_modelparallel_tpu.resilience.supervisor import supervisor

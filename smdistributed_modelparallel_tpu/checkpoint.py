@@ -599,6 +599,21 @@ def resume_from_checkpoint(path, tag=None, partial=True, strict=True,
         with open(newest) as fh:
             tag = fh.read().strip()
 
+    if elastic:
+        # Warm-start consult (smp.exec_cache): an elastic resume at a new
+        # topology is exactly the cold start the persistent executable
+        # cache exists for — count the candidate entries before the first
+        # step pays (or skips) the recompile. One env test when the cache
+        # is off. A supervisor-driven recovery already consulted under
+        # the "recovery" label; don't double-count it here.
+        from smdistributed_modelparallel_tpu.resilience.supervisor import (
+            supervisor,
+        )
+        from smdistributed_modelparallel_tpu.utils import exec_cache
+
+        if not supervisor._recovering:
+            exec_cache.note_warm_start("elastic_resume")
+
     def _verify(saved_cfg, shard_format, what):
         try:
             verify_smp_config(saved_cfg)

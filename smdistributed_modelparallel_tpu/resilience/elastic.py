@@ -123,5 +123,18 @@ def begin_elastic_resume(saved_cfg, current_cfg, shard_format, what=""):
             "verified, not resharded — make sure this is intended): %s",
             other,
         )
+    from smdistributed_modelparallel_tpu.utils import exec_cache
+
+    if layout and exec_cache.enabled():
+        # Executable-cache interaction: entries are keyed by topology, so
+        # a layout change can only warm-start from entries compiled at
+        # the NEW layout (a previous recovery/resume at this world, or a
+        # pre-warming run) — old-topology entries are simply not
+        # candidates, never false hits.
+        logger.info(
+            "elastic resume: layout changed (%s); persistent executable "
+            "cache will only serve entries compiled at the new topology.",
+            sorted(layout),
+        )
     record_elastic_resume(len(layout), len(soft), detail=detail)
     return layout, soft

@@ -477,6 +477,25 @@ class Supervisor:
         if pending is not None:
             now = time.monotonic()
             pending["phases"]["first_step"] = now - pending["t_resume_done"]
+            # Split the first_step phase's compile cost by source: a
+            # pre-warmed executable cache makes recovery's recompile a
+            # deserialize (compile_from_cache), and the gauges prove the
+            # availability win instead of assuming it.
+            from smdistributed_modelparallel_tpu.utils import exec_cache
+
+            mark = pending.pop("compile_mark", None)
+            events = (
+                exec_cache.compile_events_since(mark)
+                if mark is not None else []
+            )
+            if events:
+                pending["phases"]["compile_from_cache"] = sum(
+                    e["seconds"] for e in events
+                    if e["source"] == "disk_cache"
+                )
+                pending["phases"]["compile_fresh"] = sum(
+                    e["seconds"] for e in events if e["source"] == "fresh"
+                )
             mttr = now - pending["t_detect"]
             record_recovery(
                 mttr, phases=pending["phases"],
@@ -714,6 +733,15 @@ class Supervisor:
         from smdistributed_modelparallel_tpu.checkpoint import (
             resume_from_checkpoint,
         )
+        from smdistributed_modelparallel_tpu.utils import exec_cache
+
+        # Warm-start consult: count the persistent-executable-cache
+        # entries available to the shrunken world BEFORE first_step pays
+        # (or skips) the recompile, and mark the compile-event ledger so
+        # the MTTR closure can split first_step into compile_from_cache
+        # vs compile_fresh.
+        exec_cache.note_warm_start("recovery")
+        compile_mark = exec_cache.compile_event_mark()
 
         resume_from_checkpoint(ckpt_path, tag=tag, partial=True,
                                elastic=True)
@@ -730,6 +758,7 @@ class Supervisor:
             "failures": {int(k): v for k, v in failures.items()},
             "t_detect": t_detect,
             "t_resume_done": time.monotonic(),
+            "compile_mark": compile_mark,
             "phases": {
                 "detect": detect_s,
                 "rendezvous": rendezvous_s,

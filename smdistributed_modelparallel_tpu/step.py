@@ -45,6 +45,7 @@ from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
 from smdistributed_modelparallel_tpu.resilience.chaos import chaos
 from smdistributed_modelparallel_tpu.resilience.preemption import preemption
 from smdistributed_modelparallel_tpu.resilience.supervisor import supervisor
+from smdistributed_modelparallel_tpu.utils import exec_cache
 from smdistributed_modelparallel_tpu.utils import health
 from smdistributed_modelparallel_tpu.utils import hlo_audit
 from smdistributed_modelparallel_tpu.utils import profiling
@@ -74,6 +75,12 @@ class _ModelRef:
     def __eq__(self, other):
         return isinstance(other, _ModelRef) and other.index == self.index
 
+    def __repr__(self):
+        # Stable across processes: the repr feeds the persistent
+        # executable cache's disk key (the default object repr embeds a
+        # heap address).
+        return f"_ModelRef({self.index})"
+
 
 class StepFunction:
     def __init__(self, fn, non_split_inputs=None, input_split_axes=None):
@@ -95,6 +102,18 @@ class StepFunction:
             cfg.microbatches, self.non_split_inputs, self.input_split_axes
         )
         arg_names = _positional_names(self.fn, len(clean_args))
+        # Shape bucketing (SMP_SHAPE_BUCKETS): pad the batch/sequence dims
+        # up to the next configured bucket so variable-shaped batches map
+        # onto a small set of compiled (and disk-cached) executables.
+        # Batch padding is masked at microbatch granularity inside the
+        # compiled program (exact, not approximate); unset policy is one
+        # env lookup and leaves everything byte-identical.
+        bucket_state = None
+        policy = exec_cache.bucket_policy()
+        if policy is not None:
+            clean_args, clean_kwargs, bucket_state = _apply_shape_buckets(
+                clean_args, clean_kwargs, arg_names, splitter, policy, cfg
+            )
         stacked_args, stacked_kwargs = splitter.stack_microbatches(
             clean_args, clean_kwargs, arg_names
         )
@@ -126,7 +145,7 @@ class StepFunction:
             tl.start_step(state.step_count)
             with tl.span(f"step_{state.step_count}"):
                 grads, outputs = self._run_compiled(
-                    model, stacked_args, stacked_kwargs
+                    model, stacked_args, stacked_kwargs, bucket_state
                 )
                 with profiling.region("step/fetch"):
                     jax.block_until_ready(outputs)
@@ -135,7 +154,7 @@ class StepFunction:
             exact_time = True
         else:
             grads, outputs = self._run_compiled(
-                model, stacked_args, stacked_kwargs
+                model, stacked_args, stacked_kwargs, bucket_state
             )
             if profiling.should_sample_step(state.step_count):
                 # Roofline sample: block on this step's outputs so the
@@ -247,7 +266,8 @@ class StepFunction:
 
     # ------------------------------------------------------------------
 
-    def _run_compiled(self, model, stacked_args, stacked_kwargs):
+    def _run_compiled(self, model, stacked_args, stacked_kwargs,
+                      bucket_state=None):
         # Chaos seam: `wedge@step=N:ms=M` hangs HERE — inside dispatch,
         # after the step-begin edge, before the compiled program runs —
         # so the rank keeps heartbeating (detector thread) while its
@@ -309,19 +329,30 @@ class StepFunction:
         # chunk layout depend on all four, and the key must not rely on
         # every config change also bumping the generation.
         hmode = health.mode()
+        # Shape bucketing: a masked (microbatch-weighted) program differs
+        # from the exact-shape program even at identical input shapes, so
+        # the mask flag is part of the key. The weight VECTOR is a device
+        # input — every occupancy of one bucket shares one executable.
+        masked = bucket_state is not None
         pipe_key = (cfg.pipeline_parallel_degree, cfg.pipeline,
                     getattr(cfg, "virtual_pipeline_degree", 1),
                     num_mb, cfg.active_microbatches)
-        key = (state.generation, pipe_key,
-               treedef, tuple(scan_idx), tuple(bcast_idx),
-               tuple((i, _static_key(v)) for i, v in sorted(static.items())),
-               tuple((v.shape, str(v.dtype)) for v in scan_vals),
-               tuple(scan_meta),
-               tuple((v.shape, str(v.dtype)) for v in bcast_vals),
-               getattr(self, "_has_backward", True),
-               fused, opt._serial if fused else None,
-               model.training if model is not None else None,
-               hmode)
+        key_pre = (pipe_key,
+                   treedef, tuple(scan_idx), tuple(bcast_idx),
+                   tuple((i, _static_key(v)) for i, v in sorted(static.items())),
+                   tuple((v.shape, str(v.dtype)) for v in scan_vals),
+                   tuple(scan_meta),
+                   tuple((v.shape, str(v.dtype)) for v in bcast_vals),
+                   getattr(self, "_has_backward", True), fused)
+        key_post = (model.training if model is not None else None,
+                    hmode, masked)
+        key = ((state.generation,) + key_pre
+               + (opt._serial if fused else None,) + key_post)
+        # Disk-cache key: generation and optimizer serial are per-process
+        # instance counters that can never match across a restart — the
+        # disk entry drops both and relies on the lowered-module hash
+        # (verified at load) to catch any content difference they guarded.
+        disk_key_src = key_pre + (None,) + key_post
         compiled = self._cache.get(key)
         cache_events = telemetry.counter(
             "smp_step_compile_cache_total",
@@ -341,6 +372,7 @@ class StepFunction:
                 compiled = self._build(
                     model, treedef, scan_idx, bcast_idx, static, num_mb,
                     scan_meta, opt.build_update_fn() if fused else None,
+                    masked=masked,
                 )
             t_build = time.perf_counter() - t_build
             telemetry.histogram(
@@ -350,6 +382,7 @@ class StepFunction:
             # The X-ray fingerprint is keyed by this cache key: one audit
             # per distinct compiled program, re-identifiable across runs.
             compiled.audit_key = hlo_audit.cache_key_hash(key)
+            compiled.disk_key = exec_cache.stable_key_hash(disk_key_src)
             self._cache[key] = compiled
         else:
             cache_events.labels(event="hit").inc()
@@ -412,9 +445,14 @@ class StepFunction:
                 model._params_at_step = model._params
                 model._pending_update = None
         in_params = model.params
+        extra = ()
+        if masked:
+            extra = (_cached_mb_weights(
+                num_mb, bucket_state["active_mb"], mesh
+            ),)
         grads, outputs, grads_finite, next_rng, fused_out, health_word = (
             compiled(in_params, opt_state, scan_vals, bcast_vals, rng,
-                     loss_scale)
+                     loss_scale, *extra)
         )
         state.step_rng = next_rng
         schema = list(getattr(compiled, "health_schema", ()) or ())
@@ -454,6 +492,11 @@ class StepFunction:
             if grads is not None:
                 raw_div = getattr(compiled, "raw_divisor", None)
                 if raw_div:
+                    if masked:
+                        # The raw accumulator holds only the active
+                        # microbatches (padding carries zero weight); the
+                        # lazy mean divides by the live active count.
+                        raw_div = bucket_state["active_mb"]
                     model._set_raw_grads(grads, raw_div)
                 else:
                     model._grads = grads
@@ -477,6 +520,12 @@ class StepFunction:
                         grads, fused_out[0], fused_out[1], in_params,
                         opt_state,
                     )
+        if masked and bucket_state["active_mb"] < num_mb:
+            # Padded microbatches computed garbage under a zero weight;
+            # the user-visible StepOutput carries only the real ones
+            # (padding is whole trailing microbatches by construction).
+            act = bucket_state["active_mb"]
+            outputs = jax.tree_util.tree_map(lambda x: x[:act], outputs)
         return grads, outputs
 
     @staticmethod
@@ -495,7 +544,7 @@ class StepFunction:
         return reconstruct
 
     def _build(self, model, treedef, scan_idx, bcast_idx, static, num_mb,
-               scan_meta, fused_update):
+               scan_meta, fused_update, masked=False):
         cfg = state.cfg
         if (
             cfg.pipeline_parallel_degree > 1
@@ -532,7 +581,8 @@ class StepFunction:
 
         use_scaler = cfg.fp16
 
-        def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale):
+        def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale,
+                      mb_weights=None):
             hc = health.active()
             keys = jax.random.split(rng, num_mb)
             # Half-cast hoisted out of the microbatch scan: the cast is
@@ -550,10 +600,24 @@ class StepFunction:
                 grad_fn = jax.value_and_grad(scaled_fwd, has_aux=True)
 
                 def body(acc, xs):
-                    mb_leaves, key = xs
+                    # Shape bucketing (mb_weights): padded microbatches
+                    # carry a zero weight — their grads and losses are
+                    # masked out exactly, and the mean below divides by
+                    # the ACTIVE count, so a bucketed run's numbers equal
+                    # the exact-shape run's.
+                    if mb_weights is None:
+                        mb_leaves, key = xs
+                        wmb = None
+                    else:
+                        mb_leaves, key, wmb = xs
                     (loss_v, out), grads = grad_fn(
                         run_params, mb_leaves, bcast_leaves, key
                     )
+                    if wmb is not None:
+                        grads = jax.tree_util.tree_map(
+                            lambda g: wmb.astype(g.dtype) * g, grads
+                        )
+                        loss_v = loss_v * wmb
                     acc = jax.tree_util.tree_map(
                         lambda a, g: a + g.astype(a.dtype), acc, grads
                     )
@@ -565,7 +629,11 @@ class StepFunction:
                 acc0 = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, _acc_dtype(p.dtype, cfg)), params
                 )
-                grads, ys = jax.lax.scan(body, acc0, (scan_leaves, keys))
+                xs = (
+                    (scan_leaves, keys) if mb_weights is None
+                    else (scan_leaves, keys, mb_weights)
+                )
+                grads, ys = jax.lax.scan(body, acc0, xs)
                 if hc is not None:
                     outs, losses = ys
                     hc.add_stacked("loss", losses / loss_scale)
@@ -581,9 +649,14 @@ class StepFunction:
                     return grads, outs, None
                 # Microbatch averaging: parity with reference
                 # torch/allreduce/ddp.py:92-98 (grads divided by num_mb);
-                # loss-scale undone in the same pass.
+                # loss-scale undone in the same pass. Bucketed programs
+                # average over the active-microbatch count instead.
+                divisor = (
+                    num_mb if mb_weights is None
+                    else jnp.maximum(jnp.sum(mb_weights), 1.0)
+                )
                 grads = jax.tree_util.tree_map(
-                    lambda g, p: (g / (num_mb * loss_scale)).astype(p.dtype),
+                    lambda g, p: (g / (divisor * loss_scale)).astype(p.dtype),
                     grads, params,
                 )
                 finite = _grads_finite(grads) if use_scaler else None
@@ -822,7 +895,11 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
     hmode = health.mode()
     schema_box = []
 
-    def full_impl(params, opt_state, raw_scan, bcast_vals, rng, loss_scale):
+    def full_impl(params, opt_state, raw_scan, bcast_vals, rng, loss_scale,
+                  *extra):
+        # `extra` is the shape-bucketing microbatch-weight vector when the
+        # step engine built a masked program; empty otherwise (and the
+        # traced program is byte-identical to the pre-bucketing build).
         with health.collecting(hmode) as hc:
             if hc is not None and hc.mode == "full":
                 hc.add_tree("params", params)
@@ -831,16 +908,23 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                 stack_leaf(v, *m) for v, m in zip(raw_scan, scan_meta)
             ]
             grads, outs, finite = step_impl(
-                params, scan_leaves, bcast_vals, use_rng, loss_scale
+                params, scan_leaves, bcast_vals, use_rng, loss_scale, *extra
             )
             if fused_update is not None:
                 upd_grads = grads
                 if raw_divisor is not None:
                     # Average the raw accumulator on the way into the update —
                     # this divide fuses into the optimizer's elementwise kernels
-                    # instead of materializing an averaged-grads output.
+                    # instead of materializing an averaged-grads output. Under
+                    # shape bucketing the accumulator holds only the ACTIVE
+                    # microbatches' (weighted) grads, so the mean divides by
+                    # the live active count instead of the static num_mb.
+                    divisor = (
+                        jnp.maximum(jnp.sum(extra[0]), 1.0) if extra
+                        else raw_divisor
+                    )
                     upd_grads = jax.tree_util.tree_map(
-                        lambda g, p: (g / raw_divisor).astype(p.dtype),
+                        lambda g, p: (g / divisor).astype(p.dtype),
                         grads, params,
                     )
                 new_params, new_opt = fused_update(params, opt_state, upd_grads)
@@ -875,19 +959,53 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
     mesh = state.mesh
     holder = {}
 
-    def run(params, opt_state, scan_vals, bcast_vals, rng, loss_scale):
+    def run(params, opt_state, scan_vals, bcast_vals, rng, loss_scale,
+            *extra):
         with jax.set_mesh(mesh):
             if "compiled" not in holder:
                 compiled = None
+                source = "fresh"
+                module_sha = None
                 telemetry.set_phase(f"compile/{name}")
-                t_compile = time.perf_counter()
+                t_lower = t_compile = 0.0
+                disk_key = getattr(run, "disk_key", None)
+                use_cache = bool(disk_key) and exec_cache.enabled()
                 try:
-                    with profiling.region("step/compile"):
+                    # Trace+lower ALWAYS runs — shared by the fresh and
+                    # warm paths (and, under the executable cache, the
+                    # content check that catches changed user code or
+                    # optimizer constants the shape key cannot see).
+                    # Timed separately from the compile so the warm-start
+                    # win (compile -> deserialize) is attributable.
+                    t0 = time.perf_counter()
+                    with profiling.region("step/lower"):
                         lowered = jitted.lower(
-                            params, opt_state, scan_vals, bcast_vals, rng,
-                            loss_scale,
+                            params, opt_state, scan_vals, bcast_vals,
+                            rng, loss_scale, *extra,
                         )
-                        compiled = lowered.compile()
+                        if use_cache:
+                            module_sha = exec_cache.module_hash(lowered)
+                    t_lower = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    with profiling.region("step/compile"):
+                        if use_cache:
+                            # Persistent executable cache (smp.exec_cache):
+                            # a verified disk hit replaces the XLA compile.
+                            # The X-ray gauges/flight event are re-published
+                            # from the post-load audit inside load(), so
+                            # warm starts never bypass the drift gates.
+                            with profiling.region("step/exec_cache_load"):
+                                compiled, cached_audit = exec_cache.load(
+                                    name, disk_key, module_sha=module_sha,
+                                    params=params,
+                                    expected_param_shardings=param_pin,
+                                )
+                            if compiled is not None:
+                                source = "disk_cache"
+                                run.hlo_audit = cached_audit
+                        if compiled is None:
+                            compiled = lowered.compile()
+                    t_compile = time.perf_counter() - t0
                     state.last_compile_report = one_time_compile_report(
                         name, compiled
                     )
@@ -897,12 +1015,19 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                     # raise through the guarded call path.
                     health.maybe_oom_postmortem(name, None, e)
                     logger.debug("AOT compile report unavailable: %s", e)
-                t_compile = time.perf_counter() - t_compile
                 telemetry.histogram(
-                    "smp_step_compile_seconds", "XLA compile wall time"
-                ).observe(t_compile)
+                    "smp_step_lower_seconds",
+                    "trace+lower wall time (paid by fresh and warm paths)",
+                ).observe(t_lower)
+                telemetry.histogram(
+                    "smp_step_compile_seconds",
+                    "XLA compile wall time (disk_cache source: "
+                    "deserialize+verify instead of compile)",
+                ).labels(source=source).observe(t_compile)
+                flight_recorder.record_compile("lower", name, t_lower)
                 flight_recorder.record_compile("xla_compile", name, t_compile)
-                if compiled is not None:
+                exec_cache.record_compile_event(name, source, t_compile)
+                if compiled is not None and source == "fresh":
                     # Compiled-program X-ray (smp.xray): collective census
                     # + replication detector + remat/memory fingerprint of
                     # the program just built. SMP_HLO_AUDIT=off makes this
@@ -913,6 +1038,14 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                         params=params,
                         expected_param_shardings=param_pin,
                     )
+                    if use_cache:
+                        with profiling.region("step/exec_cache_store"):
+                            exec_cache.store(
+                                name, disk_key, compiled,
+                                module_sha=module_sha,
+                                audit=run.hlo_audit,
+                                compile_seconds=t_compile,
+                            )
                 telemetry.set_phase(f"run/{name}")
                 holder["compiled"] = compiled
             c = holder["compiled"]
@@ -920,7 +1053,7 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                 try:
                     with profiling.region("step/dispatch"):
                         return c(params, opt_state, scan_vals, bcast_vals,
-                                 rng, loss_scale)
+                                 rng, loss_scale, *extra)
                 except (TypeError, ValueError) as e:
                     # Input aval/sharding mismatch only (the step cache keys
                     # on shapes, so this is a layout drift, e.g. resharded
@@ -940,7 +1073,7 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
             try:
                 with profiling.region("step/dispatch"):
                     return jitted(params, opt_state, scan_vals, bcast_vals,
-                                  rng, loss_scale)
+                                  rng, loss_scale, *extra)
             except Exception as e:
                 health.maybe_oom_postmortem(name, holder.get("compiled"), e)
                 raise
@@ -992,6 +1125,164 @@ def _cached_scalar(value):
         out = jnp.asarray(key, jnp.float32)
         _SCALAR_CACHE[key] = out
     return out
+
+
+_MB_WEIGHTS_CACHE = {}
+
+
+def _cached_mb_weights(num_mb, active, mesh):
+    """Replicated [num_mb] 0/1 weight vector for a bucketed step: ones for
+    the active (real) microbatches, zeros for the padding. Cached per
+    occupancy so steady-state bucketed steps pay no host->device
+    transfer."""
+    import numpy as np
+
+    key = (num_mb, active, mesh)
+    out = _MB_WEIGHTS_CACHE.get(key)
+    if out is None:
+        if len(_MB_WEIGHTS_CACHE) > 64:
+            _MB_WEIGHTS_CACHE.clear()
+        w = np.zeros((num_mb,), np.float32)
+        w[:active] = 1.0
+        out = jax.device_put(w, NamedSharding(mesh, P()))
+        _MB_WEIGHTS_CACHE[key] = out
+    return out
+
+
+def _apply_shape_buckets(args, kwargs, arg_names, splitter, policy, cfg):
+    """Pad batch/sequence dims of the splittable step inputs up to the
+    configured ``SMP_SHAPE_BUCKETS`` boundaries.
+
+    Returns ``(args, kwargs, bucket_state)``; ``bucket_state`` is None
+    when no masked program is needed (policy doesn't apply, batch already
+    above every bucket, padding would create a partial microbatch, or
+    the path doesn't support masking) and ``{"active_mb": k, ...}`` when
+    the engine should build/reuse the microbatch-masked program.
+
+    Exactness contract: batch padding fills whole trailing microbatches
+    (rejected as ``unbucketable`` otherwise), masked to zero weight inside
+    the compiled program — losses/grads equal the exact-shape run's.
+    Sequence padding appends ``seq_pad``-valued positions on the right;
+    masking those is the model's contract (causal attention + ignore-index
+    losses are unaffected).
+    """
+    from smdistributed_modelparallel_tpu.backend.split import _is_array
+
+    num_mb = cfg.microbatches
+    # Masked batch bucketing composes with the plain scan path (fused
+    # optimizer included — the update's microbatch divisor becomes the
+    # active count); the pipeline schedules bake the microbatch layout
+    # into the program and stay exact-shape.
+    maskable = cfg.pipeline_parallel_degree <= 1
+
+    def leaf_axis_pairs(value, name):
+        if name is not None and name in splitter.non_split_inputs:
+            return []
+        axis = splitter.input_split_axes.get(name, 0)
+        return [
+            (leaf, axis)
+            for leaf in jax.tree_util.tree_leaves(
+                value, is_leaf=lambda x: hasattr(x, "smp_slice")
+            )
+            if _is_array(leaf) and not hasattr(leaf, "smp_slice")
+            and leaf.ndim > axis
+        ]
+
+    named = [
+        (v, arg_names[i] if i < len(arg_names) else None)
+        for i, v in enumerate(args)
+    ] + [(v, k) for k, v in kwargs.items()]
+    pairs = [p for v, n in named for p in leaf_axis_pairs(v, n)]
+    if not pairs:
+        return args, kwargs, None
+    batch = int(pairs[0][0].shape[pairs[0][1]])
+    ref_seq = None
+    for leaf, axis in pairs:
+        if leaf.ndim > axis + 1:
+            ref_seq = int(leaf.shape[axis + 1])
+            break
+
+    batch_tgt = None
+    active_mb = None
+    if maskable and policy.get("batch"):
+        tgt = exec_cache.bucket_for(batch, policy["batch"])
+        if tgt is None:
+            exec_cache.record_bucket("unbucketable")
+            logger.debug(
+                "shape buckets: batch %d exceeds every bucket %s; exact "
+                "compile.", batch, policy["batch"],
+            )
+        elif tgt % num_mb != 0 or batch % max(tgt // num_mb, 1) != 0:
+            # A partial microbatch cannot be masked exactly (its loss
+            # would mix real and padded rows); fall back to the exact
+            # shape rather than silently change the numbers.
+            exec_cache.record_bucket("unbucketable")
+            logger.debug(
+                "shape buckets: batch %d -> bucket %d not maskable at "
+                "microbatches=%d; exact compile.", batch, tgt, num_mb,
+            )
+        else:
+            batch_tgt = tgt
+            active_mb = batch // (tgt // num_mb)
+            exec_cache.record_bucket(
+                "padded" if tgt != batch else "exact"
+            )
+    seq_tgt = None
+    if policy.get("seq") and ref_seq is not None:
+        st = exec_cache.bucket_for(ref_seq, policy["seq"])
+        if st is not None and st != ref_seq:
+            seq_tgt = st
+
+    if batch_tgt is None and seq_tgt is None:
+        return args, kwargs, None
+
+    def pad_leaf(leaf, axis):
+        pads = [(0, 0)] * leaf.ndim
+        changed = False
+        if (batch_tgt is not None and batch_tgt != batch
+                and leaf.shape[axis] == batch):
+            pads[axis] = (0, batch_tgt - batch)
+            changed = True
+        if changed:
+            leaf = jnp.pad(leaf, pads)
+            pads = [(0, 0)] * leaf.ndim
+            changed = False
+        if (seq_tgt is not None and leaf.ndim > axis + 1
+                and leaf.shape[axis + 1] == ref_seq):
+            pads[axis + 1] = (0, seq_tgt - ref_seq)
+            leaf = jnp.pad(
+                leaf, pads, constant_values=policy.get("seq_pad", 0)
+            )
+        return leaf
+
+    def pad_value(value, name):
+        if name is not None and name in splitter.non_split_inputs:
+            return value
+        axis = splitter.input_split_axes.get(name, 0)
+        return jax.tree_util.tree_map(
+            lambda leaf: pad_leaf(leaf, axis)
+            if _is_array(leaf) and not hasattr(leaf, "smp_slice")
+            and leaf.ndim > axis else leaf,
+            value,
+            is_leaf=lambda x: hasattr(x, "smp_slice"),
+        )
+
+    new_args = tuple(
+        pad_value(v, arg_names[i] if i < len(arg_names) else None)
+        for i, v in enumerate(args)
+    )
+    new_kwargs = {k: pad_value(v, k) for k, v in kwargs.items()}
+    if batch_tgt is None:
+        # Sequence-only padding needs no mask: the program is the
+        # standard one at the bucketed shape.
+        return new_args, new_kwargs, None
+    return new_args, new_kwargs, {
+        "active_mb": int(active_mb),
+        "num_mb": int(num_mb),
+        "batch": int(batch),
+        "batch_target": int(batch_tgt),
+        "seq_target": seq_tgt,
+    }
 
 
 def _input_sharding(mesh, cfg, arr, meta):

@@ -634,8 +634,14 @@ def audit_compiled(name, compiled, key=None, params=None,
     always run (the ``SMP_HLO_AUDIT`` gate lives in ``maybe_audit``)."""
     from smdistributed_modelparallel_tpu.backend.state import state
 
-    mesh = mesh if mesh is not None else state.mesh
-    cfg = cfg if cfg is not None else state.cfg
+    # Uninitialized framework (offline audits, e.g. of a deserialized
+    # executable outside a training session): audit without mesh/config
+    # attribution rather than refuse.
+    try:
+        mesh = mesh if mesh is not None else state.mesh
+        cfg = cfg if cfg is not None else state.cfg
+    except Exception:
+        pass
     text = compiled.as_text()
     census = collective_census(text, mesh=mesh)
     remat = remat_census(text)
@@ -662,8 +668,12 @@ def audit_compiled(name, compiled, key=None, params=None,
         name, key, census, remat, memory, findings, flops, bytes_accessed,
         hlo_sha, _config_snapshot(cfg),
     )
-    audits[name] = audit
     if publish:
+        # Unpublished audits stay out of the registry too: a verification
+        # pass over a candidate executable (exec-cache load) must not
+        # register a program that may then be rejected — republish()
+        # registers it after the veto point.
+        audits[name] = audit
         _publish(audit)
     if persist:
         _persist(audit)
@@ -691,22 +701,42 @@ def maybe_audit(name, compiled, key=None, params=None,
     except Exception as e:  # pragma: no cover - defensive
         logger.warning("[xray] hlo audit of %s failed: %s", name, e)
         return None
-    dt = time.perf_counter() - t0
+    _count_audit(audit, time.perf_counter() - t0)
+    return audit
+
+
+def _count_audit(audit, seconds):
+    """Shared publication tail: the audit counters + the flight-recorder
+    compile event carrying the program fingerprint. Used by both the
+    fresh-compile path (maybe_audit) and the verified-cache-hit path
+    (republish) so the two can never diverge."""
     telemetry.counter(
         "smp_hlo_audits_total", "completed post-compile HLO audits"
     ).inc()
     telemetry.counter(
         "smp_hlo_audit_seconds_total",
         "host seconds spent in post-compile HLO audits",
-    ).inc(dt)
+    ).inc(seconds)
     from smdistributed_modelparallel_tpu.utils.flight_recorder import (
         flight_recorder,
     )
 
     flight_recorder.record_compile(
-        "hlo_audit", name, dt, fingerprint=audit.fingerprint_hash
+        "hlo_audit", audit.name, seconds, fingerprint=audit.fingerprint_hash
     )
-    return audit
+
+
+def republish(audit, seconds=0.0):
+    """Re-publish a verified audit along the exact channels a fresh
+    compile's ``maybe_audit`` uses: gauges, persistence, the audit
+    registry, the audit counters, and the flight-recorder compile event
+    with the program fingerprint. The executable-cache hit path calls
+    this AFTER fingerprint verification so a warm start never silently
+    bypasses the drift gates."""
+    audits[audit.name] = audit
+    _publish(audit)
+    _persist(audit)
+    _count_audit(audit, seconds)
 
 
 #: Latest audit per program name (``step``, ``step_pipeline_1f1b``, ...).

@@ -782,6 +782,22 @@ def record_recovery(mttr_s, phases=None, survivors=-1):
     )
 
 
+def record_exec_cache(result, seconds=None):
+    """One persistent executable-cache lookup outcome
+    (utils/exec_cache.py): ``result`` is hit / miss / reject_fingerprint
+    / reject_version / corrupt. Hits also record the deserialize+verify
+    wall time (the "warm compile" the availability story buys)."""
+    telemetry.counter(
+        "smp_exec_cache_total",
+        "persistent executable-cache lookups by outcome",
+    ).labels(result=result).inc()
+    if result == "hit" and seconds is not None:
+        telemetry.gauge(
+            "smp_exec_cache_hit_seconds",
+            "deserialize+verify wall time of the last executable-cache hit",
+        ).set(float(seconds))
+
+
 def record_elastic_resume(n_layout, n_soft, detail=""):
     """One elastic (topology-mismatched) checkpoint resume
     (resilience/elastic.py): counts of layout-relevant and soft config

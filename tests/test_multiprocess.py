@@ -9,6 +9,7 @@ tier (SURVEY §4).
 """
 
 import multiprocessing as mp
+import os
 import socket
 
 import pytest
@@ -295,7 +296,71 @@ def _worker_subgroup(rank, world, coord_port, conn):
         conn.send(("err", f"rank {rank}: {e}\n{traceback.format_exc()}"))
 
 
-def _worker_supervised_kill(rank, world, coord_port, ckpt_dir, conn):
+def _worker_prewarm_world1(cache_dir, conn):
+    """Populate the executable cache with the post-recovery world's
+    program: a single process over 2 virtual CPU devices, the exact
+    topology/model/step the supervised-kill survivor reforms into. The
+    entry it stores is what turns the recovery's ``first_step`` recompile
+    into a deserialize."""
+    try:
+        import os
+
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        os.environ["SMP_EXEC_CACHE"] = "on"
+        os.environ["SMP_EXEC_CACHE_DIR"] = cache_dir
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        # No gloo here: a single process needs no cross-process
+        # collectives (configuring them without a distributed client
+        # fails backend init), and at world=1 they do not shape the
+        # compiled program — the survivor's post-recovery lowered module
+        # must hash identically to this one.
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+        import sys
+
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        import jax.numpy as jnp
+        import optax
+
+        import smdistributed_modelparallel_tpu as smp
+        from smdistributed_modelparallel_tpu.models.transformer_lm import (
+            TransformerLM,
+        )
+
+        smp.init({"tensor_parallel_degree": 2, "ddp": True,
+                  "microbatches": 1})
+        model = smp.DistributedModel(TransformerLM(
+            vocab_size=16, max_len=8, d_model=8, n_layers=1, n_heads=2,
+        ))
+        opt = smp.DistributedOptimizer(optax.sgd(0.1), model)
+
+        @smp.step
+        def train_step(model, ids):
+            logits = model(ids)
+            loss = jnp.mean(logits.astype(jnp.float32) ** 2)
+            model.backward(loss)
+            return loss
+
+        ids = jnp.zeros((2, 8), jnp.int32)
+        train_step(model, ids)
+        opt.step()
+        from smdistributed_modelparallel_tpu.utils import exec_cache
+
+        n = len([d for d in os.listdir(exec_cache.cache_dir())])
+        assert n >= 1, "prewarm stored no cache entry"
+        smp.shutdown()
+        conn.send(("ok", n))
+    except Exception as e:  # pragma: no cover - surfaced in parent
+        import traceback
+
+        conn.send(("err", f"prewarm: {e}\n{traceback.format_exc()}"))
+
+
+def _worker_supervised_kill(rank, world, coord_port, ckpt_dir, conn,
+                            cache_dir=None):
     """Acceptance E2E for the in-job recovery supervisor: rank 1 is
     SIGKILLed by chaos at step 3; rank 0 detects it via missed heartbeats
     / the dead bus link, reforms the world at world=1 from the committed
@@ -307,6 +372,9 @@ def _worker_supervised_kill(rank, world, coord_port, ckpt_dir, conn):
         import os
 
         os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        if cache_dir:
+            os.environ["SMP_EXEC_CACHE"] = "on"
+            os.environ["SMP_EXEC_CACHE_DIR"] = cache_dir
         os.environ["SMP_SUPERVISOR"] = "on"
         os.environ["SMP_HEARTBEAT_INTERVAL"] = "0.2"
         os.environ["SMP_HEARTBEAT_MISS_BUDGET"] = "5"
@@ -415,7 +483,18 @@ def _worker_supervised_kill(rank, world, coord_port, ckpt_dir, conn):
             for s in rep["smp_failures_detected_total"]["series"]
         }
         assert kinds.get("dead", 0) >= 1, kinds
-        conn.send(("ok", rank, losses, replay, mttr))
+        # The recovery report's phase dict was closed in place at the
+        # first post-recovery step edge (compile_from_cache/compile_fresh
+        # split included when the executable cache was consulted).
+        phases = dict(smp.supervisor.last_report["phases"])
+        exec_outcomes = {
+            s["labels"]["result"]: s["value"]
+            for s in rep.get(
+                "smp_exec_cache_total", {"series": []}
+            )["series"]
+        }
+        conn.send(("ok", rank, losses, replay, mttr, phases,
+                   exec_outcomes))
     except Exception as e:  # pragma: no cover - surfaced in parent
         import traceback
 
@@ -551,11 +630,246 @@ def test_supervised_kill_recovers_in_job(tmp_path):
         assert r0[0] == "ok", r0
         # Rank 1 died by SIGKILL — chaos, not an orderly exit.
         assert procs[1].exitcode == -9, procs[1].exitcode
-        _, _, losses, replay, mttr = r0
+        _, _, losses, replay, mttr, phases, _ = r0
         assert {0, 1} <= set(losses) and {2, 3, 4, 5} >= set(replay)
         assert {3, 4, 5} <= set(replay)
         assert 0.0 < mttr < 300.0
+        # Cache off: the recovery's recompile must be attributed fresh.
+        assert phases.get("compile_fresh", 0) > 0 or (
+            "compile_from_cache" not in phases
+        ), phases
         return
+
+
+def _prewarm_exec_cache(cache_dir):
+    """Run the world=1 prewarm worker; returns its entry count."""
+    ctx = mp.get_context("spawn")
+    parent, child = ctx.Pipe()
+    p = ctx.Process(
+        target=_worker_prewarm_world1, args=(cache_dir, child), daemon=True,
+    )
+    p.start()
+    child.close()
+    assert parent.poll(300), "prewarm timed out"
+    r = parent.recv()
+    p.join(timeout=60)
+    assert r[0] == "ok", r
+    return r[1]
+
+
+def _run_supervised_kill_pair(coord, ckpt, cache_dir):
+    ctx = mp.get_context("spawn")
+    parents, procs = [], []
+    try:
+        for rank in range(2):
+            parent, child = ctx.Pipe()
+            p = ctx.Process(
+                target=_worker_supervised_kill,
+                args=(rank, 2, coord, ckpt, child, cache_dir), daemon=True,
+            )
+            p.start()
+            child.close()
+            parents.append(parent)
+            procs.append(p)
+        assert parents[0].poll(540), "rank 0 timed out"
+        try:
+            r0 = parents[0].recv()
+        except EOFError:
+            r0 = ("err", "rank 0 died without report")
+        procs[1].join(timeout=60)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=30)
+    return r0, procs
+
+
+@pytest.mark.chaos
+def test_supervised_kill_recovers_warm_from_cache(tmp_path):
+    """ISSUE 11 acceptance: the PR 10 SIGKILL E2E with the executable
+    cache pre-warmed for the post-recovery world — the first_step MTTR
+    phase's recompile becomes a deserialize (compile_from_cache > 0,
+    compile_fresh == 0), with the loss trajectory intact."""
+    cache = str(tmp_path / "exec_cache")
+    assert _prewarm_exec_cache(cache) >= 1
+    for attempt in range(3):
+        coord = _free_port()
+        ckpt = str(tmp_path / f"ck{attempt}")
+        r0, procs = _run_supervised_kill_pair(coord, ckpt, cache)
+        if r0[0] != "ok" and "in use" in str(r0[1]).lower() and attempt < 2:
+            continue
+        assert r0[0] == "ok", r0
+        assert procs[1].exitcode == -9, procs[1].exitcode
+        _, _, losses, replay, mttr, phases, outcomes = r0
+        assert {3, 4, 5} <= set(replay)
+        for sc in set(losses) & set(replay):
+            assert abs(replay[sc] - losses[sc]) < 1e-5, (losses, replay)
+        # The availability win, measured: the post-recovery first_step
+        # compile came from the cache, nothing compiled fresh.
+        assert outcomes.get("hit", 0) >= 1, (outcomes, phases)
+        assert phases.get("compile_from_cache", 0) > 0, phases
+        assert phases.get("compile_fresh", -1) == 0, phases
+        assert phases["compile_from_cache"] < phases["first_step"], phases
+        return
+
+
+@pytest.mark.chaos
+def test_supervised_kill_poisoned_cache_degrades_cold(tmp_path):
+    """A poisoned (truncated) cache entry must degrade recovery to the
+    cold-compile path — detected as corrupt, recompiled fresh, recovery
+    still completes — never a crash or a silently-wrong executable."""
+    cache = str(tmp_path / "exec_cache")
+    assert _prewarm_exec_cache(cache) >= 1
+    for entry in os.listdir(cache):
+        payload = os.path.join(cache, entry, "payload.bin")
+        if os.path.exists(payload):
+            with open(payload, "r+b") as fh:
+                fh.truncate(64)
+    for attempt in range(3):
+        coord = _free_port()
+        ckpt = str(tmp_path / f"ck{attempt}")
+        r0, procs = _run_supervised_kill_pair(coord, ckpt, cache)
+        if r0[0] != "ok" and "in use" in str(r0[1]).lower() and attempt < 2:
+            continue
+        assert r0[0] == "ok", r0
+        assert procs[1].exitcode == -9, procs[1].exitcode
+        _, _, losses, replay, mttr, phases, outcomes = r0
+        assert {3, 4, 5} <= set(replay)
+        for sc in set(losses) & set(replay):
+            assert abs(replay[sc] - losses[sc]) < 1e-5, (losses, replay)
+        assert outcomes.get("corrupt", 0) >= 1, outcomes
+        assert phases.get("compile_fresh", 0) > 0, phases
+        assert phases.get("compile_from_cache", -1) == 0, phases
+        return
+
+
+def _worker_cross_process_warm(rank, world, coord_port, cache_dir, conn):
+    """2-proc gloo tier: each process compiles the tp2 step program with
+    the cache on and reports its loss trajectory + lookup outcomes. A
+    second identical pair launch warm-starts from the first pair's
+    entries (entries are keyed per process index) with bit-identical
+    losses."""
+    try:
+        import os
+
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        os.environ["SMP_EXEC_CACHE"] = "on"
+        os.environ["SMP_EXEC_CACHE_DIR"] = cache_dir
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{coord_port}",
+            num_processes=world,
+            process_id=rank,
+        )
+        import sys
+
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        import jax.numpy as jnp
+        import optax
+
+        import smdistributed_modelparallel_tpu as smp
+        from smdistributed_modelparallel_tpu.models.transformer_lm import (
+            TransformerLM,
+        )
+        from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+
+        smp.init({"tensor_parallel_degree": 2, "ddp": True,
+                  "microbatches": 1})
+        model = smp.DistributedModel(TransformerLM(
+            vocab_size=16, max_len=8, d_model=8, n_layers=1, n_heads=2,
+        ))
+        opt = smp.DistributedOptimizer(optax.sgd(0.1), model)
+
+        @smp.step
+        def train_step(model, ids):
+            logits = model(ids)
+            loss = jnp.mean(logits.astype(jnp.float32) ** 2)
+            model.backward(loss)
+            return loss
+
+        ids = jnp.zeros((2, 8), jnp.int32)
+        losses = []
+        for _ in range(3):
+            out = train_step(model, ids)
+            opt.step()
+            losses.append(float(out.reduce_mean()))
+        rep = telemetry.report()["metrics"]
+        outcomes = {
+            s["labels"]["result"]: s["value"]
+            for s in rep.get(
+                "smp_exec_cache_total", {"series": []}
+            )["series"]
+        }
+        smp.shutdown()
+        conn.send(("ok", rank, losses, outcomes))
+    except Exception as e:  # pragma: no cover - surfaced in parent
+        import traceback
+
+        conn.send(("err", f"rank {rank}: {e}\n{traceback.format_exc()}"))
+
+
+def test_cross_process_warm_start_bit_identical(tmp_path):
+    """Satellite: cross-process warm start in the 2-proc gloo tier.
+
+    Pair launch 1 compiles fresh and populates the shared cache dir; pair
+    launch 2 (fresh processes — a true cold start) deserializes instead
+    of recompiling, with bit-identical per-step losses."""
+    cache = str(tmp_path / "exec_cache")
+    ctx = mp.get_context("spawn")
+    rounds = []
+    for rnd in range(2):
+        for attempt in range(3):
+            coord = _free_port()
+            parents, procs = [], []
+            try:
+                for rank in range(2):
+                    parent, child = ctx.Pipe()
+                    p = ctx.Process(
+                        target=_worker_cross_process_warm,
+                        args=(rank, 2, coord, cache, child), daemon=True,
+                    )
+                    p.start()
+                    child.close()
+                    parents.append(parent)
+                    procs.append(p)
+                results = []
+                for parent in parents:
+                    assert parent.poll(420), "worker timed out"
+                    results.append(parent.recv())
+                for p in procs:
+                    p.join(timeout=60)
+            finally:
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+                        p.join(timeout=30)
+            if any(
+                r[0] != "ok" and "in use" in str(r[1]).lower()
+                for r in results
+            ) and attempt < 2:
+                continue
+            for r in results:
+                assert r[0] == "ok", r
+            rounds.append(results)
+            break
+    first, second = rounds
+    for rank in range(2):
+        # Round 1 compiled fresh (miss), round 2 warm-started (hit).
+        assert first[rank][3].get("miss", 0) == 1, first[rank][3]
+        assert first[rank][3].get("hit", 0) == 0, first[rank][3]
+        assert second[rank][3].get("hit", 0) == 1, second[rank][3]
+        # Same init seed + same batch: the warm-started executable must
+        # reproduce the fresh run's trajectory bit-for-bit.
+        assert second[rank][2] == first[rank][2], (
+            first[rank][2], second[rank][2],
+        )
 
 
 @pytest.mark.chaos
