@@ -405,6 +405,158 @@ def _pipeline_interleave_probe(deadline):
     sys.stderr.flush()
 
 
+def _zero_probe(deadline):
+    """SMP_BENCH_ZERO_PROBE=1: zero2d vs zero3 A/B at full-rdp data
+    parallelism — per-step wall time plus the memory story (per-device
+    parameter bytes from the realized shardings, program argument/temp
+    bytes from the X-ray memory breakdown).
+
+    zero2d is the GSPMD-scheduled baseline (persistence-thresholded param
+    sharding, implicit collectives); zero3 adds the explicit machinery
+    this probe is for: just-in-time per-layer gathers, the double-buffered
+    prefetch registers, and the bucketed reduce-scatter grad path. Same
+    interleaved-blocks methodology as the pipeline probe (each block
+    re-inits — the sharding mode changes the compiled program — and pays
+    its compile in warmup, outside the timed region). Emits one stderr
+    JSON line {"component": "zero_probe", zero2d_ms, zero3_ms, speedup,
+    ...} and returns the dict for the stdout result block; the pass
+    criterion is a TPU criterion recorded in BENCH_NOTES.md (CPU smoke
+    serializes collectives and only proves the plumbing + memory split).
+    Never fails the bench.
+    """
+    import jax
+
+    if len(jax.devices()) < 2:
+        sys.stderr.write(
+            "bench: skipping zero probe (needs >= 2 devices for rdp).\n")
+        return None
+    if deadline - time.time() < 180:
+        sys.stderr.write(
+            f"bench: skipping zero probe ({deadline - time.time():.0f}s "
+            "left in window < 180s floor).\n")
+        return None
+    import jax.numpy as jnp
+    import optax
+
+    import smdistributed_modelparallel_tpu as smp
+    from smdistributed_modelparallel_tpu.models.transformer_lm import (
+        TransformerLM,
+    )
+    from smdistributed_modelparallel_tpu.utils import hlo_audit
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rdp = len(jax.devices())
+    n_layers, d_model, n_heads, seq, vocab = (
+        (8, 512, 8, 512, 8192) if on_tpu else (4, 32, 2, 16, 64)
+    )
+    # Per-microbatch batch must divide by rdp for the explicit
+    # slice-grad + reduce-scatter path (mb=4 below).
+    batch = 4 * rdp
+    iters = 10 if on_tpu else 3
+    threshold = 1 if not on_tpu else 4096
+
+    def build(extra):
+        smp.reset()
+        cfg = {"microbatches": 4, "ddp": True, "bf16": bool(on_tpu),
+               "sdp_param_persistence_threshold": threshold}
+        cfg.update(extra)
+        smp.init(cfg)
+        model = smp.DistributedModel(TransformerLM(
+            vocab_size=vocab, max_len=seq, d_model=d_model,
+            n_layers=n_layers, n_heads=n_heads,
+        ))
+        optimizer = smp.DistributedOptimizer(optax.sgd(1e-3), model)
+        ids = jax.random.randint(jax.random.key(0), (batch, seq), 0, vocab)
+
+        @smp.step
+        def train_step(model, b):
+            logits = model(b)
+            lg = logits[:, :-1].astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(lg, axis=-1)
+            tgt = jnp.take_along_axis(lg, b[:, 1:, None], axis=-1)[..., 0]
+            loss = jnp.mean(lse - tgt)
+            model.backward(loss)
+            return loss
+
+        return model, optimizer, train_step, ids
+
+    def param_bytes(model):
+        """(per-device shard bytes, logical total bytes): both variants
+        shard at the same threshold, so the 1/rdp memory claim reads off
+        the per-device/total ratio."""
+        per_device = total = 0
+        for leaf in jax.tree_util.tree_leaves(model.params):
+            try:
+                shard_shape = leaf.sharding.shard_shape(leaf.shape)
+            except Exception:
+                shard_shape = leaf.shape
+            n = 1
+            for d in shard_shape:
+                n *= int(d)
+            per_device += n * leaf.dtype.itemsize
+            total += int(leaf.size) * leaf.dtype.itemsize
+        return per_device, total
+
+    variants = (
+        ("zero2d", {"sharded_data_parallel_degree": rdp}),
+        ("zero3", {"sharded_params": "zero3"}),
+    )
+    times = {name: [] for name, _ in variants}
+    memory = {}
+    zero_block = None
+    for _round in range(3):
+        for name, extra in variants:
+            model, optimizer, train_step, ids = build(extra)
+            out = None
+            for _ in range(2):     # warmup: compile + first dispatch
+                out = train_step(model, ids)
+                optimizer.step()
+            _readback(out.reduce_mean())
+            if name not in memory:
+                audit = hlo_audit.of_step_function(train_step)
+                per_device, total = param_bytes(model)
+                memory[name] = {
+                    "param_bytes_per_device": per_device,
+                    "param_bytes_total": total,
+                    "program_memory": (audit.memory if audit else {}),
+                }
+                if name == "zero3" and audit is not None:
+                    zero_block = audit.zero
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = train_step(model, ids)
+                optimizer.step()
+            _readback(out.reduce_mean())
+            times[name].append((time.perf_counter() - t0) / iters)
+        if time.time() > deadline:
+            sys.stderr.write(
+                "bench: zero probe hit the window deadline; using the "
+                f"{len(times['zero3'])} block round(s) measured so far.\n")
+            break
+    smp.reset()
+
+    def median(xs):
+        s = sorted(xs)
+        n = len(s)
+        return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+    med = {name: median(ts) for name, ts in times.items()}
+    result = {
+        "component": "zero_probe",
+        "rdp": rdp,
+        "zero2d_ms": round(med["zero2d"] * 1e3, 3),
+        "zero3_ms": round(med["zero3"] * 1e3, 3),
+        "speedup": round(med["zero2d"] / med["zero3"], 4),
+        "memory": memory,
+        "zero": zero_block,
+        "blocks": len(times["zero3"]),
+        "on_tpu": on_tpu,
+    }
+    sys.stderr.write(json.dumps(result) + "\n")
+    sys.stderr.flush()
+    return result
+
+
 def _compile_cache_probe(deadline):
     """SMP_BENCH_COMPILE_PROBE=1: cold/warm compile A/B through the
     persistent executable cache (smp.exec_cache).
@@ -546,8 +698,9 @@ def main():
             "device retry window and emitting the CPU smoke block.\n")
         sys.stderr.flush()
         os.environ["JAX_PLATFORMS"] = "cpu"
-        if os.environ.get("SMP_BENCH_PIPELINE_PROBE", "0") == "1":
-            # The pp=2 A/B probe needs a multi-device mesh; provision
+        if (os.environ.get("SMP_BENCH_PIPELINE_PROBE", "0") == "1"
+                or os.environ.get("SMP_BENCH_ZERO_PROBE", "0") == "1"):
+            # The pp=2 / rdp A/B probes need a multi-device mesh; provision
             # virtual CPU devices BEFORE the first jax import (the main
             # smoke numbers are single-core either way).
             flags = os.environ.get("XLA_FLAGS", "")
@@ -846,6 +999,13 @@ def main():
         # must not be used after it.
         _pipeline_interleave_probe(deadline=start_time + probe_window)
 
+    zero_probe_out = None
+    if os.environ.get("SMP_BENCH_ZERO_PROBE", "0") == "1":
+        # Re-inits the framework per block (the sharding mode changes the
+        # compiled program); the headline model/step must not be reused
+        # afterwards.
+        zero_probe_out = _zero_probe(deadline=start_time + probe_window)
+
     exec_cache_out = None
     if os.environ.get("SMP_BENCH_COMPILE_PROBE", "0") == "1":
         # Also re-inits the framework; anything after this point must not
@@ -882,6 +1042,8 @@ def main():
     }
     if exec_cache_out is not None:
         result["exec_cache"] = exec_cache_out
+    if zero_probe_out is not None:
+        result["zero_probe"] = zero_probe_out
     print(json.dumps(result))
 
 

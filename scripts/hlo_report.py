@@ -32,7 +32,7 @@ import argparse
 import json
 import sys
 
-SEMANTIC_FIELDS = ("config", "collectives", "replicated", "remat")
+SEMANTIC_FIELDS = ("config", "collectives", "replicated", "remat", "zero")
 
 
 def load_programs(path):
@@ -111,6 +111,11 @@ def diff_fingerprints(a, b, fields=None, remat_tol=0.02):
         fb = b.get("remat", {}).get("fraction", 0.0)
         if abs((fa or 0.0) - (fb or 0.0)) > remat_tol:
             add("remat.fraction", fa, fb)
+    if picked("zero"):
+        za, zb = a.get("zero") or {}, b.get("zero") or {}
+        for k in sorted(set(za) | set(zb)):
+            if za.get(k) != zb.get(k):
+                add(f"zero.{k}", za.get(k), zb.get(k))
     if picked("memory"):
         ma, mb = a.get("memory", {}), b.get("memory", {})
         for k in sorted(set(ma) | set(mb)):

@@ -184,6 +184,29 @@ def _exec_cache_schema_problem(probe):
     return None
 
 
+def _zero_probe_schema_problem(probe):
+    """Why a round's ``zero_probe`` block (bench.py SMP_BENCH_ZERO_PROBE
+    zero2d-vs-zero3 A/B) is malformed, or None. Absent blocks are fine —
+    rounds predating ZeRO-3, or probe not requested."""
+    if probe is None:
+        return None
+    if not isinstance(probe, dict):
+        return f"'zero_probe' must be an object, got {type(probe).__name__}"
+    if probe.get("component") != "zero_probe":
+        return "'zero_probe.component' must be the string 'zero_probe'"
+    for key in ("zero2d_ms", "zero3_ms", "speedup"):
+        if not isinstance(probe.get(key), (int, float)):
+            return f"'zero_probe' lacks a numeric '{key}'"
+    if probe["zero3_ms"] > 0 and abs(
+        probe["speedup"] - probe["zero2d_ms"] / probe["zero3_ms"]
+    ) > max(0.05 * probe["speedup"], 0.05):
+        return "'zero_probe.speedup' inconsistent with zero2d_ms/zero3_ms"
+    mem = probe.get("memory")
+    if mem is not None and not isinstance(mem, dict):
+        return "'zero_probe.memory' must be an object when present"
+    return None
+
+
 def build_ledger(repo, threshold=0.05):
     """The full trajectory + verdict dict (see module docstring)."""
     rounds = []
@@ -225,6 +248,7 @@ def build_ledger(repo, threshold=0.05):
             "schedule": None,
             "hlo_audit": None,
             "exec_cache": None,
+            "zero_probe": None,
             "documented": n in documented,
         }
         if rc == 0:
@@ -256,6 +280,12 @@ def build_ledger(repo, threshold=0.05):
                     problems.append(f"{name}: {probe_problem}")
                     probe = None
                 row["exec_cache"] = probe
+                zprobe = parsed.get("zero_probe")
+                zprobe_problem = _zero_probe_schema_problem(zprobe)
+                if zprobe_problem:
+                    problems.append(f"{name}: {zprobe_problem}")
+                    zprobe = None
+                row["zero_probe"] = zprobe
                 row.update(
                     on_chip=_is_on_chip(parsed),
                     vs_baseline=parsed["vs_baseline"],
@@ -385,6 +415,26 @@ def render_table(ledger, out=sys.stdout):
         if isinstance(probe, dict):
             w(f"{'':>7}exec_cache: cold {probe['cold_s']:.2f}s  warm "
               f"{probe['warm_s']:.2f}s  speedup {probe['speedup']:.1f}x\n")
+        zprobe = r.get("zero_probe")
+        if isinstance(zprobe, dict):
+            parts = [
+                f"zero2d {zprobe['zero2d_ms']:.1f}ms",
+                f"zero3 {zprobe['zero3_ms']:.1f}ms",
+                f"speedup {zprobe['speedup']:.2f}x",
+            ]
+            mem = zprobe.get("memory") or {}
+            pb = {
+                k: (v or {}).get("param_bytes_per_device")
+                for k, v in mem.items() if isinstance(v, dict)
+            }
+            if pb.get("zero2d") and pb.get("zero3"):
+                parts.append(
+                    f"params/device {pb['zero2d']:,}B -> {pb['zero3']:,}B"
+                )
+            z = zprobe.get("zero") or {}
+            if z.get("overlap_fraction") is not None:
+                parts.append(f"overlap {100 * z['overlap_fraction']:.0f}%")
+            w(f"{'':>7}zero_probe: " + "  ".join(parts) + "\n")
     if ledger["best_on_chip"]:
         b = ledger["best_on_chip"]
         w(f"\nbest on-chip:   round {b['round']}  vs_baseline "

@@ -188,6 +188,7 @@ def inspect_partial_dir(ckpt_dir):
         "committed": has_committed or status == "legacy",
         "status": status,
         "topology": None,
+        "state_layout": None,
         "components": {},
     }
     cfg_path = os.path.join(ckpt_dir, "smp_config.pt")
@@ -199,9 +200,29 @@ def inspect_partial_dir(ckpt_dir):
                 k: saved.get(k)
                 for k in (
                     "pipeline_parallel_degree", "tensor_parallel_degree",
-                    "sharded_data_parallel_degree", "shard_optimizer_state",
+                    "sharded_data_parallel_degree", "sharded_params",
+                    "shard_optimizer_state",
                     "microbatches", "num_processes",
                 )
+            }
+            # Stdlib mirror of parallel/zero.describe_state_layout (the
+            # probe must run without jax): which ZeRO modes the saved
+            # state was laid out under. All of them are PartitionSpec-only
+            # annotations, so zero3 param shards reshard on load exactly
+            # like pp/tp shards — but the reader deserves to know the
+            # files hold 1/rdp-sized param pieces, not whole tensors.
+            info["state_layout"] = {
+                "zero1": bool(saved.get("shard_optimizer_state", False)),
+                "zero2d": int(
+                    saved.get("sharded_data_parallel_degree", 0) or 0
+                ) > 1,
+                "zero3": (
+                    str(saved.get("sharded_params", "none") or "none")
+                    == "zero3"
+                ),
+                "sharded_params": str(
+                    saved.get("sharded_params", "none") or "none"
+                ),
             }
         except Exception as e:  # noqa: BLE001 - report, don't crash
             info["topology"] = {"error": str(e)}
@@ -576,6 +597,16 @@ def main(argv=None):
             print(f"  {name}: {status}")
             if c["topology"]:
                 print(f"    saved topology: {c['topology']}")
+            if c.get("state_layout"):
+                modes = [
+                    m for m in ("zero1", "zero2d", "zero3")
+                    if c["state_layout"].get(m)
+                ]
+                print("    state layout: "
+                      + (" + ".join(modes) if modes else "unsharded")
+                      + (" (param shards are 1/rdp pieces; reshard-on-load"
+                         " like any layout change)"
+                         if c["state_layout"].get("zero3") else ""))
             for comp, inv in c["components"].items():
                 line = (
                     f"    {comp}: {inv['keys']} keys, "

@@ -289,6 +289,55 @@ def render(report, out=sys.stdout):
                 w(f"  !! replication: {int(rep_n)} finding(s), "
                   f"{_fmt_bytes(rep_bytes)} wasted per device\n")
 
+    # -- zero (ZeRO-3 fully-sharded params; parallel/zero.py + the X-ray's
+    # zero_report) ------------------------------------------------------
+    # smp_zero3_* gauges: rdp-axis parameter-gather / gradient-scatter
+    # traffic of the compiled program, the bucketed-reduce layout, and the
+    # overlap evidence (loop-interior fraction + double-buffered transfer
+    # registers). Rendered identically for one dump and the cross-rank
+    # aggregate (gauges maxed — the census is identical across ranks of
+    # one SPMD program).
+    zero_names = sorted({
+        s["labels"].get("step", "?")
+        for metric in ("smp_zero3_gather_ops", "smp_zero3_buckets")
+        for s in _series(report, metric)
+    })
+    if zero_names:
+        w("\n-- zero --\n")
+        for name in zero_names:
+            g_ops = _value(report, "smp_zero3_gather_ops", step=name)
+            g_bytes = _value(report, "smp_zero3_gather_bytes", step=name)
+            s_ops = _value(report, "smp_zero3_scatter_ops", step=name)
+            s_bytes = _value(report, "smp_zero3_scatter_bytes", step=name)
+            w(f"{name}:\n")
+            if g_ops is not None or s_ops is not None:
+                w(f"  param gathers: {int(g_ops or 0)} op(s), "
+                  f"{_fmt_bytes(g_bytes)}/device   grad scatters: "
+                  f"{int(s_ops or 0)} op(s), {_fmt_bytes(s_bytes)}/device\n")
+            buckets = _value(report, "smp_zero3_buckets", step=name)
+            b_bytes = _value(report, "smp_zero3_bucket_bytes", step=name)
+            if buckets is not None:
+                w(f"  reduce-scatter buckets: {int(buckets)} "
+                  f"({_fmt_bytes(b_bytes)} grads/microbatch)\n")
+            n_sharded = _value(report, "smp_zero3_sharded_params", step=name)
+            n_persist = _value(
+                report, "smp_zero3_persistent_params", step=name
+            )
+            if n_sharded is not None:
+                w(f"  params: {int(n_sharded)} rdp-sharded, "
+                  f"{int(n_persist or 0)} persistent (replicated)\n")
+            overlap = _value(
+                report, "smp_zero3_overlap_fraction", step=name
+            )
+            regs = _value(report, "smp_zero3_prefetch_registers", step=name)
+            if overlap is not None:
+                line = (f"  overlap: {100 * overlap:.1f}% of gather/scatter "
+                        "bytes issued inside loop bodies")
+                if regs:
+                    line += (f"; {int(regs)} double-buffered register "
+                             "gather(s)")
+                w(line + "\n")
+
     # -- health ---------------------------------------------------------
     # Fed by utils/health.py (SMP_HEALTH_CHECK sentinel), the fp16 loss
     # scaler, and the optimizer norm gauges; rendered identically for one

@@ -99,6 +99,29 @@ class ModelParallelConfig:
             except json.JSONDecodeError as e:
                 raise ConfigError(f"SM_HP_MP_PARAMETERS is not valid JSON: {e}")
 
+        # Environment aliases for the ZeRO-3 knobs (SMP_ZERO3 /
+        # SMP_ZERO3_BUCKET_MB): applied only when the user config does not
+        # set the canonical key, so an explicit config always wins.
+        env_zero3 = os.environ.get("SMP_ZERO3")
+        if env_zero3 is not None and "sharded_params" not in user_config:
+            if env_zero3.lower() in ("1", "on", "true", "zero3"):
+                user_config["sharded_params"] = "zero3"
+            elif env_zero3.lower() in ("0", "off", "false", "none"):
+                user_config["sharded_params"] = "none"
+            else:
+                raise ConfigError(
+                    f"SMP_ZERO3={env_zero3!r}: expected 1/on/true/zero3 "
+                    "or 0/off/false/none"
+                )
+        env_bucket = os.environ.get("SMP_ZERO3_BUCKET_MB")
+        if env_bucket is not None and "zero3_bucket_mb" not in user_config:
+            try:
+                user_config["zero3_bucket_mb"] = int(env_bucket)
+            except ValueError:
+                raise ConfigError(
+                    f"SMP_ZERO3_BUCKET_MB={env_bucket!r} is not an integer"
+                )
+
         # Resolve aliases (e.g. partitions -> pipeline_parallel_degree).
         alias_map = {
             spec["alias"]: key for key, spec in SCHEMA.items() if "alias" in spec
@@ -229,6 +252,12 @@ class ModelParallelConfig:
         if v["sharded_data_parallel_degree"] > 1 and not v["ddp"]:
             # Reference enables ZeRO-2D only under ddp; mirror that requirement.
             raise ConfigError("sharded_data_parallel_degree > 1 requires ddp: True")
+        if (v["sharded_params"] == "zero3"
+                and v["_sharded_data_parallelism_config"] is not None):
+            raise ConfigError(
+                "sharded_params: zero3 and _sharded_data_parallelism_config "
+                "(zero2d) are mutually exclusive ZeRO modes."
+            )
         if v["offload_activations"] and v["activation_loading_horizon"] < 1:
             logger.warning("activation_loading_horizon=0 disables offload prefetch pipelining.")
 
@@ -325,6 +354,10 @@ class ModelParallelConfig:
             self._values["sharded_data_parallel_degree"] > 1
             or self._values["_sharded_data_parallelism_config"] is not None
         )
+
+    @property
+    def zero3_enabled(self):
+        return self._values["sharded_params"] == "zero3"
 
     @property
     def half_dtype(self):

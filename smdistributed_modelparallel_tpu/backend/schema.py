@@ -129,6 +129,35 @@ SCHEMA = {
         "default": 1.0,
         "description": "Global grad-norm clip value applied under sharded data parallelism.",
     },
+    "sharded_params": {
+        "type": str,
+        "default": "none",
+        "options": ["none", "zero3"],
+        "requires": {
+            "ddp": True,
+            "sharded_data_parallel_degree": 1,
+            "horovod": False,
+        },
+        "dependencies": [
+            "ddp", "sharded_data_parallel_degree", "horovod",
+        ],
+        "description": "Fully-sharded parameters (ZeRO-3 / FSDP over the rdp "
+        "mesh axis): 'zero3' stores every parameter >= "
+        "sdp_param_persistence_threshold elements sharded over rdp, "
+        "all-gathers each layer's params just-in-time in forward (and "
+        "regathers in backward), and reduce-scatters gradients in "
+        "zero3_bucket_mb buckets overlapped with the backward. Env alias: "
+        "SMP_ZERO3=1. Mutually exclusive with the legacy zero2d knob "
+        "(sharded_data_parallel_degree).",
+    },
+    "zero3_bucket_mb": {
+        "type": int,
+        "default": 25,
+        "lower_bound": 1,
+        "description": "Gradient reduce-scatter bucket size in MiB under "
+        "sharded_params: zero3 (reference: DeepSpeed reduce_bucket_size). "
+        "Env alias: SMP_ZERO3_BUCKET_MB.",
+    },
     "_sharded_data_parallelism_config": {
         "type": (str, dict, type(None)),
         "default": None,

@@ -136,6 +136,7 @@ def verify_smp_config(saved):
         "prescaled_batch",
         "shard_optimizer_state",
         "sharded_data_parallel_degree",
+        "sharded_params",
     )
     mismatches = {
         k: (saved.get(k), getattr(cfg, k))

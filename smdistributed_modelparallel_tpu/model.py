@@ -532,9 +532,11 @@ class DistributedModel:
         in-framework decode at all).
         """
         from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
 
         from smdistributed_modelparallel_tpu.backend.topology import PP_AXIS
+        from smdistributed_modelparallel_tpu.parallel.sharding import (
+            strip_axis,
+        )
 
         if self._params is None:
             raise SMPValidationError(
@@ -545,15 +547,9 @@ class DistributedModel:
             return cached[1]
 
         def strip_pp(sharding):
-            def drop(ax):
-                if ax == PP_AXIS:
-                    return None
-                if isinstance(ax, (tuple, list)):
-                    kept = tuple(a for a in ax if a != PP_AXIS)
-                    return kept if kept else None
-                return ax
-            spec = P(*(drop(a) for a in sharding.spec))
-            return NamedSharding(sharding.mesh, spec)
+            return NamedSharding(
+                sharding.mesh, strip_axis(sharding.spec, PP_AXIS)
+            )
 
         shardings = jax.tree_util.tree_map(strip_pp, self._param_shardings)
         gathered = jax.device_put(self._params, shardings)

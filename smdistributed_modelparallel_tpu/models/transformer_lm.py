@@ -279,8 +279,41 @@ class TransformerLM(nn.Module):
                     "pipeline parallelism; compute the loss from logits."
                 )
         x = self.embed(ids)
-        x, _ = self.layers(x, None)
+        x = self._apply_layers(x)
         return self.head(x, targets)
+
+    def _apply_layers(self, x):
+        """The layer stack: the lifted ``nn.scan`` normally, or — under
+        ``sharded_params: zero3`` at pp=1 — the double-buffered
+        just-in-time gather scan (``parallel/zero.zero3_prefetch_scan``):
+        each tick all-gathers the NEXT layer's rdp-sharded param slice
+        into a transfer register behind an optimization barrier while the
+        current layer's matmuls run, and the backward regathers from the
+        sharded slice (per-layer remat) instead of stashing gathered
+        copies. Decode (mutable KV cache) and non-deterministic dropout
+        need the lifted scan's collection/rng plumbing and keep it."""
+        if not self.is_initializing() and not self.decode and (
+                self.dropout == 0.0 or self.deterministic):
+            import jax as _jax
+
+            from smdistributed_modelparallel_tpu.parallel import zero
+
+            stacked = self.layers.variables.get("params", {}).get("block")
+            if (stacked and isinstance(x, _jax.core.Tracer)
+                    and zero.zero3_prefetch_active()):
+                # parent=None: a detached functional module (same trick as
+                # PipelineSpec.layer_module), not a registered submodule.
+                layer = TransformerLayer(**self._layer_kwargs(), parent=None)
+                specs = zero.gathered_slice_specs(stacked, "layers/block")
+
+                def apply_layer(h, p):
+                    return layer.apply({"params": p}, h)
+
+                return zero.zero3_prefetch_scan(
+                    apply_layer, x, stacked, self.n_layers, specs
+                )
+        x, _ = self.layers(x, None)
+        return x
 
     @nn.nowrap
     def pipeline_spec(self):

@@ -468,6 +468,13 @@ def chunk_layout(spec, num_stages, virtual):
             active.reshape(shape).transpose(1, 0, 2), maxp)
 
 
+# Stage views are index-gathers over the stacked layer axis only — inner
+# dims (tp axes, zero3's rdp shards) ride along with their shardings
+# unconstrained, so under ``sharded_params: zero3`` the per-layer rdp
+# all-gather stays at each stage's point of use inside the schedule loop
+# instead of being hoisted into an upfront whole-model gather. The 1F1B
+# executors additionally pin the staged axis (``pin_stage_axis``) with
+# UNCONSTRAINED inner dims for the same reason.
 def staged_chunk_views(spec, layer_params, num_stages, virtual):
     """Stage the [L, ...] layer stack as ([S, V, maxp, ...] params,
     [S, V, maxp, ...] xs, [S, V, maxp] active mask) for the interleaved

@@ -1211,6 +1211,13 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
         if mesh is None or _pp_size <= 1:
             return tree
 
+        # UNCONSTRAINED (not None) on the non-stage dims is load-bearing
+        # for pp x zero3 composition: None would force the staged views
+        # replicated, upfront-gathering every rdp-sharded parameter
+        # before the tick loop. UNCONSTRAINED lets propagation keep the
+        # rdp dims sharded, so the all-gather lands INSIDE the loop at
+        # each stage's point of use (per-stage gather scoping — asserted
+        # by the zero3 composition gate's loop_gather_ops census).
         def pin(x):
             if getattr(x, "ndim", 0) < 1 or x.shape[0] != S:
                 return x
@@ -1891,6 +1898,13 @@ def _pipeline_zero_bubble(model, params, stacked_inputs, rng, mb_loss_fn,
         if mesh is None or _pp_size <= 1:
             return tree
 
+        # UNCONSTRAINED (not None) on the non-stage dims is load-bearing
+        # for pp x zero3 composition: None would force the staged views
+        # replicated, upfront-gathering every rdp-sharded parameter
+        # before the tick loop. UNCONSTRAINED lets propagation keep the
+        # rdp dims sharded, so the all-gather lands INSIDE the loop at
+        # each stage's point of use (per-stage gather scoping — asserted
+        # by the zero3 composition gate's loop_gather_ops census).
         def pin(x):
             if getattr(x, "ndim", 0) < 1 or x.shape[0] != S:
                 return x

@@ -54,3 +54,20 @@ def replicated(mesh):
 
 def named(mesh, *spec):
     return NamedSharding(mesh, P(*spec))
+
+
+def strip_axis(spec, axis):
+    """PartitionSpec with every occurrence of one mesh axis removed —
+    the "gathered over that axis" layout of a sharded value. Shared by
+    the decode regather (strip pp, ``model.regather_for_decode``) and
+    ZeRO-3's just-in-time param gathers (strip rdp,
+    ``parallel/zero.strip_rdp``)."""
+    def drop(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a != axis)
+            return kept if kept else None
+        return None if entry == axis else entry
+
+    return P(*(drop(a) for a in spec))

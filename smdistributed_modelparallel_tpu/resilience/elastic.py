@@ -42,6 +42,7 @@ LAYOUT_KEYS = (
     "pipeline_parallel_degree",
     "tensor_parallel_degree",
     "sharded_data_parallel_degree",
+    "sharded_params",
     "shard_optimizer_state",
 )
 

@@ -41,6 +41,7 @@ from smdistributed_modelparallel_tpu.backend.split import (
 )
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.model import DistributedModel
+from smdistributed_modelparallel_tpu.parallel import zero as zero_mod
 from smdistributed_modelparallel_tpu.parallel.sharding import batch_spec
 from smdistributed_modelparallel_tpu.resilience.chaos import chaos
 from smdistributed_modelparallel_tpu.resilience.preemption import preemption
@@ -337,7 +338,25 @@ class StepFunction:
         pipe_key = (cfg.pipeline_parallel_degree, cfg.pipeline,
                     getattr(cfg, "virtual_pipeline_degree", 1),
                     num_mb, cfg.active_microbatches)
-        key_pre = (pipe_key,
+        # ZeRO knobs change the built program (param sharding layout,
+        # slice-grad restructuring, bucket boundaries) without moving any
+        # shape component — key them explicitly so a knob flip can never
+        # warm-hit a stale executable. Mirrored in the exec-cache's
+        # verified knob facts (utils/exec_cache.py) for the disk entries.
+        # Sub-knobs that cannot affect the program under the current mode
+        # (bucket/prefetch without zero3, the persistence threshold
+        # without any ZeRO param sharding) are canonicalized out so an
+        # idle env var never spuriously invalidates caches.
+        zero3 = cfg.zero3_enabled
+        zero_key = (getattr(cfg, "sharded_params", "none"),
+                    getattr(cfg, "zero3_bucket_mb", 0) if zero3 else 0,
+                    cfg.sdp_param_persistence_threshold
+                    if (zero3 or cfg.zero2d_enabled) else 0,
+                    cfg.sharded_data_parallel_degree,
+                    # Prefetch flips between the transfer-register scan
+                    # and the lifted scan at identical shapes.
+                    zero_mod.prefetch_knob() if zero3 else "-")
+        key_pre = (pipe_key, zero_key,
                    treedef, tuple(scan_idx), tuple(bcast_idx),
                    tuple((i, _static_key(v)) for i, v in sorted(static.items())),
                    tuple((v.shape, str(v.dtype)) for v in scan_vals),
@@ -580,6 +599,20 @@ class StepFunction:
             return (loss if has_backward else jnp.zeros(())), out
 
         use_scaler = cfg.fp16
+        # ZeRO-3 explicit gradient path: the microbatch forward runs
+        # vmapped over an rdp-reshaped batch axis, so the per-slice weight
+        # grads are genuine per-device partial sums and the cross-replica
+        # reduction is OUR bucketed reduce-scatter (zero3_grad_reduce),
+        # not a GSPMD-chosen all-reduce. Requires rdp to be the only
+        # nontrivial mesh axis; other compositions keep sharded params +
+        # just-in-time gathers with GSPMD-reduced grads.
+        z3_manual = (
+            zero_mod.zero3_manual_grads_supported(cfg) and has_backward
+        )
+        z3_rdp = zero_mod.rdp_size() if z3_manual else 1
+        # Per-microbatch batch axis of each scan leaf (stacked inputs
+        # carry their batch at 0 by the splitter's contract).
+        mb_axes = [0 if stacked else axis for axis, _n, stacked in scan_meta]
 
         def step_impl(params, scan_leaves, bcast_leaves, rng, loss_scale,
                       mb_weights=None):
@@ -598,6 +631,102 @@ class StepFunction:
                     return loss * loss_scale, out
 
                 grad_fn = jax.value_and_grad(scaled_fwd, has_aux=True)
+
+                use_z3 = z3_manual and zero_mod.zero3_sliceable(
+                    scan_leaves, mb_axes, z3_rdp
+                )
+                if z3_manual and not use_z3:
+                    logger.warning(
+                        "zero3: a microbatch batch dim is not divisible by "
+                        "rdp=%d; falling back to the GSPMD gradient "
+                        "reduction for this program.", z3_rdp,
+                    )
+                if use_z3:
+                    # Output-shape probe (abstract, no compute): the user
+                    # fn's outputs must survive the slice-vmap round trip
+                    # exactly — leading batch dims scale by rdp, scalars
+                    # stay scalar. Outputs that don't (batch on a later
+                    # axis, shapes that happen not to scale) cannot be
+                    # reassembled without guessing; keep them untouched on
+                    # the GSPMD gradient path instead.
+                    def _out_avals(leaves):
+                        def probe(rp, ls, key):
+                            _, out = mb_forward(rp, ls, bcast_leaves, key)
+                            return out
+
+                        return jax.eval_shape(
+                            probe, run_params, leaves, keys[0]
+                        )
+
+                    try:
+                        plain_avals = _out_avals([
+                            jax.ShapeDtypeStruct(l.shape[1:], l.dtype)
+                            for l in scan_leaves
+                        ])
+                        sliced_avals = _out_avals([
+                            jax.ShapeDtypeStruct(
+                                l.shape[1:1 + a]
+                                + (l.shape[1 + a] // z3_rdp,)
+                                + l.shape[2 + a:],
+                                l.dtype,
+                            )
+                            for l, a in zip(scan_leaves, mb_axes)
+                        ])
+                        use_z3 = zero_mod.zero3_outputs_mergeable(
+                            plain_avals, sliced_avals, z3_rdp
+                        )
+                    except Exception as e:
+                        use_z3 = False
+                        logger.warning(
+                            "zero3: output-shape probe failed (%s); "
+                            "falling back to the GSPMD gradient "
+                            "reduction for this program.", e,
+                        )
+                    if not use_z3:
+                        logger.warning(
+                            "zero3: step outputs are not slice-mergeable "
+                            "(need leading-batch arrays or scalars); "
+                            "using the GSPMD gradient reduction so "
+                            "outputs stay exact."
+                        )
+
+                def z3_body(acc, xs):
+                    if mb_weights is None:
+                        mb_leaves, key = xs
+                        wmb = None
+                    else:
+                        mb_leaves, key, wmb = xs
+                    sliced = [
+                        zero_mod.zero3_slice_batch(l, a, z3_rdp)
+                        for l, a in zip(mb_leaves, mb_axes)
+                    ]
+                    slice_keys = jax.random.split(key, z3_rdp)
+
+                    def slice_fwd(run_params, sl_leaves, k):
+                        loss, out = mb_forward(
+                            run_params, sl_leaves, bcast_leaves, k
+                        )
+                        return loss * loss_scale, out
+
+                    (loss_v, out), pgrads = jax.vmap(
+                        jax.value_and_grad(slice_fwd, has_aux=True),
+                        in_axes=(None, 0, 0),
+                    )(run_params, sliced, slice_keys)
+                    grads = zero_mod.zero3_grad_reduce(
+                        pgrads, params, model, name="step"
+                    )
+                    out = zero_mod.zero3_merge_outputs(out)
+                    loss_v = jnp.mean(loss_v)
+                    if wmb is not None:
+                        grads = jax.tree_util.tree_map(
+                            lambda g: wmb.astype(g.dtype) * g, grads
+                        )
+                        loss_v = loss_v * wmb
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(a.dtype), acc, grads
+                    )
+                    ys = (out, loss_v) if hc is not None else out
+                    return acc, ys
 
                 def body(acc, xs):
                     # Shape bucketing (mb_weights): padded microbatches
@@ -629,11 +758,19 @@ class StepFunction:
                 acc0 = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, _acc_dtype(p.dtype, cfg)), params
                 )
+                if zero_mod.zero3_enabled(cfg):
+                    # Sharded gradient accumulator: the carry keeps the
+                    # params' rdp-sharded placements across microbatches,
+                    # so per-mb grads reduce INTO shards rather than
+                    # materializing replicated between iterations.
+                    acc0 = zero_mod.zero3_pin_grads(acc0, model)
                 xs = (
                     (scan_leaves, keys) if mb_weights is None
                     else (scan_leaves, keys, mb_weights)
                 )
-                grads, ys = jax.lax.scan(body, acc0, xs)
+                grads, ys = jax.lax.scan(
+                    z3_body if use_z3 else body, acc0, xs
+                )
                 if hc is not None:
                     outs, losses = ys
                     hc.add_stacked("loss", losses / loss_scale)
@@ -646,6 +783,8 @@ class StepFunction:
                     # folds into the optimizer-update kernels in the runner,
                     # and into a lazy divide if the user reads model.grads.
                     # (Loss scaling is off in fused mode.)
+                    if zero_mod.zero3_enabled(cfg):
+                        grads = zero_mod.zero3_pin_grads(grads, model)
                     return grads, outs, None
                 # Microbatch averaging: parity with reference
                 # torch/allreduce/ddp.py:92-98 (grads divided by num_mb);
@@ -659,6 +798,8 @@ class StepFunction:
                     lambda g, p: (g / (divisor * loss_scale)).astype(p.dtype),
                     grads, params,
                 )
+                if zero_mod.zero3_enabled(cfg):
+                    grads = zero_mod.zero3_pin_grads(grads, model)
                 finite = _grads_finite(grads) if use_scaler else None
                 return grads, outs, finite
 
@@ -773,6 +914,10 @@ class StepFunction:
                 grads = jax.tree_util.tree_map(
                     lambda g, p: (g / loss_scale).astype(p.dtype), grads, params
                 )
+                if zero_mod.zero3_enabled(cfg):
+                    # pp x zero3: grads leave rdp-sharded; the reduction
+                    # itself is GSPMD's (per-stage, inside the tick loop).
+                    grads = zero_mod.zero3_pin_grads(grads, model)
                 finite = _grads_finite(grads) if use_scaler else None
                 return grads, outs, finite
 
@@ -849,6 +994,8 @@ class StepFunction:
                 grads = jax.tree_util.tree_map(
                     lambda g, p: (g / loss_scale).astype(p.dtype), grads, params
                 )
+                if zero_mod.zero3_enabled(cfg):
+                    grads = zero_mod.zero3_pin_grads(grads, model)
                 finite = _grads_finite(grads) if use_scaler else None
                 return grads, outs, finite
             _, (outs, hvals) = forward_all(params)

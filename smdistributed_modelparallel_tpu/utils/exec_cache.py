@@ -206,6 +206,36 @@ def _knob_facts():
         "fused_step_donation": bool(getattr(cfg, "fused_step_donation", False))
         if cfg else False,
         "health": health.mode(),
+        # ZeRO-3 knobs: mode/bucket/threshold reshape the compiled program
+        # (param sharding layout, slice-grad restructuring, reduce-scatter
+        # bucket boundaries) at identical input shapes — a knob flip must
+        # version-mismatch, never warm-hit a stale executable. Sub-knobs
+        # idle under the current mode are canonicalized (0 / "-") so a
+        # stray env var never spuriously rejects entries of byte-identical
+        # programs; mirrors the step engine's zero_key.
+        **_zero_knob_facts(cfg),
+    }
+
+
+def _zero_knob_facts(cfg):
+    zero3 = bool(getattr(cfg, "zero3_enabled", False))
+    zero2d = bool(getattr(cfg, "zero2d_enabled", False))
+    prefetch = "-"
+    if zero3:
+        from smdistributed_modelparallel_tpu.parallel.zero import (
+            prefetch_knob,
+        )
+
+        prefetch = prefetch_knob()
+    return {
+        "sharded_params": getattr(cfg, "sharded_params", "none")
+        if cfg else "none",
+        "zero3_bucket_mb": int(getattr(cfg, "zero3_bucket_mb", 0) or 0)
+        if zero3 else 0,
+        "sdp_param_persistence_threshold": int(
+            getattr(cfg, "sdp_param_persistence_threshold", 0) or 0
+        ) if (zero3 or zero2d) else 0,
+        "zero3_prefetch": prefetch,
     }
 
 

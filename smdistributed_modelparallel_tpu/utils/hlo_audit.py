@@ -376,6 +376,190 @@ def while_carries(hlo_text):
     return out
 
 
+# ----------------------------------------------------------------------
+# ZeRO-3 traffic report (sharded_params: zero3)
+# ----------------------------------------------------------------------
+
+_COMP_HEADER_RE = re.compile(
+    r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*(?:\([^)]*\))?\s*\(.*\)\s*->.*\{\s*$"
+)
+_INSTR_RE = re.compile(r"^\s*(ROOT\s+)?%([\w.\-]+)\s*=\s*(.*)$")
+_REF_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _computations(hlo_text):
+    """``(name, [instruction lines])`` per computation in the HLO text."""
+    name, lines = None, []
+    for line in hlo_text.splitlines():
+        m = _COMP_HEADER_RE.match(line)
+        if m is not None:
+            if name is not None:
+                yield name, lines
+            name, lines = m.group(1), []
+            continue
+        if line.startswith("}"):
+            if name is not None:
+                yield name, lines
+            name, lines = None, []
+            continue
+        if name is not None:
+            lines.append(line)
+    if name is not None:
+        yield name, lines
+
+
+# The result-type prefix of a tuple-typed instruction can contain
+# ``/*index=N*/`` comments, so the paren alternative must key on paren
+# nesting (HLO types never nest parens), not on '='-freedom.
+_RHS_OP_RE = re.compile(r"^(?:\([^()]*\)|\S+)\s+([a-z][a-z0-9\-]*)\(")
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+
+#: Pure data-movement ops: an all-gather whose transitive users are ONLY
+#: these (ending at the body ROOT tuple) computes nothing this iteration —
+#: it is parked in the loop carry for the next tick. Anything else
+#: (dot, a fusion whose body computes, convert feeding compute, ...)
+#: counts as compute, so gathers consumed at use never misclassify as
+#: registers. ``parameter``/``constant`` matter only for classifying
+#: fused computations as move-only.
+_MOVE_OPS = frozenset((
+    "tuple", "copy", "bitcast", "get-tuple-element", "opt-barrier",
+    "all-gather-done", "transpose", "reshape", "parameter", "constant",
+))
+
+
+def zero3_prefetch_evidence(hlo_text):
+    """Structural double-buffering check: inside some while-loop body that
+    performs both an all-gather and matmuls, at least one all-gather's
+    result never feeds this iteration's compute — its only transitive
+    users are data-movement ops (including fusions of them, e.g. the
+    copy/bitcast fusions XLA builds for carry writes) ending at the carry
+    tuple: the transfer register, i.e. the next layer's gather is issued
+    before this layer's dependent matmuls. Returns the count of such
+    register gathers."""
+    comps = list(_computations(hlo_text))
+    # A fusion is data-movement iff every instruction of its called
+    # computation is.
+    move_only = {}
+    for name, lines in comps:
+        ok = True
+        for line in lines:
+            m = _INSTR_RE.match(line)
+            if m is None:
+                continue
+            km = _RHS_OP_RE.match(m.group(3))
+            if km is None or km.group(1) not in _MOVE_OPS:
+                ok = False
+                break
+        move_only[name] = ok
+
+    registers = 0
+    for name, lines in comps:
+        users, kinds, dots, gathers, calls = {}, {}, set(), [], {}
+        for line in lines:
+            m = _INSTR_RE.match(line)
+            if m is None:
+                continue
+            iname, rhs = m.group(2), m.group(3)
+            for op in _REF_RE.findall(rhs):
+                if op != iname:
+                    users.setdefault(op, set()).add(iname)
+            km = _RHS_OP_RE.match(rhs)
+            kinds[iname] = km.group(1) if km else "?"
+            if kinds[iname] == "fusion":
+                fm = _CALLS_RE.search(rhs)
+                if fm:
+                    calls[iname] = fm.group(1)
+            cm = _COLL_RE.search(line)
+            if cm is not None and cm.group("op") == "all-gather" and (
+                    cm.group("suffix") != "-done"):
+                gathers.append(iname)
+            if _DOT_RE.search(line):
+                dots.add(iname)
+        if not gathers or not dots:
+            continue
+
+        def moves(iname):
+            kind = kinds.get(iname)
+            if kind == "fusion":
+                return move_only.get(calls.get(iname, ""), False)
+            return kind in _MOVE_OPS
+
+        for g in gathers:
+            seen, frontier = set(), list(users.get(g, ()))
+            parked = True
+            while frontier:
+                cur = frontier.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                if not moves(cur):
+                    parked = False
+                    break
+                frontier.extend(users.get(cur, ()))
+            if parked and seen:
+                registers += 1
+    return registers
+
+
+def zero_report(hlo_text, mesh=None):
+    """ZeRO-3 collective-traffic report over the compiled program: rdp-axis
+    parameter-gather and gradient-scatter volume, how much of it is issued
+    inside loop bodies (where it can overlap the loop's compute — the
+    epilogue position on the critical tail cannot), and the structural
+    double-buffering evidence from ``zero3_prefetch_evidence``. Bytes are
+    per-device result payloads, same convention as the census."""
+    from smdistributed_modelparallel_tpu.backend.topology import RDP_AXIS
+
+    maps = _mesh_coord_maps(mesh)
+    totals = {
+        "gather_ops": 0, "gather_bytes": 0,
+        "scatter_ops": 0, "scatter_bytes": 0,
+        "allreduce_ops": 0, "allreduce_bytes": 0,
+    }
+    interior_bytes = total_gs_bytes = 0
+    loop_gathers = 0
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.search(line)
+        if m is None or m.group("suffix") == "-done":
+            continue
+        op = m.group("op")
+        if op not in ("all-gather", "reduce-scatter", "all-reduce"):
+            continue
+        groups = _parse_replica_groups(line)
+        use_global = "use_global_device_ids=true" in line
+        if groups is None:
+            axis = "unattributed"
+        elif groups == "all":
+            axis = "world"
+        else:
+            axis = _attribute_groups(groups, mesh, maps, use_global)
+        if axis != RDP_AXIS:
+            continue
+        nbytes = _shape_bytes(m.group("shape"))
+        onm = _OP_NAME_RE.search(line)
+        in_loop = bool(onm and "while" in onm.group(1))
+        if op == "all-gather":
+            totals["gather_ops"] += 1
+            totals["gather_bytes"] += nbytes
+            loop_gathers += int(in_loop)
+        elif op == "reduce-scatter":
+            totals["scatter_ops"] += 1
+            totals["scatter_bytes"] += nbytes
+        else:
+            totals["allreduce_ops"] += 1
+            totals["allreduce_bytes"] += nbytes
+            continue  # all-reduce volume is reported but not "overlap"
+        total_gs_bytes += nbytes
+        if in_loop:
+            interior_bytes += nbytes
+    totals["loop_gather_ops"] = loop_gathers
+    totals["overlap_fraction"] = round(
+        interior_bytes / total_gs_bytes, 4
+    ) if total_gs_bytes else 0.0
+    totals["prefetch_registers"] = zero3_prefetch_evidence(hlo_text)
+    return totals
+
+
 def memory_breakdown(compiled):
     """XLA buffer-assignment byte classes of a compiled executable, or
     ``{}`` when the backend won't say."""
@@ -546,7 +730,7 @@ class ProgramAudit:
     """Structured audit of one compiled step program."""
 
     def __init__(self, name, key, census, remat, memory, findings,
-                 flops, bytes_accessed, hlo_sha256, config):
+                 flops, bytes_accessed, hlo_sha256, config, zero=None):
         self.name = name
         self.key = key
         self.census = census
@@ -557,6 +741,7 @@ class ProgramAudit:
         self.bytes_accessed = bytes_accessed
         self.hlo_sha256 = hlo_sha256
         self.config = config
+        self.zero = zero
         self.fingerprint = self._fingerprint()
         self.fingerprint_hash = fingerprint_hash(self.fingerprint)
 
@@ -581,7 +766,7 @@ class ProgramAudit:
     # -- export ---------------------------------------------------------
 
     def _fingerprint(self):
-        return {
+        fp = {
             "name": self.name,
             "key": self.key,
             "config": self.config,
@@ -594,6 +779,11 @@ class ProgramAudit:
             "bytes_accessed": self.bytes_accessed,
             "hlo_sha256": self.hlo_sha256,
         }
+        # Additive: only zero3 programs carry the block, so fingerprints
+        # (and committed goldens) of every other program are unchanged.
+        if self.zero is not None:
+            fp["zero"] = self.zero
+        return fp
 
     def as_dict(self):
         d = dict(self.fingerprint)
@@ -604,13 +794,18 @@ class ProgramAudit:
 def _config_snapshot(cfg):
     if cfg is None:
         return {}
-    return {
+    snap = {
         "pipeline": getattr(cfg, "pipeline", None),
         "pp": getattr(cfg, "pipeline_parallel_degree", 1),
         "tp": getattr(cfg, "tensor_parallel_degree", 1),
         "v": getattr(cfg, "virtual_pipeline_degree", 1),
         "mb": getattr(cfg, "microbatches", 1),
     }
+    # Additive (default omitted) so pre-zero3 fingerprints stay stable.
+    sharded = getattr(cfg, "sharded_params", "none")
+    if sharded and sharded != "none":
+        snap["sharded_params"] = sharded
+    return snap
 
 
 def fingerprint_hash(fp):
@@ -646,6 +841,9 @@ def audit_compiled(name, compiled, key=None, params=None,
     census = collective_census(text, mesh=mesh)
     remat = remat_census(text)
     memory = memory_breakdown(compiled)
+    zero = None
+    if bool(getattr(cfg, "zero3_enabled", False)):
+        zero = zero_report(text, mesh=mesh)
     findings = []
     findings += _param_findings(
         params, expected_param_shardings, mesh, min_bytes
@@ -666,7 +864,7 @@ def audit_compiled(name, compiled, key=None, params=None,
     ).hexdigest()
     audit = ProgramAudit(
         name, key, census, remat, memory, findings, flops, bytes_accessed,
-        hlo_sha, _config_snapshot(cfg),
+        hlo_sha, _config_snapshot(cfg), zero=zero,
     )
     if publish:
         # Unpublished audits stay out of the registry too: a verification
@@ -791,7 +989,7 @@ def bench_summary(audit):
 #: The environment-stable fingerprint subset the golden regression gates
 #: compare (memory/FLOPs/hashes move with jaxlib versions; these move
 #: only when the program's parallel structure does).
-SEMANTIC_FIELDS = ("config", "collectives", "replicated", "remat")
+SEMANTIC_FIELDS = ("config", "collectives", "replicated", "remat", "zero")
 
 
 def diff(a, b, fields=None, remat_tol=0.02):
@@ -838,6 +1036,11 @@ def diff(a, b, fields=None, remat_tol=0.02):
         fb = b.get("remat", {}).get("fraction", 0.0)
         if abs((fa or 0.0) - (fb or 0.0)) > remat_tol:
             add("remat.fraction", fa, fb)
+    if picked("zero"):
+        za, zb = a.get("zero") or {}, b.get("zero") or {}
+        for k in sorted(set(za) | set(zb)):
+            if za.get(k) != zb.get(k):
+                add(f"zero.{k}", za.get(k), zb.get(k))
     if picked("memory"):
         ma, mb = a.get("memory", {}), b.get("memory", {})
         for k in sorted(set(ma) | set(mb)):
@@ -889,6 +1092,12 @@ def _publish(audit):
             "smp_hlo_memory_bytes",
             "XLA buffer-assignment bytes of the compiled program by class",
         ).labels(kind=k, **lab).set(v)
+    if audit.zero is not None:
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            record_zero3_xray,
+        )
+
+        record_zero3_xray(audit.name, audit.zero)
 
 
 def _persist(audit):

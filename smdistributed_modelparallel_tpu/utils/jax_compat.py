@@ -22,6 +22,18 @@ them onto what the installed jax actually provides. Current shims:
   the cost of replicated compute over those axes on multi-axis meshes.
   jax >= 0.5 gets true partial-auto behavior back automatically.
 
+- ``ensure_optimization_barrier_rules``: jax 0.4.x ships
+  ``lax.optimization_barrier`` without batching (or differentiation)
+  rules, so a barrier inside a ``vmap``-ed region raises
+  NotImplementedError. The ZeRO-3 prefetch scan (``parallel/zero.py``)
+  issues its next-layer gather behind a barrier inside the vmapped
+  per-rdp-slice forward; the shim registers the trivially-correct
+  identity batching rule (the barrier is semantically the identity on
+  every operand). Differentiation stays unimplemented — callers wrap the
+  barrier in a ``custom_vjp`` identity instead, which keeps the
+  scheduling constraint out of the transpose program where it would pin
+  the wrong ordering.
+
 Keep this module import-light (jax only): it is imported at ops-module
 import time, which the import-hygiene test requires to not initialize
 any accelerator backend.
@@ -56,3 +68,22 @@ def shard_map(f, mesh=None, in_specs=None, out_specs=None, axis_names=None,
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_rep=bool(check_vma), auto=frozenset(),
     )
+
+
+def ensure_optimization_barrier_rules():
+    """Register the identity batching rule for ``optimization_barrier``
+    when the installed jax lacks one (jax < 0.5). Idempotent; never
+    overrides a rule jax itself provides."""
+    try:
+        from jax._src.lax.lax import optimization_barrier_p
+        from jax.interpreters import batching
+    except ImportError:  # pragma: no cover - future jax reorganizations
+        return False
+    if optimization_barrier_p in batching.primitive_batchers:
+        return True
+
+    def _barrier_batcher(args, dims):
+        return optimization_barrier_p.bind(*args), list(dims)
+
+    batching.primitive_batchers[optimization_barrier_p] = _barrier_batcher
+    return True

@@ -816,6 +816,38 @@ def record_elastic_resume(n_layout, n_soft, detail=""):
     _flight().record_preempt("elastic_resume", detail=detail)
 
 
+def record_zero3_xray(name, zero_block):
+    """Publish the X-ray's ZeRO-3 traffic report (utils/hlo_audit.py
+    ``zero_report``) as ``smp_zero3_*`` gauges: per-device rdp-axis
+    parameter-gather / gradient-scatter volume of the compiled program,
+    the fraction issued inside loop bodies (overlappable with compute),
+    and the double-buffered transfer-register evidence. Complements the
+    build-time gauges the grad engine sets (``smp_zero3_buckets`` /
+    ``smp_zero3_bucket_bytes`` / ``smp_zero3_sharded_params``)."""
+    lab = dict(step=name)
+    for key, help_text in (
+        ("gather_ops", "rdp-axis parameter all-gather instructions in the "
+         "compiled zero3 program"),
+        ("gather_bytes", "per-device rdp all-gather result bytes in the "
+         "compiled zero3 program"),
+        ("scatter_ops", "rdp-axis gradient reduce-scatter instructions in "
+         "the compiled zero3 program"),
+        ("scatter_bytes", "per-device rdp reduce-scatter result bytes in "
+         "the compiled zero3 program"),
+        ("overlap_fraction", "fraction of zero3 gather/scatter bytes "
+         "issued inside loop bodies (overlappable with the loop's "
+         "compute)"),
+        ("prefetch_registers", "double-buffered transfer-register gathers "
+         "(next layer's gather parked in the scan carry) detected in the "
+         "compiled zero3 program"),
+    ):
+        val = zero_block.get(key)
+        if val is not None:
+            telemetry.gauge(f"smp_zero3_{key}", help_text).labels(
+                **lab
+            ).set(float(val))
+
+
 def _atexit_dump():  # pragma: no cover - exercised via subprocess test
     try:
         # An empty registry must not clobber the dump smp.shutdown already

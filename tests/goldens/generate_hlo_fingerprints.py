@@ -42,6 +42,14 @@ CONFIGS = {
         "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
         "pipeline": "zero_bubble",
     },
+    # The canonical ZeRO-3 program (tests/test_zero3.py gate): rdp=2,
+    # everything past a 1-element persistence threshold fully sharded —
+    # the fingerprint's `zero` block carries the gather/scatter census,
+    # overlap fraction, and transfer-register evidence.
+    "zero3_rdp2": {
+        "microbatches": 2, "ddp": True, "_device_count_override": 2,
+        "sharded_params": "zero3", "sdp_param_persistence_threshold": 1,
+    },
 }
 
 
