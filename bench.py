@@ -363,7 +363,22 @@ def _pipeline_interleave_probe(deadline):
             out = train_step(model, ids)
             optimizer.step()
         _readback(out.reduce_mean())
-        return (time.perf_counter() - t0) / iters
+        dt = (time.perf_counter() - t0) / iters
+        # FLOP-weighted remat fraction + fingerprint from the compiled
+        # program's X-ray: the schedule-level recompute cost of each
+        # variant becomes ledger-verifiable on CPU (the wall-clock A/B
+        # needs a chip; the census does not).
+        remat = fp = None
+        try:
+            from smdistributed_modelparallel_tpu.utils import hlo_audit
+
+            audit = hlo_audit.of_step_function(train_step)
+            if audit is not None:
+                remat = audit.remat.get("fraction")
+                fp = audit.fingerprint_hash
+        except Exception as e:  # the audit must never kill the probe
+            sys.stderr.write(f"bench: pipeline-probe audit skipped ({e!r})\n")
+        return dt, remat, fp
 
     # Variant order inside a round keeps the A/B/C blocks interleaved so
     # clock/thermal drift hits all three schedules alike.
@@ -371,9 +386,16 @@ def _pipeline_interleave_probe(deadline):
                 ("interleaved_v2", 2, "interleaved"),
                 ("zb_h1", 2, "zero_bubble"))
     times = {name: [] for name, _, _ in variants}
+    remats = {}
+    fps = {}
     for _ in range(3):
         for name, v, schedule in variants:
-            times[name].append(timed_block(v, schedule))
+            dt, remat, fp = timed_block(v, schedule)
+            times[name].append(dt)
+            if remat is not None:
+                remats[name] = remat
+            if fp is not None:
+                fps[name] = fp
         if time.time() > deadline:
             sys.stderr.write(
                 "bench: pipeline probe hit the window deadline; using the "
@@ -388,11 +410,16 @@ def _pipeline_interleave_probe(deadline):
 
     med = {name: median(ts) for name, ts in times.items()}
     best = min(med, key=med.get)
-    sys.stderr.write(json.dumps({
+    result = {
         "component": "pipeline_schedule",
         "pp": 2, "microbatches": 8,
         "schedules": {name: round(dt * 1e3, 3) for name, dt in med.items()},
         "schedule_best": best,
+        # Per-schedule FLOP-weighted remat fraction + program fingerprint
+        # from the compile-time X-ray (scripts/perf_ledger.py schema-checks
+        # and renders these; empty dicts when no AOT executable exists).
+        "remat_fraction": remats,
+        "fingerprints": fps,
         "speedup_v2": round(med["1f1b"] / med["interleaved_v2"], 4),
         "speedup_zb": round(med["1f1b"] / med["zb_h1"], 4),
         # Legacy fields (round <= 5 consumers of the v1-vs-v2 probe).
@@ -401,8 +428,10 @@ def _pipeline_interleave_probe(deadline):
         "speedup": round(med["1f1b"] / med["interleaved_v2"], 4),
         "blocks": len(times["zb_h1"]),
         "on_tpu": on_tpu,
-    }) + "\n")
+    }
+    sys.stderr.write(json.dumps(result) + "\n")
     sys.stderr.flush()
+    return result
 
 
 def _zero_probe(deadline):
@@ -993,11 +1022,14 @@ def main():
             _readback(out.reduce_mean())
         sys.stderr.write(f"bench: profile written to {prof_dir}\n")
 
+    pipeline_probe_out = None
     if os.environ.get("SMP_BENCH_PIPELINE_PROBE", "0") == "1":
         # Last probe: it re-inits the framework (virtual_pipeline_degree
         # changes the partitioning), so the single-chip model/step above
         # must not be used after it.
-        _pipeline_interleave_probe(deadline=start_time + probe_window)
+        pipeline_probe_out = _pipeline_interleave_probe(
+            deadline=start_time + probe_window
+        )
 
     zero_probe_out = None
     if os.environ.get("SMP_BENCH_ZERO_PROBE", "0") == "1":
@@ -1044,6 +1076,8 @@ def main():
         result["exec_cache"] = exec_cache_out
     if zero_probe_out is not None:
         result["zero_probe"] = zero_probe_out
+    if pipeline_probe_out is not None:
+        result["pipeline_probe"] = pipeline_probe_out
     print(json.dumps(result))
 
 

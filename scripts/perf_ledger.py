@@ -207,6 +207,43 @@ def _zero_probe_schema_problem(probe):
     return None
 
 
+def _pipeline_probe_schema_problem(probe):
+    """Why a round's ``pipeline_probe`` block (bench.py
+    SMP_BENCH_PIPELINE_PROBE 3-way schedule A/B) is malformed, or None.
+    Absent blocks are fine — rounds predating the stamped probe, or
+    probe not requested."""
+    if probe is None:
+        return None
+    if not isinstance(probe, dict):
+        return (
+            f"'pipeline_probe' must be an object, got {type(probe).__name__}"
+        )
+    if probe.get("component") != "pipeline_schedule":
+        return ("'pipeline_probe.component' must be the string "
+                "'pipeline_schedule'")
+    scheds = probe.get("schedules")
+    if not (isinstance(scheds, dict) and scheds and all(
+        isinstance(v, (int, float)) for v in scheds.values()
+    )):
+        return "'pipeline_probe.schedules' must map schedule names to ms"
+    remat = probe.get("remat_fraction")
+    if remat is not None:
+        if not (isinstance(remat, dict) and all(
+            isinstance(v, (int, float)) and 0.0 <= v <= 1.0
+            for v in remat.values()
+        )):
+            return ("'pipeline_probe.remat_fraction' must map schedule "
+                    "names to fractions in [0, 1]")
+        unknown = sorted(set(remat) - set(scheds))
+        if unknown:
+            return ("'pipeline_probe.remat_fraction' names schedules the "
+                    f"probe did not time: {unknown}")
+    best = probe.get("schedule_best")
+    if best is not None and best not in scheds:
+        return f"'pipeline_probe.schedule_best' {best!r} not in schedules"
+    return None
+
+
 def build_ledger(repo, threshold=0.05):
     """The full trajectory + verdict dict (see module docstring)."""
     rounds = []
@@ -249,6 +286,7 @@ def build_ledger(repo, threshold=0.05):
             "hlo_audit": None,
             "exec_cache": None,
             "zero_probe": None,
+            "pipeline_probe": None,
             "documented": n in documented,
         }
         if rc == 0:
@@ -286,6 +324,12 @@ def build_ledger(repo, threshold=0.05):
                     problems.append(f"{name}: {zprobe_problem}")
                     zprobe = None
                 row["zero_probe"] = zprobe
+                pprobe = parsed.get("pipeline_probe")
+                pprobe_problem = _pipeline_probe_schema_problem(pprobe)
+                if pprobe_problem:
+                    problems.append(f"{name}: {pprobe_problem}")
+                    pprobe = None
+                row["pipeline_probe"] = pprobe
                 row.update(
                     on_chip=_is_on_chip(parsed),
                     vs_baseline=parsed["vs_baseline"],
@@ -415,6 +459,19 @@ def render_table(ledger, out=sys.stdout):
         if isinstance(probe, dict):
             w(f"{'':>7}exec_cache: cold {probe['cold_s']:.2f}s  warm "
               f"{probe['warm_s']:.2f}s  speedup {probe['speedup']:.1f}x\n")
+        pprobe = r.get("pipeline_probe")
+        if isinstance(pprobe, dict):
+            remat = pprobe.get("remat_fraction") or {}
+            parts = []
+            for sched in sorted(pprobe.get("schedules", {})):
+                ms = pprobe["schedules"][sched]
+                part = f"{sched} {ms:.1f}ms"
+                if sched in remat:
+                    part += f" (remat {100 * remat[sched]:.0f}%)"
+                parts.append(part)
+            if pprobe.get("schedule_best"):
+                parts.append(f"best {pprobe['schedule_best']}")
+            w(f"{'':>7}pipeline_probe: " + "  ".join(parts) + "\n")
         zprobe = r.get("zero_probe")
         if isinstance(zprobe, dict):
             parts = [

@@ -289,6 +289,64 @@ def render(report, out=sys.stdout):
                 w(f"  !! replication: {int(rep_n)} finding(s), "
                   f"{_fmt_bytes(rep_bytes)} wasted per device\n")
 
+    # -- recompute (memory-budgeted recompute planner; parallel/
+    # remat_plan.py) --------------------------------------------------
+    # smp_recompute_* gauges: the active stash plan per schedule (mode,
+    # per-chunk decisions, ring slots), its stash bytes vs the budget,
+    # and the planner's executed-FLOP recompute fractions before (full)
+    # and after (planned) — next to the measured census fraction the
+    # hlo-audit section shows for the compiled program.
+    rc_scheds = sorted({
+        s["labels"].get("schedule", "?")
+        for s in _series(report, "smp_recompute_mode_info")
+    })
+    if rc_scheds:
+        w("\n-- recompute --\n")
+        for sched in rc_scheds:
+            mode = effective = None
+            for s in _series(report, "smp_recompute_mode_info"):
+                if s["labels"].get("schedule") == sched:
+                    mode = s["labels"].get("mode")
+                    effective = s["labels"].get("effective")
+            line = f"{sched}: mode {mode}"
+            if effective and effective != mode:
+                line += f" -> {effective}"
+            n_stash = _value(report, "smp_recompute_chunks",
+                             schedule=sched, decision="stash")
+            n_rec = _value(report, "smp_recompute_chunks",
+                           schedule=sched, decision="recompute")
+            if n_stash is not None:
+                line += (f"   chunks: {int(n_stash)} stashed"
+                         + (f", {int(n_rec)} degraded" if n_rec else ""))
+            w(line + "\n")
+            res_slots = _value(report, "smp_recompute_ring_slots",
+                               schedule=sched, ring="residual")
+            cot_slots = _value(report, "smp_recompute_ring_slots",
+                               schedule=sched, ring="cotangent")
+            stash_b = _value(report, "smp_recompute_stash_bytes",
+                             schedule=sched)
+            budget_b = _value(report, "smp_recompute_budget_bytes",
+                              schedule=sched)
+            if stash_b is not None:
+                line = f"  stash: {_fmt_bytes(stash_b)}/device"
+                if budget_b is not None:
+                    line += f" vs budget {_fmt_bytes(budget_b)}"
+                else:
+                    line += " (unbudgeted)"
+                if res_slots is not None:
+                    line += (f"  [rings: residual x{int(res_slots)}"
+                             + (f", cotangent x{int(cot_slots)}"
+                                if cot_slots else "") + "]")
+                w(line + "\n")
+            before = _value(report, "smp_recompute_predicted_fraction",
+                            schedule=sched, when="full")
+            after = _value(report, "smp_recompute_predicted_fraction",
+                           schedule=sched, when="planned")
+            if before is not None and after is not None:
+                w(f"  recompute census (planner model): "
+                  f"{100 * before:.0f}% full -> {100 * after:.0f}% "
+                  "planned (measured program census in -- hlo audit --)\n")
+
     # -- zero (ZeRO-3 fully-sharded params; parallel/zero.py + the X-ray's
     # zero_report) ------------------------------------------------------
     # smp_zero3_* gauges: rdp-axis parameter-gather / gradient-scatter

@@ -122,6 +122,28 @@ class ModelParallelConfig:
                     f"SMP_ZERO3_BUCKET_MB={env_bucket!r} is not an integer"
                 )
 
+        # Environment aliases for the recompute planner (SMP_RECOMPUTE /
+        # SMP_RECOMPUTE_BUDGET_MB), same precedence rule as the ZeRO ones.
+        env_recompute = os.environ.get("SMP_RECOMPUTE")
+        if env_recompute is not None and "recompute" not in user_config:
+            val = env_recompute.strip().lower()
+            if val in ("full", "stash_weight", "stash_all", "auto"):
+                user_config["recompute"] = val
+            else:
+                raise ConfigError(
+                    f"SMP_RECOMPUTE={env_recompute!r}: expected "
+                    "full/stash_weight/stash_all/auto"
+                )
+        env_rbudget = os.environ.get("SMP_RECOMPUTE_BUDGET_MB")
+        if env_rbudget is not None and "recompute_budget_mb" not in user_config:
+            try:
+                user_config["recompute_budget_mb"] = int(env_rbudget)
+            except ValueError:
+                raise ConfigError(
+                    f"SMP_RECOMPUTE_BUDGET_MB={env_rbudget!r} is not an "
+                    "integer"
+                )
+
         # Resolve aliases (e.g. partitions -> pipeline_parallel_degree).
         alias_map = {
             spec["alias"]: key for key, spec in SCHEMA.items() if "alias" in spec

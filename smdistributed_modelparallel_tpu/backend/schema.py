@@ -491,6 +491,37 @@ SCHEMA = {
         "4) above which fused_ce: auto switches to the no-materialize "
         "Pallas CE kernel.",
     },
+    "recompute": {
+        "type": str,
+        "default": "full",
+        "options": ["full", "stash_weight", "stash_all", "auto"],
+        "description": "TPU extension: memory-budgeted recompute planner "
+        "(env alias SMP_RECOMPUTE). 'full' (default): every backward pass "
+        "re-runs its chunk forward (activation recomputation; the compiled "
+        "program is byte-identical to older builds). 'stash_weight': the "
+        "zero-bubble executor's B pass captures per-layer jax.vjp "
+        "residuals so the deferred W pass consumes them instead of "
+        "re-running the forward — a single forward per microbatch. "
+        "'stash_all': residuals are captured at the forward pass itself, "
+        "removing the B recompute too (also applies to the "
+        "interleaved/1F1B executors). 'auto': the schedule's default "
+        "stash (stash_weight on zero_bubble — memory-conservative, its "
+        "rings cost only the existing W-queue depth; stash_all on "
+        "interleaved/1F1B) budgeted against recompute_budget_mb and "
+        "degraded per-(stage, chunk) by the planner "
+        "(parallel/remat_plan.py). Non-pipeline paths map the knob onto "
+        "jax.checkpoint policies (dots_with_no_batch_dims_saveable "
+        "family).",
+    },
+    "recompute_budget_mb": {
+        "type": (int, type(None)),
+        "default": None,
+        "lower_bound": 0,
+        "description": "TPU extension: stash budget in MiB for "
+        "recompute: auto (env alias SMP_RECOMPUTE_BUDGET_MB). Unset: the "
+        "XLA memory-breakdown temp bytes of the last audited program, "
+        "else the planner's own ring bound (stash everything).",
+    },
     "_device_count_override": {
         "type": (int, type(None)),
         "default": None,

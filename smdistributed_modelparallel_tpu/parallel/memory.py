@@ -38,10 +38,32 @@ def offload_supported():
 
 
 def remat_policy():
-    """Checkpoint policy for layer remat, honoring offload_activations."""
+    """Checkpoint policy for layer remat, honoring offload_activations
+    and the ``recompute`` knob.
+
+    ``recompute: "full"`` (the default) returns exactly what the pre-knob
+    build returned — None (full remat) or the offload policy — so default
+    programs stay byte-identical. The stash modes map onto the
+    ``dots_with_no_batch_dims_saveable`` policy family: non-pipeline runs
+    (pp=1 microbatch scan, fill-drain) have no schedule for the recompute
+    planner to stash against, so the same memory-for-FLOPs trade is taken
+    one level down, inside ``jax.checkpoint``: ``stash_weight``/``auto``
+    save the weight-matmul outputs (the dominant recompute), ``stash_all``
+    saves everything (checkpoint becomes a no-op boundary). Offloading
+    takes precedence — an offload policy already saves the layer boundary
+    to host, and combining the two would double-store.
+    """
     global _warned_offload
     cfg = state.cfg
     if cfg is None or not cfg.offload_activations:
+        if cfg is not None:
+            from smdistributed_modelparallel_tpu.parallel import remat_plan
+
+            mode = remat_plan.resolve(cfg)
+            if mode in ("stash_weight", "auto"):
+                return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if mode == "stash_all":
+                return jax.checkpoint_policies.everything_saveable
         return None  # full remat
     if not offload_supported():
         if not _warned_offload:
@@ -173,6 +195,83 @@ def zero_bubble_ring_plan(fwd_k, fwd_m, bwd_k, bwd_m, wgt_k, wgt_m,
         "stash_alive_peak": stash_alive_peak,
         "w_queue_peak": w_queue_peak,
         "extra_ring_slots": ring_slots - (int(window) + 1),
+    }
+
+
+def _ring_slots_for(write_ticks, read_ticks):
+    """Minimum ``m % R`` ring size for one chunk's stash entries: entry m
+    is written at ``write_ticks[m]`` and last read at ``read_ticks[m]``
+    (both m-ordered — the schedules are FIFO per (stage, chunk)). The
+    executors order sub-steps F -> B -> W within a tick and every stash
+    write-pass precedes its read-pass, so a same-tick write of entry
+    ``m + R`` lands BEFORE the read of entry ``m`` — strict inequality is
+    required, i.e. entry ``m`` counts as alive through its read tick."""
+    import bisect
+
+    peak = 0
+    for m, wt in enumerate(write_ticks):
+        # Entries m' < m still alive at this write: read tick >= wt.
+        first_alive = bisect.bisect_left(read_ticks, wt)
+        peak = max(peak, m - first_alive + 1)
+    return max(peak, 1)
+
+
+def recompute_ring_plan(fwd_k, fwd_m, bwd_k, bwd_m, wgt_k=None, wgt_m=None,
+                        num_stages=1, virtual=1):
+    """Stash-ring budget of the recompute planner (``parallel/
+    remat_plan.py``): exact per-(stage, chunk) ring sizes for the three
+    residual-stash lifetimes the stash executors use, walked from the
+    static schedule like ``zero_bubble_ring_plan``:
+
+    - ``b_to_w``: entries written by the B pass, consumed by the W pass —
+      the ``stash_weight`` residual + cotangent rings (== the W-queue
+      depth under the strict write-before-read slot convention);
+    - ``f_to_w``: written at F, consumed at W — the ``stash_all``
+      residual ring on the zero-bubble schedule;
+    - ``f_to_b``: written at F, consumed at B — the ``stash_all``
+      residual ring on the interleaved/1F1B schedules (pass ``wgt_*`` as
+      None for those).
+
+    Returns ``{"b_to_w", "f_to_w", "f_to_b", "per_chunk": {name: [C]}}``
+    (global-chunk-indexed per-chunk peaks; the scalar is their max).
+    """
+    import numpy as np
+
+    S, V = int(num_stages), int(virtual)
+    C = S * V
+    n_ticks = int(np.asarray(fwd_m).shape[0])
+
+    def ticks_of(k_arr, m_arr):
+        out = [[] for _ in range(C)]
+        if k_arr is None or m_arr is None:
+            return None
+        k_arr = np.asarray(k_arr)
+        m_arr = np.asarray(m_arr)
+        for t in range(n_ticks):
+            for s in range(S):
+                if m_arr[t, s] >= 0:
+                    out[int(k_arr[t, s]) * S + s].append(t)
+        return out
+
+    f_ticks = ticks_of(fwd_k, fwd_m)
+    b_ticks = ticks_of(bwd_k, bwd_m)
+    w_ticks = ticks_of(wgt_k, wgt_m)
+
+    per_chunk = {"b_to_w": [], "f_to_w": [], "f_to_b": []}
+    for c in range(C):
+        if w_ticks is not None:
+            per_chunk["b_to_w"].append(
+                _ring_slots_for(b_ticks[c], w_ticks[c])
+            )
+            per_chunk["f_to_w"].append(
+                _ring_slots_for(f_ticks[c], w_ticks[c])
+            )
+        per_chunk["f_to_b"].append(_ring_slots_for(f_ticks[c], b_ticks[c]))
+    return {
+        "b_to_w": max(per_chunk["b_to_w"], default=0),
+        "f_to_w": max(per_chunk["f_to_w"], default=0),
+        "f_to_b": max(per_chunk["f_to_b"], default=0),
+        "per_chunk": per_chunk,
     }
 
 

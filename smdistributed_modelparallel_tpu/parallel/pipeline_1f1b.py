@@ -505,6 +505,219 @@ def _chunk_acc_rows(acc, rows, krow, act):
     return jax.tree_util.tree_map(upd, acc, rows)
 
 
+def _make_residual_split(apply_one_layer, cast_half, rng, maxp, aux_seed,
+                         has_sides, side_leaf_avals=None):
+    """Per-layer vjp split of one chunk application, for the recompute
+    planner's stash modes (``parallel/remat_plan.py``).
+
+    The fused executors differentiate the whole chunk under one
+    ``jax.vjp``, so the deferred weight-grad pass must re-run the chunk
+    forward to rebuild the vjp's residuals. Here the chunk forward is
+    instead run with a PER-LAYER ``jax.vjp`` whose function output is
+    returned as flattened pytree leaves (`jax.vjp`'s vjp function is a
+    ``tree_util.Partial`` — its leaves ARE the saved residuals), so a
+    later pass can rebuild each layer's vjp with ``tree_unflatten`` and
+    the treedef captured at trace time:
+
+    - ``capture_fwd``: the chunk forward, additionally returning the
+      per-layer residual leaves stacked over the layer axis;
+    - ``bwd_from_res``: the input-grad sweep from residuals — reverse
+      per-layer vjp chain seeded by the chunk-output cotangent, returning
+      (input cotangent, side cotangent leaves, per-layer OUTPUT
+      cotangents). The per-layer weight cotangents are never used here,
+      so XLA dead-code-eliminates their matmuls;
+    - ``wgt_from_res``: the weight-grad pass — per-layer vjp calls from
+      (residuals, stashed per-layer cotangents), keeping only the weight
+      cotangents (the input-grad matmuls are dead and eliminated). No
+      forward, no cotangent chain: weight-grad FLOPs only.
+
+    The captured treedef (``captured["treedef"]``) comes from whichever
+    trace runs first (the executors probe with ``jax.eval_shape``); the
+    embedded backward is jaxpr-closed and trace-independent, so leaves
+    written by one compiled segment reconstruct in another.
+    """
+    captured = {}
+
+    def capture_fwd(chunk_lp, chunk_lxs, x, side, c_idx, m_idx, act_row):
+        base = jax.random.fold_in(jax.random.fold_in(rng, c_idx), m_idx)
+
+        def body(c, xs):
+            lp, lxs, i, act = xs
+
+            def one(lp_, c_, side_):
+                new_c, aux = apply_one_layer(
+                    cast_half(lp_), c_, lxs, jax.random.fold_in(base, i),
+                    side_,
+                )
+                out_c = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(act, n, o), new_c, c_
+                )
+                return out_c, jnp.where(act, aux, 0.0)
+
+            if has_sides:
+                (out_c, aux), lvjp = jax.vjp(one, lp, c, side)
+            else:
+                (out_c, aux), lvjp = jax.vjp(
+                    lambda lp_, c_: one(lp_, c_, None), lp, c
+                )
+            leaves, treedef = jax.tree_util.tree_flatten(lvjp)
+            captured.setdefault("treedef", treedef)
+            return out_c, (aux, tuple(leaves))
+
+        idx = jnp.arange(maxp)
+        out, (auxs, res) = jax.lax.scan(
+            body, x, (chunk_lp, chunk_lxs, idx, act_row)
+        )
+        return out, jnp.sum(auxs), res
+
+    def _unflatten(res_layer):
+        return jax.tree_util.tree_unflatten(
+            captured["treedef"], list(res_layer)
+        )
+
+    def bwd_from_res(res, cot):
+        side_zeros = [
+            jnp.zeros(a.shape, jnp.float32) for a in (side_leaf_avals or [])
+        ]
+
+        def body(carry, res_layer):
+            cbar, side_acc = carry
+            lvjp = _unflatten(res_layer)
+            outs = lvjp((cbar, aux_seed))
+            if has_sides:
+                _d_lp, d_c, d_side = outs
+                leaves, _, idx = _inexact_leaves(d_side)
+                side_acc = [
+                    a + leaves[i].astype(a.dtype)
+                    for a, i in zip(side_acc, idx)
+                ]
+            else:
+                _d_lp, d_c = outs
+            # ys: this layer's OUTPUT cotangent — what its weight-grad
+            # vjp call needs later. _d_lp is unused: dead code.
+            return (d_c, side_acc), cbar
+
+        (d_x, side_acc), cot_stack = jax.lax.scan(
+            body, (cot, side_zeros), res, reverse=True
+        )
+        return d_x, side_acc, cot_stack
+
+    def bwd_full_from_res(res, cot):
+        """Monolithic backward from residuals (the interleaved/1F1B
+        executors' B pass under ``stash_all``): one reverse sweep
+        producing weight grads AND the input cotangent — no forward."""
+        side_zeros = [
+            jnp.zeros(a.shape, jnp.float32) for a in (side_leaf_avals or [])
+        ]
+
+        def body(carry, res_layer):
+            cbar, side_acc = carry
+            lvjp = _unflatten(res_layer)
+            outs = lvjp((cbar, aux_seed))
+            if has_sides:
+                d_lp, d_c, d_side = outs
+                leaves, _, idx = _inexact_leaves(d_side)
+                side_acc = [
+                    a + leaves[i].astype(a.dtype)
+                    for a, i in zip(side_acc, idx)
+                ]
+            else:
+                d_lp, d_c = outs
+            return (d_c, side_acc), d_lp
+
+        (d_x, side_acc), d_lp_stack = jax.lax.scan(
+            body, (cot, side_zeros), res, reverse=True
+        )
+        return d_lp_stack, d_x, side_acc
+
+    def wgt_from_res(res, cot_stack):
+        def body(_, xs):
+            res_layer, cot_layer = xs
+            lvjp = _unflatten(res_layer)
+            outs = lvjp((cot_layer, aux_seed))
+            # Keep only the weight cotangent; d_c / d_side are dead.
+            return (), outs[0]
+
+        _, d_lp_stack = jax.lax.scan(body, (), (res, cot_stack))
+        return d_lp_stack
+
+    return capture_fwd, bwd_from_res, bwd_full_from_res, wgt_from_res, captured
+
+
+def _stash_slot_bytes(avals):
+    """Bytes one (stage, chunk, ring-slot) stash entry costs per device:
+    the probe avals carry a leading stage axis (vmapped rows), which the
+    ring shards over pp — drop it."""
+    return int(sum(
+        a.dtype.itemsize * int(np.prod(a.shape[1:], dtype=np.int64))
+        for a in jax.tree_util.tree_leaves(avals)
+    ))
+
+
+def _probe_stash_avals(S, staged_params, staged_xs, active_rows, carry_aval,
+                       sides, capture_fwd, bwd_from_res=None):
+    """Abstract-trace one vmapped chunk-row capture to learn the stash
+    leaf shapes (and capture the per-layer vjp treedef as a side effect
+    — this must run before any ``bwd_*_from_res`` trace). Returns the
+    residual avals, or ``(res_avals, cot_avals)`` when ``bwd_from_res``
+    is given (the zero-bubble executor also stashes the per-layer
+    output cotangents)."""
+
+    def row_aval(tree):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct((S,) + a.shape[2:], a.dtype),
+            tree,
+        )
+
+    def stage_rows_aval(tree):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct((S,) + a.shape, a.dtype), tree
+        )
+
+    side_row_aval = None
+    if sides is not None:
+        side_row_aval = tuple(
+            jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct((S,) + a.shape[1:], a.dtype),
+                s,
+            )
+            for s in sides
+        )
+
+    def probe(ch_params, ch_xs, x, side, c_ids, mrow, act):
+        _out, _aux, res = jax.vmap(
+            capture_fwd,
+            in_axes=(0, 0, 0, 0 if sides is not None else None, 0, 0, 0),
+        )(ch_params, ch_xs, x, side, c_ids, mrow, act)
+        if bwd_from_res is None:
+            return res
+        cot = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a), x)
+        _d_x, _side_acc, cot_stack = jax.vmap(bwd_from_res)(res, cot)
+        return res, cot_stack
+
+    return jax.eval_shape(
+        probe,
+        row_aval(staged_params), row_aval(staged_xs),
+        stage_rows_aval(carry_aval), side_row_aval,
+        jax.ShapeDtypeStruct((S,), jnp.int32),
+        jax.ShapeDtypeStruct((S,), jnp.int32),
+        row_aval(active_rows),
+    )
+
+
+def _stash_chunk_maps(plan, V):
+    """Static per-local-chunk maps of a stash plan: ``(stash_of_arr,
+    res_col_arr, Vs, all_stash)`` — whether chunk k stashes, and its
+    column in the Vs-compressed stash rings."""
+    Vs = len(plan.stash_chunks)
+    stash_of_np = np.zeros((V,), bool)
+    res_col_np = np.zeros((V,), np.int32)
+    for col, k in enumerate(plan.stash_chunks):
+        stash_of_np[k] = True
+        res_col_np[k] = col
+    return (jnp.asarray(stash_of_np), jnp.asarray(res_col_np), Vs, Vs == V)
+
+
 def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
                   loss_seed_scale):
     """Run the full 1F1B forward+backward for all microbatches.
@@ -527,19 +740,48 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
     spec = model._pipeline_spec
     cfg = state.cfg
     virtual = int(getattr(cfg, "virtual_pipeline_degree", 1) or 1)
+    from smdistributed_modelparallel_tpu.parallel import remat_plan
+
+    rmode = remat_plan.resolve(cfg)
     if getattr(cfg, "pipeline", "interleaved") == "zero_bubble":
         # ZB-H1: backward split into input-grad/weight-grad passes; the
-        # executor is chunk-generalized for any v >= 1.
+        # executor is chunk-generalized for any v >= 1. A non-default
+        # recompute plan routes to the stash executor (which itself
+        # falls back here when the plan degrades every chunk).
+        if rmode != "full":
+            return _pipeline_zero_bubble_stash(
+                model, params, stacked_inputs, rng, mb_loss_fn,
+                loss_seed_scale, virtual, rmode,
+            )
         return _pipeline_zero_bubble(
             model, params, stacked_inputs, rng, mb_loss_fn, loss_seed_scale,
             virtual,
         )
-    if virtual > 1:
+    if rmode == "stash_weight":
+        # No deferred weight-grad pass to stash for on the fused
+        # schedules: the SCHEDULE-level stash is inert here (the knob
+        # still maps onto the jax.checkpoint policy in
+        # memory.remat_policy for models that rematerialize, and the
+        # fingerprint config snapshot keeps recording the knob).
+        logger.warning(
+            "recompute: 'stash_weight' targets the zero_bubble schedule's "
+            "W pass; pipeline: %r has none — no schedule-level stash "
+            "(use 'stash_all' to remove this schedule's B recompute).",
+            getattr(cfg, "pipeline", "interleaved"),
+        )
+        rmode = "full"
+    if virtual > 1 or rmode in ("stash_all", "auto"):
         # Interleaved virtual stages take the generalized executor; the
-        # default path below stays byte-for-byte the v=1 program.
+        # default path below stays byte-for-byte the v=1 program. The
+        # stash modes also route v=1 through it (the plan needs the
+        # chunked ring layout), leaving the plain executor untouched —
+        # including when an auto plan later degrades every chunk: the
+        # run then stays on the chunk-generalized executor at v=1
+        # (numerically identical, chunk-ring program) rather than
+        # re-entering this dispatch.
         return _pipeline_1f1b_virtual(
             model, params, stacked_inputs, rng, mb_loss_fn, loss_seed_scale,
-            virtual,
+            virtual, rmode=rmode,
         )
     S = cfg.pipeline_parallel_degree
     M = cfg.microbatches
@@ -1086,8 +1328,16 @@ def pipeline_1f1b(model, params, stacked_inputs, rng, mb_loss_fn,
 
 
 def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
-                           loss_seed_scale, virtual):
+                           loss_seed_scale, virtual, rmode="full"):
     """1F1B with ``virtual`` interleaved model chunks per pipeline stage.
+
+    ``rmode`` ("full" default) is the recompute-planner knob: under
+    ``stash_all``/``auto`` the forward sub-step captures per-layer vjp
+    residuals into a stash ring (``memory.recompute_ring_plan``'s
+    ``f_to_b`` lifetime) and the backward sub-step consumes them instead
+    of re-running the chunk forward under ``jax.vjp`` — the 1F1B
+    B-recompute disappears where the plan stashes. At the default every
+    code path below is untouched (the plan machinery never runs).
 
     Same numerical contract as the v=1 executor (grads/losses/outputs
     interchangeable with the fill-drain path), different schedule shape:
@@ -1415,6 +1665,50 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
     _scatter_add_leaf = _chunk_scatter_add_leaf
     scatter_chunk_stat = _chunk_scatter_stat
 
+    # ---- recompute planner (stash_all / auto): capture residuals at F,
+    # consume at B — everything below is inert at rmode == "full".
+    rstash = False
+    all_rstash = True
+    fres0 = None
+    if rmode != "full":
+        from smdistributed_modelparallel_tpu.parallel import remat_plan
+        from smdistributed_modelparallel_tpu.parallel.memory import (
+            recompute_ring_plan,
+        )
+
+        stash_rings = recompute_ring_plan(
+            fwd_k_np, fwd_m_np, bwd_k_np, bwd_m_np,
+            num_stages=S, virtual=V,
+        )
+        side_leaf_avals = (
+            [side_leaves[i] for i in side_idx] if sides is not None else []
+        )
+        (capture_fwd, _bwd_in, bwd_full_from_res, _wgt,
+         _captured) = _make_residual_split(
+            apply_one_layer, cast_half, rng, maxp, aux_seed,
+            sides is not None, side_leaf_avals=side_leaf_avals,
+        )
+        res_avals = _probe_stash_avals(
+            S, staged_params, staged_xs, active_rows, carry_aval, sides,
+            capture_fwd,
+        )
+        rplan = remat_plan.plan_pipeline(
+            "1f1b", rmode, S, V,
+            res_ring_slots=stash_rings["f_to_b"], cot_ring_slots=0,
+            res_slot_bytes=_stash_slot_bytes(res_avals),
+            cot_slot_bytes=0, cfg=cfg,
+        )
+        if rplan.effective != "full":
+            rstash = True
+            stash_of_arr, res_col_arr, Vs_r, all_rstash = (
+                _stash_chunk_maps(rplan, V)
+            )
+            Rfb = rplan.res_ring_slots
+            fres0 = jax.tree_util.tree_map(
+                lambda a: jnp.zeros((S, Vs_r, Rfb) + a.shape[1:], a.dtype),
+                res_avals,
+            )
+
     hc = health.active()
 
     def tick_impl(carry, t, do_fwd, do_bwd):
@@ -1422,6 +1716,10 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
         warmup ticks compile only the forward sub-step, cooldown ticks only
         the backward one — the idle sub-steps are never part of the
         program, which is what the occupancy accounting assumes."""
+        fres = None
+        if rstash:
+            fres = carry[-1]
+            carry = carry[:-1]
         if hc is not None:
             (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, dlay, drep,
              dembed, dsides, losses, outs, (hbad, habs, hmb)) = carry
@@ -1493,11 +1791,25 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
             f_sides = gather_sides_rows(fmc)
             c_ids = fkc * S + stage_ids
             with named_region("smp/pipeline/tick_fwd"):
-                outs_f, _aux_f = jax.vmap(
-                    chunk_fwd,
-                    in_axes=(0, 0, 0, 0 if sides is not None else None,
-                             0, 0, 0),
-                )(ch_params, ch_xs, x_in, f_sides, c_ids, fmc, ch_act)
+                if rstash:
+                    # Same forward compute; the per-layer vjp capture
+                    # additionally emits the residual leaves the backward
+                    # sub-step will consume instead of re-running this.
+                    outs_f, _aux_f, res_f = jax.vmap(
+                        capture_fwd,
+                        in_axes=(0, 0, 0, 0 if sides is not None else None,
+                                 0, 0, 0),
+                    )(ch_params, ch_xs, x_in, f_sides, c_ids, fmc, ch_act)
+                    fres = set_ring(
+                        fres, res_col_arr[fkc], fmc % Rfb, res_f,
+                        f_active & stash_of_arr[fkc],
+                    )
+                else:
+                    outs_f, _aux_f = jax.vmap(
+                        chunk_fwd,
+                        in_axes=(0, 0, 0, 0 if sides is not None else None,
+                                 0, 0, 0),
+                    )(ch_params, ch_xs, x_in, f_sides, c_ids, fmc, ch_act)
             outs_f = pin_stage_axis(outs_f)
             stash = set_ring(stash, fkc, f_slots, x_in, f_active)
             if hc is not None:
@@ -1594,13 +1906,55 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
                 _, vjp = jax.vjp(f, lp, x, side)
                 return vjp((cot, aux_seed))
 
+            d_side_leaf_rows = None
             with named_region("smp/pipeline/tick_bwd"):
-                d_lp_rows, d_x_rows, d_side_rows = jax.vmap(
-                    chunk_bwd,
-                    in_axes=(0, 0, 0, 0 if sides is not None else None,
-                             0, 0, 0, 0),
-                )(ch_params_b, ch_xs_b, stash_in,
-                  b_sides, cot_in, c_ids_b, bmc, ch_act_b)
+                if rstash:
+                    # Backward from the residuals the forward sub-step
+                    # stashed: no forward re-run for stashed chunks.
+                    res_b = get_ring(fres, res_col_arr[bkc], bmc % Rfb)
+                    d_lp_res, d_x_res, side_res = jax.vmap(
+                        bwd_full_from_res
+                    )(res_b, cot_in)
+                    if all_rstash:
+                        d_lp_rows, d_x_rows = d_lp_res, d_x_res
+                        d_side_leaf_rows = side_res
+                    else:
+                        # Budget-degraded chunks keep the recompute path;
+                        # a static per-chunk mask selects.
+                        d_lp_rec, d_x_rec, d_side_rec = jax.vmap(
+                            chunk_bwd,
+                            in_axes=(0, 0, 0,
+                                     0 if sides is not None else None,
+                                     0, 0, 0, 0),
+                        )(ch_params_b, ch_xs_b, stash_in,
+                          b_sides, cot_in, c_ids_b, bmc, ch_act_b)
+                        bmask = stash_of_arr[bkc]
+
+                        def sel(a, b):
+                            return jnp.where(
+                                bmask.reshape((S,) + (1,) * (a.ndim - 1)),
+                                a, b.astype(a.dtype),
+                            )
+
+                        d_lp_rows = jax.tree_util.tree_map(
+                            sel, d_lp_res, d_lp_rec
+                        )
+                        d_x_rows = jax.tree_util.tree_map(
+                            sel, d_x_res, d_x_rec
+                        )
+                        if sides is not None:
+                            rec_all, _, _ = _inexact_leaves(d_side_rec)
+                            d_side_leaf_rows = [
+                                sel(a, rec_all[i])
+                                for a, i in zip(side_res, side_idx)
+                            ]
+                else:
+                    d_lp_rows, d_x_rows, d_side_rows = jax.vmap(
+                        chunk_bwd,
+                        in_axes=(0, 0, 0, 0 if sides is not None else None,
+                                 0, 0, 0, 0),
+                    )(ch_params_b, ch_xs_b, stash_in,
+                      b_sides, cot_in, c_ids_b, bmc, ch_act_b)
             d_lp_rows = pin_stage_axis(d_lp_rows)
             d_x_rows = pin_stage_axis(d_x_rows)
 
@@ -1619,18 +1973,27 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
             )
 
             if sides is not None and dsides is not None:
-                def one_stage_side_add(ds, s):
-                    row_leaves, _, _ = _inexact_leaves(
-                        jax.tree_util.tree_map(lambda r: r[s], d_side_rows)
-                    )
-                    vals = [row_leaves[i] for i in side_idx]
-                    return [
-                        _scatter_add_leaf(d, bmc[s], v, b_active[s])
-                        for d, v in zip(ds, vals)
-                    ]
+                if d_side_leaf_rows is not None:
+                    for s in range(S):
+                        dsides = [
+                            _scatter_add_leaf(d, bmc[s], leaf[s], b_active[s])
+                            for d, leaf in zip(dsides, d_side_leaf_rows)
+                        ]
+                else:
+                    def one_stage_side_add(ds, s):
+                        row_leaves, _, _ = _inexact_leaves(
+                            jax.tree_util.tree_map(
+                                lambda r: r[s], d_side_rows
+                            )
+                        )
+                        vals = [row_leaves[i] for i in side_idx]
+                        return [
+                            _scatter_add_leaf(d, bmc[s], v, b_active[s])
+                            for d, v in zip(ds, vals)
+                        ]
 
-                for s in range(S):
-                    dsides = one_stage_side_add(dsides, s)
+                    for s in range(S):
+                        dsides = one_stage_side_add(dsides, s)
 
             losses = losses.at[m_last].set(
                 jnp.where(is_lastk, loss_m.astype(jnp.float32), losses[m_last])
@@ -1642,6 +2005,8 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
                      drep, dembed, dsides, losses, outs)
         if hc is not None:
             new_carry = new_carry + ((hbad, habs, hmb),)
+        if rstash:
+            new_carry = new_carry + (fres,)
         return new_carry, None
 
     carry0 = (
@@ -1655,6 +2020,8 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
             jnp.zeros((S, V), jnp.float32), jnp.zeros((S, V), jnp.float32),
             jnp.full((S, V), -1.0, jnp.float32),
         ),)
+    if rstash:
+        carry0 = carry0 + (pin_stage_axis(fres0),)
 
     # Named profiler regions per schedule phase: an XLA trace of the
     # compiled step shows the warmup/steady/cooldown loops as separately
@@ -1674,6 +2041,8 @@ def _pipeline_1f1b_virtual(model, params, stacked_inputs, rng, mb_loss_fn,
             lambda c, t: tick_impl(c, t, False, True), carry_end,
             jnp.arange(t_fe, n_ticks),
         )
+    if rstash:
+        carry_end = carry_end[:-1]
     if hc is not None:
         (_, _, _, _, _, _, dlay, drep, dembed, dsides, losses, outs,
          (hbad, habs, hmb)) = carry_end
@@ -2419,6 +2788,798 @@ def _pipeline_zero_bubble(model, params, stacked_inputs, rng, mb_loss_fn,
     else:
         (_, _, _, _, _, _, dlay, drep, dembed, dsides, losses,
          outs) = carry_end
+
+    # ---- embedding backward ------------------------------------------
+
+    def embed_bwd(acc, xs):
+        mb_input, key, dcarry, dside_row = xs
+
+        def embed_inexact(p_rest):
+            args, kwargs = mb_input
+            out, aux = apply_collecting_aux(
+                module, {"params": cast_half(with_layers(p_rest))}, *args,
+                rngs=_mk_rngs(model, key, "embed"),
+                method=spec.embed_method, **kwargs,
+            )
+            leaves, _, idx = _inexact_leaves(out)
+            return [leaves[i] for i in idx] + [aux]
+
+        out_aval = jax.eval_shape(embed_inexact, params_rest)
+        if sides is not None:
+            cots = list(jax.tree_util.tree_leaves(dcarry)) + list(dside_row)
+        else:
+            cots = jax.tree_util.tree_leaves(dcarry)
+        cots = cots + [aux_seed]
+        cots = [c.astype(a.dtype) for c, a in zip(cots, out_aval)]
+        _, vjp = jax.vjp(embed_inexact, params_rest)
+        (dp,) = vjp(cots)
+        acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(a.dtype), acc, dp
+        )
+        return acc, None
+
+    if spec.embed_method is not None:
+        demb_params0 = param_grad_zeros(params_rest)
+        dside_stack = tuple(dsides) if dsides is not None else ()
+        demb_params, _ = jax.lax.scan(
+            embed_bwd, demb_params0,
+            (stacked_inputs, mb_keys, dembed, dside_stack),
+        )
+    else:
+        demb_params = None
+
+    # ---- assemble the full gradient tree -----------------------------
+
+    flat_idx = jnp.asarray(idx_np.reshape(-1))
+    flat_mask = active_np.reshape(-1)
+
+    def to_layers(g):
+        gf = g.reshape((S * V * maxp,) + g.shape[3:])
+        gf = gf * flat_mask.reshape((-1,) + (1,) * (gf.ndim - 1))
+        return jnp.zeros((L,) + g.shape[3:], g.dtype).at[flat_idx].add(gf)
+
+    layer_grads = jax.tree_util.tree_map(to_layers, dlay)
+    if demb_params is not None:
+        drep = jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(a.dtype), drep, demb_params
+        )
+    grads = _set_subtree(drep, spec.layer_path, layer_grads)
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(jnp.result_type(p)), grads, params
+    )
+    return grads, losses, outs
+
+
+def _pipeline_zero_bubble_stash(model, params, stacked_inputs, rng,
+                                mb_loss_fn, loss_seed_scale, virtual, rmode):
+    """ZB-H1 executor under a non-default recompute plan
+    (``recompute: stash_weight | stash_all | auto``).
+
+    Same numerical contract and schedule as ``_pipeline_zero_bubble``;
+    two structural differences, both existing only on this knob-gated
+    path (the default executor stays byte-identical):
+
+    - **Residual stash instead of W-pass recompute**: the B sub-step
+      runs the chunk forward as per-layer ``jax.vjp`` captures
+      (``_make_residual_split``), writing the flattened residual leaves
+      and the per-layer output cotangents into stash rings sized by
+      ``memory.recompute_ring_plan``; the deferred W sub-step rebuilds
+      each layer's vjp from the rings and computes weight-grad matmuls
+      ONLY — no forward re-run, no cotangent chain. Under ``stash_all``
+      the residuals are captured at the F sub-step itself, so B skips
+      its forward too. ``auto`` plans per-(stage, chunk): degraded
+      chunks keep the recompute path (both paths compile, selected by a
+      static per-chunk mask).
+
+    - **One scan, conditional sub-steps**: instead of one compiled scan
+      per contiguous segment of active passes, the whole tick range is
+      ONE scan whose F/B/W sub-steps run under ``lax.cond`` keyed by
+      static per-tick activity arrays. Out-of-phase ticks skip their
+      sub-steps at runtime (same executed work as the segmented loops,
+      modulo rare mid-span gap ticks, which execute masked), and each
+      pass's ops are compiled exactly ONCE — the segmented executor
+      compiles every pass into each of its segments, which is most of
+      what the structural remat census counts against the ZB schedule.
+    """
+    spec = model._pipeline_spec
+    cfg = state.cfg
+    S = cfg.pipeline_parallel_degree
+    M = cfg.microbatches
+    L = spec.num_layers
+    V = virtual
+    W = min(cfg.active_microbatches or (S + 1), M)
+    from smdistributed_modelparallel_tpu.nn.auto_distribute import unwrap_hooks
+
+    module = unwrap_hooks(model.module)
+    layer_module = spec.layer_module
+    half = cfg.half_dtype
+
+    (fwd_k_np, fwd_m_np, bwd_k_np, bwd_m_np, wgt_k_np,
+     wgt_m_np) = build_zero_bubble_schedule(S, M, W, V)
+    n_ticks = fwd_m_np.shape[0]
+
+    from smdistributed_modelparallel_tpu.parallel.memory import (
+        recompute_ring_plan,
+        zero_bubble_ring_plan,
+    )
+    from smdistributed_modelparallel_tpu.parallel import remat_plan
+
+    plan_rings = zero_bubble_ring_plan(
+        fwd_k_np, fwd_m_np, bwd_k_np, bwd_m_np, wgt_k_np, wgt_m_np,
+        num_stages=S, virtual=V, window=W,
+    )
+    R1 = plan_rings["ring_slots"]
+    stash_rings = recompute_ring_plan(
+        fwd_k_np, fwd_m_np, bwd_k_np, bwd_m_np, wgt_k_np, wgt_m_np,
+        num_stages=S, virtual=V,
+    )
+
+    from smdistributed_modelparallel_tpu.utils import health
+    from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+        flight_recorder,
+    )
+    from smdistributed_modelparallel_tpu.utils.telemetry import (
+        record_pipeline_occupancy,
+        telemetry,
+    )
+
+    # Static per-tick activity: which sub-steps this tick executes. A
+    # sub-step also runs (masked) on a tick whose PREVIOUS tick produced
+    # stage transfers that still need merging — the transfer registers
+    # hold exactly one tick, so the merge cannot be deferred past it.
+    stage_col = np.arange(S)[None, :]
+    f_any = (fwd_m_np >= 0).any(axis=1)
+    b_any = (bwd_m_np >= 0).any(axis=1)
+    w_any = (wgt_m_np >= 0).any(axis=1)
+    f_xfer = ((fwd_m_np >= 0)
+              & ~((stage_col == S - 1) & (fwd_k_np == V - 1))).any(axis=1)
+    b_xfer = ((bwd_m_np >= 0)
+              & ~((stage_col == 0) & (bwd_k_np == 0))).any(axis=1)
+    f_run = f_any.copy()
+    f_run[1:] |= f_xfer[:-1]
+    b_run = b_any.copy()
+    b_run[1:] |= b_xfer[:-1]
+    w_run = w_any
+
+    busy, total = schedule_occupancy(
+        fwd_m_np, bwd_m_np, fwd_ticks=int(f_run.sum()),
+        bwd_ticks=int(b_run.sum()), wgt=wgt_m_np,
+        wgt_ticks=int(w_run.sum()),
+    )
+    record_pipeline_occupancy(
+        "zb", S, M, busy_slots=busy, total_slots=total, virtual=V,
+        passes=3,
+        pass_ticks={"fwd": int(f_run.sum()), "bwd_input": int(b_run.sum()),
+                    "bwd_weight": int(w_run.sum())},
+    )
+    _ring_gauge = telemetry.gauge(
+        "smp_pipeline_ring_slots",
+        "per-(stage, chunk) ring-buffer slots of the pipeline executor",
+    )
+    _ring_gauge.labels(schedule="zb").set(R1)
+    telemetry.gauge(
+        "smp_pipeline_wqueue_peak",
+        "peak deferred weight-grad units per (stage, chunk) [zero-bubble]",
+    ).labels(schedule="zb").set(plan_rings["w_queue_peak"])
+    flight_recorder.record_schedule(
+        "zb",
+        ((t, s, d, int(m_arr[t, s]), int(k_arr[t, s]) * S + s, p)
+         for t in range(n_ticks) for s in range(S)
+         for d, p, k_arr, m_arr in (
+             ("fwd", "F", fwd_k_np, fwd_m_np),
+             ("bwd_input", "B", bwd_k_np, bwd_m_np),
+             ("bwd_weight", "W", wgt_k_np, wgt_m_np))
+         if m_arr[t, s] >= 0),
+    )
+    fwd_k_sched = jnp.asarray(fwd_k_np)
+    fwd_m_sched = jnp.asarray(fwd_m_np)
+    bwd_k_sched = jnp.asarray(bwd_k_np)
+    bwd_m_sched = jnp.asarray(bwd_m_np)
+    wgt_k_sched = jnp.asarray(wgt_k_np)
+    wgt_m_sched = jnp.asarray(wgt_m_np)
+    f_run_sched = jnp.asarray(f_run)
+    b_run_sched = jnp.asarray(b_run)
+    w_run_sched = jnp.asarray(w_run)
+
+    from smdistributed_modelparallel_tpu.parallel.pipeline import (
+        _get_subtree,
+        _mk_rngs,
+        _scan_map,
+        chunk_layout,
+        staged_chunk_views,
+    )
+
+    def cast_half(tree):
+        from smdistributed_modelparallel_tpu.nn.utils import half_cast
+
+        return half_cast(tree, half)
+
+    layer_params = _get_subtree(params, spec.layer_path)
+    staged_params, staged_xs, active_rows = staged_chunk_views(
+        spec, layer_params, S, V
+    )
+
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    from smdistributed_modelparallel_tpu.backend.topology import PP_AXIS
+
+    mesh = state.mesh
+    _pp_size = dict(mesh.shape).get(PP_AXIS, 1) if mesh is not None else 1
+
+    def pin_stage_axis(tree):
+        if mesh is None or _pp_size <= 1:
+            return tree
+
+        def pin(x):
+            if getattr(x, "ndim", 0) < 1 or x.shape[0] != S:
+                return x
+            rest = [_P.UNCONSTRAINED] * (x.ndim - 1)
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, _P(PP_AXIS, *rest))
+            )
+
+        return jax.tree_util.tree_map(pin, tree)
+
+    staged_params = pin_stage_axis(staged_params)
+    staged_xs = pin_stage_axis(staged_xs)
+    params_rest = _set_subtree(params, spec.layer_path, {})
+
+    def with_layers(p_rest):
+        return _set_subtree(p_rest, spec.layer_path, layer_params)
+
+    idx_np, active_np, maxp = chunk_layout(spec, S, V)
+
+    mb_keys = jax.random.split(rng, M)
+
+    # ---- embed all microbatches (the input queue) --------------------
+
+    def embed_mb(mb_input, key):
+        args, kwargs = mb_input
+        if spec.embed_method is None:
+            return args[0]
+        return module.apply(
+            {"params": cast_half(params)}, *args,
+            rngs=_mk_rngs(model, key, "embed"),
+            method=spec.embed_method, **kwargs,
+        )
+
+    with named_region("smp/pipeline/embed"):
+        embedded = _scan_map(embed_mb, stacked_inputs, mb_keys)
+
+    if spec.carry_is_tuple:
+        hidden_q = embedded[0]
+        sides = embedded[1:]
+    else:
+        hidden_q = embedded
+        sides = None
+
+    carry_aval = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), hidden_q
+    )
+
+    # ---- per-chunk forward + residual split --------------------------
+
+    from smdistributed_modelparallel_tpu.parallel.memory import remat_policy
+    from smdistributed_modelparallel_tpu.parallel.pipeline import (
+        apply_collecting_aux,
+        make_layer_apply,
+    )
+
+    apply_one_layer = make_layer_apply(
+        model, spec, layer_module, side_in_carry=False
+    )
+
+    if spec.carry_remat:
+        apply_one_layer = jax.checkpoint(apply_one_layer, policy=remat_policy())
+
+    def chunk_fwd(chunk_lp, chunk_lxs, x, side, c_idx, m_idx, act_row):
+        base = jax.random.fold_in(jax.random.fold_in(rng, c_idx), m_idx)
+        chunk_lp = cast_half(chunk_lp)
+
+        def body(c, xs):
+            lp, lxs, i, act = xs
+            new_c, aux = apply_one_layer(
+                lp, c, lxs, jax.random.fold_in(base, i), side
+            )
+            out_c = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(act, n, o), new_c, c
+            )
+            return out_c, jnp.where(act, aux, 0.0)
+
+        idx = jnp.arange(maxp)
+        out, auxs = jax.lax.scan(body, x, (chunk_lp, chunk_lxs, idx, act_row))
+        return out, jnp.sum(auxs)
+
+    stage_ids = jnp.arange(S)
+    aux_w = float(getattr(cfg, "moe_aux_loss_weight", 1.0))
+    aux_seed = (
+        jnp.asarray(aux_w, jnp.float32)
+        * jnp.asarray(loss_seed_scale, jnp.float32)
+    )
+
+    side_leaves = side_treedef = side_idx = None
+    if sides is not None:
+        side_leaves, side_treedef, side_idx = _inexact_leaves(
+            tuple(jax.tree_util.tree_map(lambda a: a[0], s) for s in sides)
+        )
+    side_leaf_avals = (
+        [side_leaves[i] for i in side_idx] if sides is not None else []
+    )
+
+    capture_fwd, bwd_from_res, _bwd_full, wgt_from_res, _captured = (
+        _make_residual_split(
+            apply_one_layer, cast_half, rng, maxp, aux_seed,
+            sides is not None, side_leaf_avals=side_leaf_avals,
+        )
+    )
+
+    # Probe the residual/cotangent stash shapes (and capture the vjp
+    # treedef) with an abstract trace of one B-style capture row sweep.
+    res_avals, cot_avals = _probe_stash_avals(
+        S, staged_params, staged_xs, active_rows, carry_aval, sides,
+        capture_fwd, bwd_from_res=bwd_from_res,
+    )
+    _slot_bytes = _stash_slot_bytes
+
+    capture_at_f_target = rmode == "stash_all"
+    res_ring_slots = (
+        stash_rings["f_to_w"] if capture_at_f_target
+        else stash_rings["b_to_w"]
+    )
+    cot_ring_slots = stash_rings["b_to_w"]
+    plan = remat_plan.plan_pipeline(
+        "zb", rmode, S, V,
+        res_ring_slots=res_ring_slots, cot_ring_slots=cot_ring_slots,
+        res_slot_bytes=_slot_bytes(res_avals),
+        cot_slot_bytes=_slot_bytes(cot_avals), cfg=cfg,
+    )
+    if plan.effective == "full":
+        # Every chunk degraded (auto under a tight budget): the untouched
+        # recompute executor IS the plan.
+        return _pipeline_zero_bubble(
+            model, params, stacked_inputs, rng, mb_loss_fn, loss_seed_scale,
+            virtual,
+        )
+    capture_at_f = plan.effective == "stash_all"
+    stash_of_arr, res_col_arr, Vs, all_stash = _stash_chunk_maps(plan, V)
+    Rres = plan.res_ring_slots
+    Rcot = plan.cot_ring_slots
+
+    # ---- head + user loss (last stage, last chunk only) ---------------
+
+    def head_apply_aux(p, carry, key):
+        if spec.head_method is None:
+            return carry, jnp.zeros((), jnp.float32)
+        return apply_collecting_aux(
+            module, {"params": cast_half(p)}, carry,
+            rngs=_mk_rngs(model, key, "head"), method=spec.head_method,
+        )
+
+    def head_apply(p, carry, key):
+        return head_apply_aux(p, carry, key)[0]
+
+    loss_out_aval = jax.eval_shape(
+        lambda c: mb_loss_fn(head_apply(params, c, mb_keys[0]), 0, mb_keys[0]),
+        jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, a.dtype), carry_aval),
+    )
+
+    # ---- buffers ------------------------------------------------------
+
+    def zeros_chunk_ring(n):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros((S, V, n) + a.shape, a.dtype), carry_aval
+        )
+
+    def zeros_stage_rows():
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros((S,) + a.shape, a.dtype), carry_aval
+        )
+
+    def zeros_stash_ring(avals, n):
+        # [S, Vs, n, ...]: stage axis leads (pp-sharded like its
+        # siblings); leaf shapes come from the probe avals (leading
+        # stage axis dropped).
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros((S, Vs, n) + a.shape[1:], a.dtype), avals
+        )
+
+    grad_dtype = jnp.float32
+
+    def _acc_dtype(dtype):
+        if jnp.issubdtype(dtype, jnp.floating) and cfg._fp32_grad_accumulation:
+            return jnp.float32
+        return dtype
+
+    def param_grad_zeros(tree):
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, _acc_dtype(p.dtype)), tree
+        )
+
+    inbuf0 = zeros_chunk_ring(R1)
+    stash0 = zeros_chunk_ring(R1)
+    cotbuf0 = zeros_chunk_ring(R1)
+    outbuf0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((S, R1) + a.shape, a.dtype), carry_aval
+    )
+    xfer_f0 = zeros_stage_rows()
+    xfer_b0 = zeros_stage_rows()
+    wres0 = zeros_stash_ring(res_avals, Rres)
+    wcot0 = zeros_stash_ring(cot_avals, Rcot)
+    dlay0 = param_grad_zeros(staged_params)
+    drep0 = param_grad_zeros(params_rest)
+    dembed0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((M,) + a.shape, grad_dtype), carry_aval
+    )
+    dsides0 = None
+    if sides is not None:
+        dsides0 = [
+            jnp.zeros((M,) + side_leaves[i].shape, grad_dtype) for i in side_idx
+        ]
+    losses0 = jnp.zeros((M,), jnp.float32)
+    outs0 = jax.tree_util.tree_map(
+        lambda a: jnp.zeros((M,) + a.shape, a.dtype), loss_out_aval[1]
+    )
+
+    hc = health.active()
+
+    def gather_mb(tree, m):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+            tree,
+        )
+
+    def gather_sides_rows(ms):
+        if sides is None:
+            return None
+        return tuple(
+            jax.tree_util.tree_map(
+                lambda a: jax.vmap(
+                    lambda i: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False)
+                )(ms),
+                s,
+            )
+            for s in sides
+        )
+
+    def select_chunk(tree, krow):
+        return jax.tree_util.tree_map(
+            lambda a: jax.vmap(
+                lambda av, k: jax.lax.dynamic_index_in_dim(av, k, 0, keepdims=False)
+            )(a, krow),
+            tree,
+        )
+
+    # ---- sub-steps (each a lax.cond branch over the whole carry) ------
+
+    def f_substep(carry, t):
+        (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, wres, wcot, dlay,
+         drep, dembed, dsides, losses, outs, hstats) = carry
+        (hbad, habs, hmb), hstats_b = hstats
+
+        prev = jnp.maximum(t - 1, 0)
+        was_prev = t > 0
+        pk = fwd_k_sched[prev]
+        pm = fwd_m_sched[prev]
+        p_act = (pm >= 0) & was_prev
+        dst_k = jnp.roll(pk, 1) + (stage_ids == 0)
+        dst_m = jnp.roll(jnp.maximum(pm, 0), 1)
+        dst_act = jnp.roll(p_act, 1) & (dst_k < V)
+        inbuf = _chunk_ring_set(
+            inbuf, jnp.clip(dst_k, 0, V - 1), dst_m % R1,
+            jax.tree_util.tree_map(lambda o: jnp.roll(o, 1, axis=0), xfer_f),
+            dst_act,
+        )
+
+        fk = fwd_k_sched[t]
+        fm = fwd_m_sched[t]
+        f_active = fm >= 0
+        fkc = jnp.clip(fk, 0, V - 1)
+        fmc = jnp.maximum(fm, 0)
+        f_slots = fmc % R1
+        ch_params = select_chunk(staged_params, fkc)
+        ch_xs = select_chunk(staged_xs, fkc)
+        ch_act = select_chunk(active_rows, fkc)
+        from_q = gather_mb(hidden_q, fmc[0])
+        buf_in = _chunk_ring_get(inbuf, fkc, f_slots)
+        x_in = jax.tree_util.tree_map(
+            lambda q, b: b.at[0].set(jnp.where(fkc[0] == 0, q, b[0])),
+            from_q, buf_in,
+        )
+        f_sides = gather_sides_rows(fmc)
+        c_ids = fkc * S + stage_ids
+        with named_region("smp/pipeline/tick_fwd"):
+            if capture_at_f:
+                outs_f, _aux_f, res_f = jax.vmap(
+                    capture_fwd,
+                    in_axes=(0, 0, 0, 0 if sides is not None else None,
+                             0, 0, 0),
+                )(ch_params, ch_xs, x_in, f_sides, c_ids, fmc, ch_act)
+                wres = _chunk_ring_set(
+                    wres, res_col_arr[fkc], fmc % Rres, res_f,
+                    f_active & stash_of_arr[fkc],
+                )
+            else:
+                outs_f, _aux_f = jax.vmap(
+                    chunk_fwd,
+                    in_axes=(0, 0, 0, 0 if sides is not None else None,
+                             0, 0, 0),
+                )(ch_params, ch_xs, x_in, f_sides, c_ids, fmc, ch_act)
+        outs_f = pin_stage_axis(outs_f)
+        stash = _chunk_ring_set(stash, fkc, f_slots, x_in, f_active)
+        if hc is not None:
+            brow, arow = health.stage_row_stats(outs_f, S)
+            brow = jnp.where(f_active, brow, 0.0)
+            arow = jnp.where(f_active, arow, 0.0)
+            hmb = _chunk_scatter_stat(
+                hmb, fkc, fmc.astype(jnp.float32),
+                f_active & (brow > 0),
+                lambda cur, mb: jnp.where(cur < 0, mb, cur),
+            )
+            hbad = _chunk_scatter_stat(
+                hbad, fkc, brow, f_active, lambda cur, v: cur + v
+            )
+            habs = _chunk_scatter_stat(
+                habs, fkc, arow, f_active, jnp.maximum
+            )
+        last_row_active = f_active & (stage_ids == S - 1) & (fkc == V - 1)
+        outbuf = _chunk_outbuf_set(outbuf, f_slots, outs_f, last_row_active)
+        xfer_f = outs_f
+        return (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, wres, wcot,
+                dlay, drep, dembed, dsides, losses, outs,
+                ((hbad, habs, hmb), hstats_b))
+
+    def b_substep(carry, t):
+        (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, wres, wcot, dlay,
+         drep, dembed, dsides, losses, outs, hstats) = carry
+        hstats_f, (hbad_b, habs_b, hmb_b) = hstats
+
+        prev = jnp.maximum(t - 1, 0)
+        was_prev = t > 0
+        pbk = bwd_k_sched[prev]
+        pbm = bwd_m_sched[prev]
+        pb_act = (pbm >= 0) & was_prev
+        dst_bk = jnp.roll(pbk, -1) - (stage_ids == S - 1)
+        dst_bm = jnp.roll(jnp.maximum(pbm, 0), -1)
+        dst_b_act = jnp.roll(pb_act, -1) & (dst_bk >= 0)
+        cotbuf = _chunk_ring_set(
+            cotbuf, jnp.clip(dst_bk, 0, V - 1), dst_bm % R1,
+            jax.tree_util.tree_map(lambda o: jnp.roll(o, -1, axis=0), xfer_b),
+            dst_b_act,
+        )
+
+        bk = bwd_k_sched[t]
+        bm = bwd_m_sched[t]
+        b_active = bm >= 0
+        bkc = jnp.clip(bk, 0, V - 1)
+        bmc = jnp.maximum(bm, 0)
+        b_slots = bmc % R1
+
+        is_lastk = b_active[S - 1] & (bkc[S - 1] == V - 1)
+        m_last = bmc[S - 1]
+        key_last = jax.lax.dynamic_index_in_dim(
+            mb_keys, m_last, 0, keepdims=False
+        )
+        out_last = jax.tree_util.tree_map(
+            lambda ob: jax.lax.dynamic_index_in_dim(
+                ob[S - 1], b_slots[S - 1], 0, keepdims=False
+            ),
+            outbuf,
+        )
+
+        def head_loss(p_rest, out):
+            final, h_aux = head_apply_aux(with_layers(p_rest), out, key_last)
+            loss, user_out = mb_loss_fn(final, m_last, key_last)
+            loss = loss + jnp.asarray(aux_w, loss.dtype) * h_aux.astype(
+                loss.dtype
+            )
+            return loss, user_out
+
+        def run_head():
+            loss_m, head_vjp, user_out = jax.vjp(
+                head_loss, params_rest, out_last, has_aux=True
+            )
+            seed = jnp.asarray(loss_seed_scale, loss_m.dtype)
+            d_rep, d_out_last = head_vjp(seed)
+            return loss_m.astype(jnp.float32), d_rep, d_out_last, user_out
+
+        head_aval = jax.eval_shape(run_head)
+        with named_region("smp/pipeline/head"):
+            loss_m, d_rep, d_out_last, user_out = jax.lax.cond(
+                is_lastk,
+                run_head,
+                lambda: jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), head_aval
+                ),
+            )
+
+        cot_in = _chunk_ring_get(cotbuf, bkc, b_slots)
+        cot_in = jax.tree_util.tree_map(
+            lambda c, d: c.at[S - 1].set(
+                jnp.where(is_lastk, d.astype(c.dtype), c[S - 1])
+            ),
+            cot_in, d_out_last,
+        )
+        # Retain the head cotangent for a possible RECOMPUTE W pass on a
+        # degraded last chunk (mixed auto plans); harmless otherwise.
+        cotbuf = _chunk_ring_set(
+            cotbuf, bkc, b_slots, cot_in,
+            b_active & (stage_ids == S - 1) & (bkc == V - 1),
+        )
+        b_sides = gather_sides_rows(bmc)
+        stash_in = _chunk_ring_get(stash, bkc, b_slots)
+        ch_params_b = select_chunk(staged_params, bkc)
+        ch_xs_b = select_chunk(staged_xs, bkc)
+        ch_act_b = select_chunk(active_rows, bkc)
+        c_ids_b = bkc * S + stage_ids
+        b_cols = res_col_arr[bkc]
+        b_stash_act = b_active & stash_of_arr[bkc]
+
+        with named_region("smp/pipeline/tick_bwd_input"):
+            if capture_at_f:
+                # Residuals were captured at F: no backward-time forward.
+                # stash_all plans are never partial (only auto degrades
+                # chunks, and auto targets stash_weight on this
+                # schedule), so every chunk's residuals are in the ring.
+                assert all_stash
+                res_b = _chunk_ring_get(wres, b_cols, bmc % Rres)
+            else:
+                _out_b, _aux_b, res_b = jax.vmap(
+                    capture_fwd,
+                    in_axes=(0, 0, 0, 0 if sides is not None else None,
+                             0, 0, 0),
+                )(ch_params_b, ch_xs_b, stash_in, b_sides, c_ids_b, bmc,
+                  ch_act_b)
+            d_x_rows, d_side_rows, cot_stack = jax.vmap(bwd_from_res)(
+                res_b, cot_in
+            )
+        d_x_rows = pin_stage_axis(d_x_rows)
+        # Stash for the deferred W pass (stashed chunks only).
+        if not capture_at_f:
+            wres = _chunk_ring_set(
+                wres, b_cols, bmc % Rres, res_b, b_stash_act
+            )
+        wcot = _chunk_ring_set(
+            wcot, b_cols, bmc % Rcot, cot_stack, b_stash_act
+        )
+
+        if hc is not None:
+            brow_b, arow_b = health.stage_row_stats(d_x_rows, S)
+            brow_b = jnp.where(b_active, brow_b, 0.0)
+            arow_b = jnp.where(b_active, arow_b, 0.0)
+            hmb_b = _chunk_scatter_stat(
+                hmb_b, bkc, bmc.astype(jnp.float32),
+                b_active & (brow_b > 0),
+                lambda cur, mb: jnp.where(cur < 0, mb, cur),
+            )
+            hbad_b = _chunk_scatter_stat(
+                hbad_b, bkc, brow_b, b_active, lambda cur, v: cur + v
+            )
+            habs_b = _chunk_scatter_stat(
+                habs_b, bkc, arow_b, b_active, jnp.maximum
+            )
+
+        drep = jax.tree_util.tree_map(
+            lambda a, g: a + jnp.where(is_lastk, g.astype(a.dtype), 0),
+            drep, d_rep,
+        )
+
+        dembed = _chunk_scatter_add_mb(
+            dembed, bmc[0],
+            jax.tree_util.tree_map(lambda r: r[0], d_x_rows),
+            b_active[0] & (bkc[0] == 0),
+        )
+
+        if sides is not None and dsides is not None:
+            # d_side_rows: per-stage accumulated inexact side-cotangent
+            # leaves (already filtered to side_idx order).
+            for s in range(S):
+                dsides = [
+                    _chunk_scatter_add_leaf(d, bmc[s], leaf[s], b_active[s])
+                    for d, leaf in zip(dsides, d_side_rows)
+                ]
+
+        losses = losses.at[m_last].set(
+            jnp.where(is_lastk, loss_m.astype(jnp.float32), losses[m_last])
+        )
+        outs = _chunk_scatter_set_mb(outs, m_last, user_out, is_lastk)
+        xfer_b = d_x_rows
+        return (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, wres, wcot,
+                dlay, drep, dembed, dsides, losses, outs,
+                (hstats_f, (hbad_b, habs_b, hmb_b)))
+
+    def w_substep(carry, t):
+        (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, wres, wcot, dlay,
+         drep, dembed, dsides, losses, outs, hstats) = carry
+
+        wk = wgt_k_sched[t]
+        wm = wgt_m_sched[t]
+        w_active = wm >= 0
+        wkc = jnp.clip(wk, 0, V - 1)
+        wmc = jnp.maximum(wm, 0)
+        w_cols = res_col_arr[wkc]
+        w_stash = stash_of_arr[wkc]
+        ch_act_w = select_chunk(active_rows, wkc)
+
+        with named_region("smp/pipeline/tick_bwd_weight"):
+            res_w = _chunk_ring_get(wres, w_cols, wmc % Rres)
+            cot_w = _chunk_ring_get(wcot, w_cols, wmc % Rcot)
+            d_lp_rows = jax.vmap(wgt_from_res)(res_w, cot_w)
+            if not all_stash:
+                # Degraded chunks keep the recompute path: vjp w.r.t. the
+                # chunk params re-running the forward from the input
+                # stash and the retained chunk-output cotangent.
+                w_slots = wmc % R1
+                w_sides = gather_sides_rows(wmc)
+                stash_w = _chunk_ring_get(stash, wkc, w_slots)
+                cotc_w = _chunk_ring_get(cotbuf, wkc, w_slots)
+                ch_params_w = select_chunk(staged_params, wkc)
+                ch_xs_w = select_chunk(staged_xs, wkc)
+                c_ids_w = wkc * S + stage_ids
+
+                def chunk_bwd_weight(lp, lxs, x, side, cot, c_idx, m_idx,
+                                     act_row):
+                    def g(lp_):
+                        return chunk_fwd(lp_, lxs, x, side, c_idx, m_idx,
+                                         act_row)
+
+                    _, vjp = jax.vjp(g, lp)
+                    (d_lp,) = vjp((cot, aux_seed))
+                    return d_lp
+
+                d_lp_rec = jax.vmap(
+                    chunk_bwd_weight,
+                    in_axes=(0, 0, 0, 0 if sides is not None else None,
+                             0, 0, 0, 0),
+                )(ch_params_w, ch_xs_w, stash_w, w_sides, cotc_w,
+                  c_ids_w, wmc, ch_act_w)
+                d_lp_rows = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(
+                        w_stash.reshape((S,) + (1,) * (a.ndim - 1)), a, b
+                    ),
+                    d_lp_rows, d_lp_rec,
+                )
+        d_lp_rows = pin_stage_axis(d_lp_rows)
+        dlay = _chunk_acc_rows(dlay, d_lp_rows, wkc, w_active)
+        return (inbuf, stash, cotbuf, outbuf, xfer_f, xfer_b, wres, wcot,
+                dlay, drep, dembed, dsides, losses, outs, hstats)
+
+    def tick(carry, t):
+        carry = jax.lax.cond(
+            f_run_sched[t], lambda c: f_substep(c, t), lambda c: c, carry
+        )
+        carry = jax.lax.cond(
+            b_run_sched[t], lambda c: b_substep(c, t), lambda c: c, carry
+        )
+        carry = jax.lax.cond(
+            w_run_sched[t], lambda c: w_substep(c, t), lambda c: c, carry
+        )
+        return carry, None
+
+    def hgrids():
+        return (
+            jnp.zeros((S, V), jnp.float32), jnp.zeros((S, V), jnp.float32),
+            jnp.full((S, V), -1.0, jnp.float32),
+        )
+
+    carry0 = (
+        pin_stage_axis(inbuf0), pin_stage_axis(stash0),
+        pin_stage_axis(cotbuf0), pin_stage_axis(outbuf0),
+        pin_stage_axis(xfer_f0), pin_stage_axis(xfer_b0),
+        pin_stage_axis(wres0), pin_stage_axis(wcot0),
+        pin_stage_axis(dlay0), drep0, dembed0, dsides0, losses0, outs0,
+        (hgrids(), hgrids()),
+    )
+    with named_region("smp/pipeline/steady"):
+        carry_end, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+    (_, _, _, _, _, _, _, _, dlay, drep, dembed, dsides, losses, outs,
+     hstats) = carry_end
+    if hc is not None:
+        ((hbad, habs, hmb), (hbad_b, habs_b, hmb_b)) = hstats
+        chunk_ids = np.arange(V)[None, :] * S + np.arange(S)[:, None]
+        hc.add_stage_stats("zb", hbad, habs, hmb, chunk_ids=chunk_ids,
+                           pass_name="fwd")
+        hc.add_stage_stats("zb", hbad_b, habs_b, hmb_b, chunk_ids=chunk_ids,
+                           pass_name="bwd_input")
 
     # ---- embedding backward ------------------------------------------
 

@@ -356,7 +356,31 @@ class StepFunction:
                     # Prefetch flips between the transfer-register scan
                     # and the lifted scan at identical shapes.
                     zero_mod.prefetch_knob() if zero3 else "-")
-        key_pre = (pipe_key, zero_key,
+        # Recompute-planner knob: a stash mode rebuilds the pipeline
+        # executors (and the checkpoint policy) at identical shapes, so
+        # the knob must be keyed. Canonicalized so idle values never
+        # move the key: the default ("full") contributes NOTHING — the
+        # key (and the disk key every stored entry and golden hashes)
+        # stays byte-identical to pre-knob builds regardless of stray
+        # budget env vars — and the budget is keyed only under "auto"
+        # (the only mode that reads it).
+        from smdistributed_modelparallel_tpu.parallel import remat_plan
+        rmode = remat_plan.resolve(cfg)
+        # Under "auto", an UNSET budget (-1: planner falls back to the
+        # last audit's temp bytes or its own ring bound) is a different
+        # program than an explicit 0 (degrade everything) — keep them
+        # distinct. The audit-derived default itself is deliberately not
+        # keyed (it is a volatile registry value); a plan drift under the
+        # same key is caught by the disk cache's lowered-module content
+        # hash, costing a verified miss, never a wrong program.
+        _rbudget = getattr(cfg, "recompute_budget_mb", None)
+        recompute_key = (
+            () if rmode == "full"
+            else ((rmode,
+                   (-1 if _rbudget is None else int(_rbudget))
+                   if rmode == "auto" else 0),)
+        )
+        key_pre = (pipe_key, zero_key) + recompute_key + (
                    treedef, tuple(scan_idx), tuple(bcast_idx),
                    tuple((i, _static_key(v)) for i, v in sorted(static.items())),
                    tuple((v.shape, str(v.dtype)) for v in scan_vals),

@@ -214,7 +214,28 @@ def _knob_facts():
         # stray env var never spuriously rejects entries of byte-identical
         # programs; mirrors the step engine's zero_key.
         **_zero_knob_facts(cfg),
+        # Recompute-planner knobs, same canonicalization contract: the
+        # default mode omits both facts entirely (entries stored before
+        # the knob existed keep verifying), and the budget is recorded
+        # only under "auto" — the one mode whose program reads it — so a
+        # stray SMP_RECOMPUTE_BUDGET_MB never invalidates anything.
+        **_recompute_knob_facts(cfg),
     }
+
+
+def _recompute_knob_facts(cfg):
+    from smdistributed_modelparallel_tpu.parallel import remat_plan
+
+    mode = remat_plan.resolve(cfg)
+    if mode == "full":
+        return {}
+    facts = {"recompute": mode}
+    if mode == "auto":
+        # Unset (-1) vs explicit 0 are different programs (the planner's
+        # fallback budget vs degrade-everything); mirror the step key.
+        budget = getattr(cfg, "recompute_budget_mb", None)
+        facts["recompute_budget_mb"] = -1 if budget is None else int(budget)
+    return facts
 
 
 def _zero_knob_facts(cfg):

@@ -730,7 +730,8 @@ class ProgramAudit:
     """Structured audit of one compiled step program."""
 
     def __init__(self, name, key, census, remat, memory, findings,
-                 flops, bytes_accessed, hlo_sha256, config, zero=None):
+                 flops, bytes_accessed, hlo_sha256, config, zero=None,
+                 recompute=None):
         self.name = name
         self.key = key
         self.census = census
@@ -742,6 +743,7 @@ class ProgramAudit:
         self.hlo_sha256 = hlo_sha256
         self.config = config
         self.zero = zero
+        self.recompute = recompute
         self.fingerprint = self._fingerprint()
         self.fingerprint_hash = fingerprint_hash(self.fingerprint)
 
@@ -783,6 +785,10 @@ class ProgramAudit:
         # (and committed goldens) of every other program are unchanged.
         if self.zero is not None:
             fp["zero"] = self.zero
+        # Additive likewise: only builds under a non-default recompute
+        # plan carry the block — default-knob fingerprints are unchanged.
+        if self.recompute is not None:
+            fp["recompute"] = self.recompute
         return fp
 
     def as_dict(self):
@@ -805,6 +811,10 @@ def _config_snapshot(cfg):
     sharded = getattr(cfg, "sharded_params", "none")
     if sharded and sharded != "none":
         snap["sharded_params"] = sharded
+    # Additive likewise for the recompute knob (default "full" omitted).
+    recompute = getattr(cfg, "recompute", "full")
+    if recompute and recompute != "full":
+        snap["recompute"] = recompute
     return snap
 
 
@@ -844,6 +854,15 @@ def audit_compiled(name, compiled, key=None, params=None,
     zero = None
     if bool(getattr(cfg, "zero3_enabled", False)):
         zero = zero_report(text, mesh=mesh)
+    recompute = None
+    try:
+        from smdistributed_modelparallel_tpu.parallel import (
+            remat_plan as _remat_plan,
+        )
+
+        recompute = _remat_plan.active_for(cfg)
+    except Exception:  # pragma: no cover - defensive
+        pass
     findings = []
     findings += _param_findings(
         params, expected_param_shardings, mesh, min_bytes
@@ -864,7 +883,7 @@ def audit_compiled(name, compiled, key=None, params=None,
     ).hexdigest()
     audit = ProgramAudit(
         name, key, census, remat, memory, findings, flops, bytes_accessed,
-        hlo_sha, _config_snapshot(cfg), zero=zero,
+        hlo_sha, _config_snapshot(cfg), zero=zero, recompute=recompute,
     )
     if publish:
         # Unpublished audits stay out of the registry too: a verification
@@ -989,7 +1008,8 @@ def bench_summary(audit):
 #: The environment-stable fingerprint subset the golden regression gates
 #: compare (memory/FLOPs/hashes move with jaxlib versions; these move
 #: only when the program's parallel structure does).
-SEMANTIC_FIELDS = ("config", "collectives", "replicated", "remat", "zero")
+SEMANTIC_FIELDS = ("config", "collectives", "replicated", "remat", "zero",
+                   "recompute")
 
 
 def diff(a, b, fields=None, remat_tol=0.02):
@@ -1041,6 +1061,11 @@ def diff(a, b, fields=None, remat_tol=0.02):
         for k in sorted(set(za) | set(zb)):
             if za.get(k) != zb.get(k):
                 add(f"zero.{k}", za.get(k), zb.get(k))
+    if picked("recompute"):
+        ra, rb = a.get("recompute") or {}, b.get("recompute") or {}
+        for k in sorted(set(ra) | set(rb)):
+            if ra.get(k) != rb.get(k):
+                add(f"recompute.{k}", ra.get(k), rb.get(k))
     if picked("memory"):
         ma, mb = a.get("memory", {}), b.get("memory", {})
         for k in sorted(set(ma) | set(mb)):

@@ -169,6 +169,13 @@ _SLOW_TESTS = (
     # pay 2+ extra end-to-end compiles.
     "test_zero3.py::TestZero3Composition",
     "test_zero3.py::TestZero3Elastic",
+    # Recompute-planner heavy multi-compile cases: the census acceptance
+    # gate (stash + full + pp=1 baseline at the canonical config) and the
+    # committed stash golden stay fast in test_recompute.py; the
+    # per-mode parity matrix and the auto-degradation executor runs each
+    # pay 2-3 extra pipeline compiles.
+    "test_recompute.py::TestStashParity",
+    "test_recompute.py::TestAutoDegradation",
 )
 
 

@@ -50,6 +50,16 @@ CONFIGS = {
         "microbatches": 2, "ddp": True, "_device_count_override": 2,
         "sharded_params": "zero3", "sdp_param_persistence_threshold": 1,
     },
+    # The recompute planner's headline program (tests/test_recompute.py
+    # gate): ZB-H1 with the W pass consuming stashed vjp residuals — the
+    # fingerprint carries the `recompute` block (plan decisions + ring
+    # sizes) and a remat fraction far below the `full` golden's 0.79.
+    # LAST in this dict: cache keys embed the per-process init
+    # generation, so appending keeps every earlier golden byte-stable.
+    "zero_bubble_stash_weight_pp2_mb4": {
+        "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
+        "pipeline": "zero_bubble", "recompute": "stash_weight",
+    },
 }
 
 

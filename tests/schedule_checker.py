@@ -110,3 +110,47 @@ def check_schedule(num_stages, num_microbatches, fwd_mb, bwd_mb,
     if w_tick is not None:
         out["W"] = w_tick
     return out
+
+
+def check_stash_lifetimes(ticks, write_pass, read_pass, ring_slots,
+                          num_stages, num_microbatches, virtual=1):
+    """Validate a recompute-stash ring against the schedule's tick maps.
+
+    ``ticks`` is ``check_schedule``'s return value; a stash entry for
+    (chunk, m) is written by ``write_pass`` ("F" or "B") and consumed by
+    ``read_pass`` ("B" or "W") at slot ``m % ring_slots``. Asserts, per
+    (chunk, m):
+
+    - **no read before its write**: the consuming pass's tick is not
+      before the writing pass's tick (same tick is legal — the executors
+      order sub-steps F -> B -> W, and every stash write-pass precedes
+      its read-pass);
+    - **no slot reuse before the consuming tick**: the next occupant of
+      the slot, (chunk, m + ring_slots), is written STRICTLY after
+      (chunk, m)'s read tick — a same-tick overwrite lands before the
+      read (sub-step order again) and would corrupt the entry.
+
+    This is the executable counterpart of
+    ``parallel/memory.recompute_ring_plan``: a plan's slot count passes
+    here iff the executor's ``m % slots`` ring indexing is sound.
+    """
+    order = {"F": 0, "B": 1, "W": 2}
+    assert order[write_pass] < order[read_pass], "write pass must precede"
+    w_map, r_map = ticks[write_pass], ticks[read_pass]
+    C = int(num_stages) * int(virtual)
+    M = int(num_microbatches)
+    R = int(ring_slots)
+    assert R >= 1
+    for c in range(C):
+        for m in range(M):
+            assert w_map[(c, m)] <= r_map[(c, m)], (
+                f"{read_pass}({c},{m}) reads its stash slot before "
+                f"{write_pass}({c},{m}) writes it"
+            )
+            if m + R < M:
+                assert w_map[(c, m + R)] > r_map[(c, m)], (
+                    f"stash ring of {R} slot(s): {write_pass}({c},{m + R}) "
+                    f"overwrites slot {m % R} at tick {w_map[(c, m + R)]}, "
+                    f"not strictly after the consuming "
+                    f"{read_pass}({c},{m}) at tick {r_map[(c, m)]}"
+                )

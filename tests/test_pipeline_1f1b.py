@@ -394,18 +394,26 @@ class TestVirtualHLOGuard:
     """
 
     def test_v1_explicit_knob_is_byte_identical(self):
-        """virtual_pipeline_degree=1 AND pipeline="interleaved" (explicit)
-        vs unset: the compiled pp=2 step must be byte-identical — neither
-        the virtual machinery nor the zero-bubble schedule dispatch may
-        leak into the default path."""
+        """virtual_pipeline_degree=1 AND pipeline="interleaved" AND
+        recompute="full" (explicit) vs unset: the compiled pp=2 step must
+        be byte-identical — neither the virtual machinery, nor the
+        zero-bubble schedule dispatch, nor the recompute planner may leak
+        into the default path. A stray budget env var must also be inert
+        at the default knob (idle-value canonicalization)."""
+        import os
+
         step_a, step_b = _mk_step(), _mk_step()
         _train({"pipeline_parallel_degree": 2, "microbatches": 4,
                 "ddp": True}, steps=1, step_fn=step_a)
         default_hlo = _compiled_step_hlo(step_a)
-        _train({"pipeline_parallel_degree": 2, "microbatches": 4,
-                "ddp": True, "virtual_pipeline_degree": 1,
-                "pipeline": "interleaved"},
-               steps=1, step_fn=step_b)
+        os.environ["SMP_RECOMPUTE_BUDGET_MB"] = "7"
+        try:
+            _train({"pipeline_parallel_degree": 2, "microbatches": 4,
+                    "ddp": True, "virtual_pipeline_degree": 1,
+                    "pipeline": "interleaved", "recompute": "full"},
+                   steps=1, step_fn=step_b)
+        finally:
+            del os.environ["SMP_RECOMPUTE_BUDGET_MB"]
         explicit_hlo = _compiled_step_hlo(step_b)
         assert _strip_hlo(default_hlo) == _strip_hlo(explicit_hlo)
         # The pp permutes are present in the default program (the guard
