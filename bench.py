@@ -717,6 +717,149 @@ def _compile_cache_probe(deadline):
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _serve_probe(deadline):
+    """SMP_BENCH_SERVE_PROBE=1: static-batch ``smp.generate`` vs
+    continuous batching (``smp.serving``) on a synthetic ragged-arrival
+    trace.
+
+    The trace is 12 greedy requests with ragged decode lengths arriving
+    ``gap_s`` apart. The static baseline serves them the only way
+    ``smp.generate`` can: FIFO batches of ``slots`` requests, each batch
+    waiting for its last member to arrive and running to the batch's MAX
+    max_new_tokens (short rows ride along as wasted steps, and nothing
+    streams until the batch completes). Continuous batching admits each
+    request on arrival, backfills freed slots, and retires rows at their
+    own length. Token parity is asserted row-for-row (greedy), compile is
+    excluded from both legs (warmed up beforehand), and the block stamped
+    into BENCH_r*.json as ``"serving"`` carries
+    ttft/itl/tokens_per_sec/speedup (schema-checked by
+    scripts/perf_ledger.py). TPU criterion in BENCH_NOTES.md: same
+    structure at serving batch sizes."""
+    import numpy as np
+
+    import smdistributed_modelparallel_tpu as smp
+    from smdistributed_modelparallel_tpu.models.transformer_lm import (
+        TransformerLM,
+    )
+
+    if time.time() > deadline - 30:
+        sys.stderr.write(
+            "bench: serve probe skipped (probe window exhausted)\n"
+        )
+        return None
+    try:
+        import jax as _jax
+
+        smp.reset()
+        smp.init({})
+        mod = TransformerLM(
+            vocab_size=512, max_len=64, d_model=384, n_layers=4,
+            n_heads=4,
+        )
+        # Extreme decode raggedness is where continuous batching earns
+        # its keep: each FIFO batch of 4 carries one long stream, so the
+        # static baseline burns batch-max steps on three retired rows AND
+        # serializes the long streams across batches — the engine runs
+        # the longs concurrently and backfills retired slots from the
+        # queue.
+        plen, slots, gap_s = 8, 4, 0.01
+        max_news = [28, 4, 4, 4, 28, 4, 4, 4, 28, 4, 4, 4]
+        prompts = [
+            np.asarray(_jax.random.randint(
+                _jax.random.key(100 + i), (plen,), 0, 128
+            ))
+            for i in range(len(max_news))
+        ]
+        params = mod.init(
+            _jax.random.key(0), _jax.numpy.asarray(prompts[0])[None]
+        )["params"]
+
+        # -- static leg: FIFO batches, batch-max decode length ----------
+        batches = [
+            list(range(i, min(i + slots, len(max_news))))
+            for i in range(0, len(max_news), slots)
+        ]
+        for b in batches:  # compile warmup (excluded from both legs)
+            ids = _jax.numpy.asarray(np.stack([prompts[i] for i in b]))
+            smp.generate(mod, ids, max(max_news[i] for i in b),
+                         params=params)
+        for m in set(max_news):
+            # The engine's per-request key schedule is
+            # split(key(seed), max_new) — prime the per-count threefry
+            # compile the same way the static leg's generates were.
+            _jax.random.split(_jax.random.key(0), m)
+        t0 = time.perf_counter()
+        static_out = {}
+        static_ttft = []
+        for b in batches:
+            last_arrival = max(i * gap_s for i in b)
+            now = time.perf_counter() - t0
+            if now < last_arrival:
+                time.sleep(last_arrival - now)
+            ids = _jax.numpy.asarray(np.stack([prompts[i] for i in b]))
+            out = np.asarray(smp.generate(
+                mod, ids, max(max_news[i] for i in b), params=params
+            ))
+            done = time.perf_counter() - t0
+            for row, i in enumerate(b):
+                static_out[i] = list(out[row, plen:plen + max_news[i]])
+                static_ttft.append(done - i * gap_s)
+        static_wall = time.perf_counter() - t0
+        useful_tokens = sum(max_news)
+        static_tps = useful_tokens / static_wall
+
+        # -- continuous leg ---------------------------------------------
+        engine = smp.serving.ServingEngine(
+            mod, params=params, max_slots=slots,
+            block_tokens_override=8, prefill_chunk=8,
+        )
+        engine._program("prefill")   # compile warmup
+        engine._program("decode")
+        reqs = [
+            smp.serving.ServeRequest(
+                f"b{i}", list(map(int, prompts[i])), max_news[i],
+                arrival_s=i * gap_s,
+            )
+            for i in range(len(max_news))
+        ]
+        t0 = time.perf_counter()
+        results = engine.run(reqs, timeout_s=deadline - time.time())
+        cont_wall = time.perf_counter() - t0
+        cont_tps = useful_tokens / cont_wall
+
+        parity = all(
+            list(results[f"b{i}"]) == static_out[i]
+            for i in range(len(max_news))
+        )
+        ttft_ms = (
+            1e3 * engine._ttft_sum / max(engine._ttft_n, 1)
+        )
+        itl_ms = 1e3 * engine._itl_sum / max(engine._itl_n, 1)
+        result = {
+            "component": "serving",
+            "ttft_ms": round(ttft_ms, 2),
+            "itl_ms": round(itl_ms, 2),
+            "tokens_per_sec": round(cont_tps, 2),
+            "static_tokens_per_sec": round(static_tps, 2),
+            "static_ttft_ms": round(
+                1e3 * sum(static_ttft) / len(static_ttft), 2
+            ),
+            "speedup": round(cont_tps / static_tps, 3),
+            "requests": len(max_news),
+            "decode_steps": int(engine.stats["decode_steps"]),
+            "prefill_chunks": int(engine.stats["prefill_chunks"]),
+            "token_parity": bool(parity),
+        }
+        sys.stderr.write(json.dumps(result) + "\n")
+        sys.stderr.flush()
+        return result
+    except Exception as e:  # the probe must never kill the bench
+        sys.stderr.write(f"bench: serve probe failed ({e!r})\n")
+        return None
+    finally:
+        smp.reset()
+
+
 def main():
     start_time = time.time()
     probe_window = int(os.environ.get("SMP_BENCH_PROBE_WINDOW", 1200))
@@ -1046,6 +1189,11 @@ def main():
             deadline=start_time + probe_window
         )
 
+    serving_out = None
+    if os.environ.get("SMP_BENCH_SERVE_PROBE", "0") == "1":
+        # Also re-inits the framework (single-device serving config).
+        serving_out = _serve_probe(deadline=start_time + probe_window)
+
     from smdistributed_modelparallel_tpu.ops.attention import _pallas_ok
 
     q_probe = jnp.zeros((batch // num_mb, seq_len, 12, 64), jnp.bfloat16)
@@ -1074,6 +1222,8 @@ def main():
     }
     if exec_cache_out is not None:
         result["exec_cache"] = exec_cache_out
+    if serving_out is not None:
+        result["serving"] = serving_out
     if zero_probe_out is not None:
         result["zero_probe"] = zero_probe_out
     if pipeline_probe_out is not None:

@@ -244,6 +244,35 @@ def _pipeline_probe_schema_problem(probe):
     return None
 
 
+def _serve_probe_schema_problem(probe):
+    """Why a round's ``serving`` block (bench.py SMP_BENCH_SERVE_PROBE
+    static-vs-continuous-batching A/B) is malformed, or None. Absent
+    blocks are fine — rounds predating the serving engine, or probe not
+    requested."""
+    if probe is None:
+        return None
+    if not isinstance(probe, dict):
+        return f"'serving' must be an object, got {type(probe).__name__}"
+    if probe.get("component") != "serving":
+        return "'serving.component' must be the string 'serving'"
+    for key in ("ttft_ms", "itl_ms", "tokens_per_sec", "speedup"):
+        if not isinstance(probe.get(key), (int, float)):
+            return f"'serving' lacks a numeric '{key}'"
+    static = probe.get("static_tokens_per_sec")
+    if static is not None:
+        if not isinstance(static, (int, float)):
+            return "'serving.static_tokens_per_sec' must be numeric"
+        if static > 0 and abs(
+            probe["speedup"] - probe["tokens_per_sec"] / static
+        ) > max(0.05 * probe["speedup"], 0.05):
+            return ("'serving.speedup' inconsistent with "
+                    "tokens_per_sec/static_tokens_per_sec")
+    if probe.get("token_parity") is False:
+        # A speedup at unequal outputs measures nothing.
+        return "'serving.token_parity' is false — the A/B is invalid"
+    return None
+
+
 def build_ledger(repo, threshold=0.05):
     """The full trajectory + verdict dict (see module docstring)."""
     rounds = []
@@ -287,6 +316,7 @@ def build_ledger(repo, threshold=0.05):
             "exec_cache": None,
             "zero_probe": None,
             "pipeline_probe": None,
+            "serving": None,
             "documented": n in documented,
         }
         if rc == 0:
@@ -330,6 +360,12 @@ def build_ledger(repo, threshold=0.05):
                     problems.append(f"{name}: {pprobe_problem}")
                     pprobe = None
                 row["pipeline_probe"] = pprobe
+                sprobe = parsed.get("serving")
+                sprobe_problem = _serve_probe_schema_problem(sprobe)
+                if sprobe_problem:
+                    problems.append(f"{name}: {sprobe_problem}")
+                    sprobe = None
+                row["serving"] = sprobe
                 row.update(
                     on_chip=_is_on_chip(parsed),
                     vs_baseline=parsed["vs_baseline"],
@@ -472,6 +508,17 @@ def render_table(ledger, out=sys.stdout):
             if pprobe.get("schedule_best"):
                 parts.append(f"best {pprobe['schedule_best']}")
             w(f"{'':>7}pipeline_probe: " + "  ".join(parts) + "\n")
+        sprobe = r.get("serving")
+        if isinstance(sprobe, dict):
+            parts = [
+                f"ttft {sprobe['ttft_ms']:.1f}ms",
+                f"itl {sprobe['itl_ms']:.1f}ms",
+                f"{sprobe['tokens_per_sec']:,.0f} tok/s",
+                f"speedup {sprobe['speedup']:.2f}x vs static",
+            ]
+            if sprobe.get("token_parity"):
+                parts.append("parity ok")
+            w(f"{'':>7}serving: " + "  ".join(parts) + "\n")
         zprobe = r.get("zero_probe")
         if isinstance(zprobe, dict):
             parts = [

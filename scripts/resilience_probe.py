@@ -319,6 +319,10 @@ _PHASE_ORDER = ("detect", "rendezvous", "reshard_load", "first_step")
 # Optional phases stamped when the executable cache is in play: the
 # first_step compile cost split by source (resilience/supervisor.py).
 _COMPILE_PHASES = ("compile_from_cache", "compile_fresh")
+# Serving-replica failovers (serving/replica.py) record a different
+# phase vocabulary: mirror-gap detection, shadow re-admission, first
+# re-admitted token. Identified by the presence of "readmit".
+_SERVE_PHASE_ORDER = ("detect", "readmit", "first_token")
 
 
 def _parse_recovery_detail(detail):
@@ -353,20 +357,25 @@ def _recoveries_from_ring(events):
                     current["ckpt"] = ev.get("detail", "")
         elif name == "recovery_done":
             phases = _parse_recovery_detail(ev.get("detail", ""))
+            serving = "readmit" in phases
+            order = _SERVE_PHASE_ORDER if serving else (
+                _PHASE_ORDER + _COMPILE_PHASES
+            )
             rec = {
                 "mttr_s": phases.pop("mttr", None),
-                "phases": {
-                    p: phases.get(p)
-                    for p in _PHASE_ORDER + _COMPILE_PHASES
-                    if p in phases
-                },
+                "mode": "serving" if serving else "training",
+                "phases": {p: phases.get(p) for p in order if p in phases},
                 "ckpt": (current or {}).get("ckpt", ""),
                 "done_wall_us": ev.get("wall_us"),
             }
             # Warm vs cold first_step: warm means the recovery's
             # recompile(s) all came from the executable cache. Dumps
-            # predating the cache (no compile phases) are "unknown".
-            if any(p in rec["phases"] for p in _COMPILE_PHASES):
+            # predating the cache (no compile phases) are "unknown";
+            # serving failovers never recompile (their programs are
+            # live), so the label does not apply.
+            if serving:
+                rec["first_step_source"] = "n/a"
+            elif any(p in rec["phases"] for p in _COMPILE_PHASES):
                 cold = rec["phases"].get("compile_fresh") or 0.0
                 rec["first_step_source"] = "cold" if cold > 0 else "warm"
             else:
@@ -432,7 +441,11 @@ def recovery_report(root, max_mttr=600.0, max_cold_recoveries=None):
                 f"{where}: MTTR {r['mttr_s']:.1f}s exceeds --max-mttr "
                 f"{max_mttr:g}s"
             )
-        missing = [p for p in _PHASE_ORDER if r["phases"].get(p) is None]
+        order = (
+            _SERVE_PHASE_ORDER if r.get("mode") == "serving"
+            else _PHASE_ORDER
+        )
+        missing = [p for p in order if r["phases"].get(p) is None]
         if missing:
             report["problems"].append(
                 f"{where}: phase breakdown incomplete (missing "
@@ -440,11 +453,13 @@ def recovery_report(root, max_mttr=600.0, max_cold_recoveries=None):
             )
     # Executable-cache gate: CI can assert recoveries actually warm-start
     # from the cache. A recovery without compile-source phases cannot
-    # prove it was warm, so under the gate it counts as cold.
+    # prove it was warm, so under the gate it counts as cold. Serving
+    # failovers never recompile (live programs) and are exempt.
     if max_cold_recoveries is not None:
         cold = [
             r for r in report["recoveries"]
-            if r.get("first_step_source") != "warm"
+            if r.get("mode") != "serving"
+            and r.get("first_step_source") != "warm"
         ]
         report["cold_recoveries"] = len(cold)
         if len(cold) > max_cold_recoveries:
@@ -469,16 +484,21 @@ def _render_recovery(report):
     print(f"  completed recoveries (telemetry): "
           f"{report['recoveries_total']}")
     for r in report["recoveries"]:
+        order = (
+            _SERVE_PHASE_ORDER if r.get("mode") == "serving"
+            else _PHASE_ORDER + _COMPILE_PHASES
+        )
         phases = "  ".join(
             f"{p}={r['phases'][p]:.3f}s"
-            for p in _PHASE_ORDER + _COMPILE_PHASES
+            for p in order
             if r["phases"].get(p) is not None
         )
         mttr = f"{r['mttr_s']:.3f}s" if r.get("mttr_s") else "?"
         src = r.get("first_step_source", "unknown")
-        tag = "" if src == "unknown" else f"  first_step={src}"
-        print(f"  rank {r.get('rank')}: MTTR {mttr}  [{phases}]{tag}  "
-              f"{r.get('ckpt', '')}")
+        tag = "" if src in ("unknown", "n/a") else f"  first_step={src}"
+        mode = "  [serving]" if r.get("mode") == "serving" else ""
+        print(f"  rank {r.get('rank')}: MTTR {mttr}{mode}  [{phases}]{tag}"
+              f"  {r.get('ckpt', '')}")
     for a in report["aborts"]:
         print(f"  ABORT rank {a.get('rank')}: {a.get('reason')}")
     for p in report["problems"]:

@@ -396,6 +396,72 @@ def render(report, out=sys.stdout):
                              "gather(s)")
                 w(line + "\n")
 
+    # -- serving (smp.serving continuous-batching engine) ---------------
+    # SLO gauges (TTFT / ITL last+mean, throughput), occupancy (queue
+    # depth, decode slots, paged KV-pool blocks), and request lifecycle
+    # counters incl. failover re-admissions.
+    serve_events = {
+        s["labels"].get("event", "?"): s["value"]
+        for s in _series(report, "smp_serve_requests_total")
+    }
+    if serve_events or _series(report, "smp_serve_slots"):
+        w("\n-- serving --\n")
+        if serve_events:
+            w("  requests: " + "  ".join(
+                f"{k} {int(v)}" for k, v in sorted(serve_events.items())
+            ) + "\n")
+        tok = {
+            s["labels"].get("kind", "?"): s["value"]
+            for s in _series(report, "smp_serve_tokens_total")
+        }
+        if tok:
+            w("  tokens: " + "  ".join(
+                f"{k} {int(v)}" for k, v in sorted(tok.items())
+            ) + "\n")
+        ttft_last = _value(report, "smp_serve_ttft_seconds", stat="last")
+        ttft_mean = _value(report, "smp_serve_ttft_seconds", stat="mean")
+        itl_last = _value(report, "smp_serve_itl_seconds", stat="last")
+        itl_mean = _value(report, "smp_serve_itl_seconds", stat="mean")
+        if ttft_mean is not None or itl_mean is not None:
+            parts = []
+            if ttft_mean is not None:
+                parts.append(f"ttft {1e3 * ttft_mean:.1f}ms mean"
+                             + (f" ({1e3 * ttft_last:.1f}ms last)"
+                                if ttft_last is not None else ""))
+            if itl_mean is not None:
+                parts.append(f"itl {1e3 * itl_mean:.1f}ms mean"
+                             + (f" ({1e3 * itl_last:.1f}ms last)"
+                                if itl_last is not None else ""))
+            w("  latency: " + "  ".join(parts) + "\n")
+        rps = _value(report, "smp_serve_requests_per_sec")
+        tps = _value(report, "smp_serve_tokens_per_sec", scope="engine")
+        tps_chip = _value(report, "smp_serve_tokens_per_sec", scope="chip")
+        if rps is not None or tps is not None:
+            parts = []
+            if rps is not None:
+                parts.append(f"{rps:.2f} req/s")
+            if tps is not None:
+                parts.append(f"{tps:,.1f} tok/s")
+            if tps_chip is not None:
+                parts.append(f"{tps_chip:,.1f} tok/s/chip")
+            w("  throughput: " + "  ".join(parts) + "\n")
+        q = _value(report, "smp_serve_queue_depth")
+        active = _value(report, "smp_serve_slots", state="active")
+        total = _value(report, "smp_serve_slots", state="total")
+        if total is not None:
+            w(f"  occupancy: queue {int(q or 0)}  slots "
+              f"{int(active or 0)}/{int(total)}\n")
+        kv_used = _value(report, "smp_serve_kv_blocks", state="used")
+        kv_total = _value(report, "smp_serve_kv_blocks", state="total")
+        kv_res = _value(report, "smp_serve_kv_blocks", state="reserved")
+        if kv_total:
+            pct = 100.0 * (kv_used or 0) / kv_total
+            w(f"  kv pool: {int(kv_used or 0)}/{int(kv_total)} blocks "
+              f"used ({pct:.0f}%), {int(kv_res or 0)} reserved\n")
+        progs = _value(report, "smp_serve_programs")
+        if progs is not None:
+            w(f"  compiled programs: {int(progs)}\n")
+
     # -- health ---------------------------------------------------------
     # Fed by utils/health.py (SMP_HEALTH_CHECK sentinel), the fp16 loss
     # scaler, and the optimizer norm gauges; rendered identically for one

@@ -79,6 +79,7 @@ from smdistributed_modelparallel_tpu.nn.tp_registry import (
 )
 from smdistributed_modelparallel_tpu.nn.huggingface import from_hf
 from smdistributed_modelparallel_tpu.generation import generate
+from smdistributed_modelparallel_tpu import serving
 from smdistributed_modelparallel_tpu.utils.data import (
     dataloader,
     prefetch_to_device,

@@ -589,6 +589,83 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
     if rng is None:
         rng = jax.random.key(0)
 
+    # Decode-length shape buckets (SMP_SHAPE_BUCKETS "seq" sizes, the
+    # PR-11 policy): ragged (prompt-len, max-new-tokens) pairs round UP
+    # to bucket boundaries so serving-style traffic reuses cached
+    # programs instead of churning the _COMPILED LRU. max_new_tokens
+    # buckets for every decoder-only model (the extra steps are sliced
+    # off; EOS-frozen rows just emit pad there); prompt length buckets by
+    # LEFT-padding through the existing padded-prompt machinery, so it
+    # needs a mask-capable module (the smp.nn family). Greedy output is
+    # invariant; stochastic sampling draws from the bucketed key schedule
+    # (split(rng, bucketed_max_new) — reproducible for a fixed bucket
+    # config, documented in README). Beam search is excluded: its
+    # hypothesis scores normalize by max_new_tokens, so padding it would
+    # change the ranking.
+    orig_input_ids = input_ids
+    orig_T = input_ids.shape[1]
+    orig_new = max_new_tokens
+    if num_beams == 1 and not seq2seq:
+        from smdistributed_modelparallel_tpu.utils import exec_cache
+
+        policy = exec_cache.bucket_policy()
+        seqs = (policy or {}).get("seq")
+        if seqs:
+            padded = False
+            unbucketable = False
+            limit = getattr(module, "max_len", None) or getattr(
+                module, "num_positions", None
+            )
+            new_b = exec_cache.bucket_for(max_new_tokens, seqs)
+            if new_b is not None and limit is not None and (
+                orig_T + new_b > limit
+            ):
+                # Never let a bucket push a fitting request past the
+                # model's position limit — decode length stays exact.
+                new_b = None
+            if new_b is None:
+                unbucketable = True
+            elif new_b != max_new_tokens:
+                max_new_tokens = new_b
+                padded = True
+            t_b = exec_cache.bucket_for(orig_T, seqs)
+            if t_b is not None and limit is not None and (
+                t_b + max_new_tokens > limit
+            ):
+                t_b = None
+            if t_b is not None and t_b != orig_T:
+                import inspect
+
+                if "attention_mask" in inspect.signature(
+                    type(module).__call__
+                ).parameters:
+                    nb = input_ids.shape[0]
+                    pad_w = t_b - orig_T
+                    input_ids = jnp.concatenate(
+                        [jnp.full((nb, pad_w), pad_token_id,
+                                  input_ids.dtype), input_ids], axis=1
+                    )
+                    keep = (
+                        attention_mask.astype(jnp.int32)
+                        if attention_mask is not None
+                        else jnp.ones((nb, orig_T), jnp.int32)
+                    )
+                    attention_mask = jnp.concatenate(
+                        [jnp.zeros((nb, pad_w), jnp.int32), keep], axis=1
+                    )
+                    padded = True
+                else:
+                    unbucketable = True
+            elif t_b is None:
+                unbucketable = True
+            # "padded" wins over "unbucketable": a call whose decode
+            # length bucketed (program shared) but whose prompt dim
+            # couldn't must count as a bucket hit, not a miss.
+            exec_cache.record_bucket(
+                "padded" if padded
+                else ("unbucketable" if unbucketable else "exact")
+            )
+
     B, T = input_ids.shape
     cache_len = (1 + max_new_tokens) if seq2seq else (T + max_new_tokens)
     limit = getattr(module, "max_len", None) or getattr(
@@ -654,5 +731,14 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
     mesh = state.mesh if state.initialized else None
     if mesh is not None:
         with jax.set_mesh(mesh):
-            return compiled(*args)
-    return compiled(*args)
+            out = compiled(*args)
+    else:
+        out = compiled(*args)
+    if T != orig_T or max_new_tokens != orig_new:
+        # Bucketed run: drop the left-pad columns and the extra decode
+        # steps — callers see exactly the (prompt, max_new) they asked
+        # for.
+        out = jnp.concatenate(
+            [orig_input_ids, out[:, T:T + orig_new]], axis=1
+        )
+    return out

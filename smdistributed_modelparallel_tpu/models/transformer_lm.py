@@ -56,9 +56,14 @@ class CausalSelfAttention(nn.Module):
     deterministic: bool = True
     decode: bool = False
     decode_cache_len: Optional[int] = None
+    # Paged decoding for smp.serving (nn/utils.PagedKVCache): K/V live in
+    # a shared block pool; per-call state (block tables, positions)
+    # arrives via the ``paged`` argument. Mutually exclusive with decode.
+    paged_blocks: Optional[int] = None
+    paged_block_tokens: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x, attn_bias=None):
+    def __call__(self, x, attn_bias=None, paged=None):
         B, T, D = x.shape
         H = self.n_heads
         hd = D // H
@@ -71,7 +76,15 @@ class CausalSelfAttention(nn.Module):
         pos_offset = 0
         cache = None
         decode_mask = None
-        if self.decode:
+        if self.paged_blocks is not None:
+            if paged is None:
+                raise ValueError(
+                    "paged KV-cache decoding needs the per-call paged "
+                    "state (block_tables/positions) — drive this module "
+                    "through smp.serving.ServingEngine."
+                )
+            pos_offset = paged["positions"]
+        elif self.decode:
             from smdistributed_modelparallel_tpu.nn.utils import DecodeKVCache
 
             cache = DecodeKVCache(self, (B, self.decode_cache_len, H, hd),
@@ -84,7 +97,18 @@ class CausalSelfAttention(nn.Module):
             # The cache stores POST-rotary K: chunk q/k rotate at their
             # absolute positions once, on write.
             q, k = apply_rotary(q, k, rd, neox_style=True, offset=pos_offset)
-        if cache is not None:
+        if self.paged_blocks is not None:
+            from smdistributed_modelparallel_tpu.nn.utils import PagedKVCache
+
+            pool = PagedKVCache(
+                self, self.paged_blocks, self.paged_block_tokens, H, hd,
+                k.dtype,
+            )
+            k, v, decode_mask = pool.append(
+                k, v, paged["block_tables"], paged["positions"],
+                valid=paged.get("valid"), window=self.window,
+            )
+        elif cache is not None:
             k, v, decode_mask = cache.append(k, v, window=self.window)
         from smdistributed_modelparallel_tpu.ops.attention import attention_core
 
@@ -122,13 +146,16 @@ class TransformerLayer(nn.Module):
     ln_eps: float = 1e-5
     decode: bool = False
     decode_cache_len: Optional[int] = None
+    paged_blocks: Optional[int] = None
+    paged_block_tokens: Optional[int] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, paged=None):
         attn = CausalSelfAttention(
             self.d_model, self.n_heads, self.dropout, self.attention_in_fp32,
             self.rotary, self.rotary_dim, self.window, self.deterministic,
             self.decode, self.decode_cache_len,
+            self.paged_blocks, self.paged_block_tokens,
             name="attn",
         )
 
@@ -140,10 +167,10 @@ class TransformerLayer(nn.Module):
 
         if self.parallel_block:
             h = nn.LayerNorm(epsilon=self.ln_eps, name="ln1")(x)
-            x = x + attn(h) + mlp(h)
+            x = x + attn(h, paged=paged) + mlp(h)
         else:
             h = nn.LayerNorm(epsilon=self.ln_eps, name="ln1")(x) if self.pre_layernorm else x
-            x = x + attn(h)
+            x = x + attn(h, paged=paged)
             if self.post_layernorm:
                 x = nn.LayerNorm(epsilon=self.ln_eps, name="ln1_post")(x)
             h = nn.LayerNorm(epsilon=self.ln_eps, name="ln2")(x) if self.pre_layernorm else x
@@ -156,13 +183,20 @@ class TransformerLayer(nn.Module):
 
 
 class _ScanBody(nn.Module):
-    """Carry-protocol wrapper for nn.scan over TransformerLayer."""
+    """Carry-protocol wrapper for nn.scan over TransformerLayer. The
+    second argument is the scan's xs slot — None in training/decode, the
+    (broadcast) paged per-call state under smp.serving."""
 
     layer_kwargs: dict
 
     @nn.compact
-    def __call__(self, x, _):
-        return TransformerLayer(**self.layer_kwargs, name="block")(x), None
+    def __call__(self, x, paged):
+        return (
+            TransformerLayer(**self.layer_kwargs, name="block")(
+                x, paged=paged
+            ),
+            None,
+        )
 
 
 class TransformerLM(nn.Module):
@@ -188,6 +222,11 @@ class TransformerLM(nn.Module):
     # KV-cache decoding for smp.generate (see nn/utils.DecodeKVCache).
     decode: bool = False
     decode_cache_len: Optional[int] = None
+    # Paged serving decode (smp.serving / nn/utils.PagedKVCache): the
+    # block-pool geometry; per-call block tables/positions arrive via the
+    # ``paged`` argument of ``__call__``.
+    paged_blocks: Optional[int] = None
+    paged_block_tokens: Optional[int] = None
 
     @nn.nowrap
     def _layer_kwargs(self):
@@ -205,17 +244,27 @@ class TransformerLM(nn.Module):
             ln_eps=self.ln_eps,
             decode=self.decode,
             decode_cache_len=self.decode_cache_len,
+            paged_blocks=self.paged_blocks,
+            paged_block_tokens=self.paged_block_tokens,
         )
 
     def setup(self):
         self.wte = nn.Embed(self.vocab_size, self.d_model, name="wte")
         if self.pos_type == "learned":
             self.wpe = nn.Embed(self.max_len, self.d_model, name="wpe")
+        scan_kwargs = {}
+        if self.paged_blocks is not None:
+            # The paged per-call state (block tables, positions) is the
+            # same for every layer: broadcast it instead of scanning.
+            # Only the paged clone changes its scan signature — the
+            # training/decode paths keep the exact pre-serving transform.
+            scan_kwargs["in_axes"] = nn.broadcast
         ScanLayers = nn.scan(
             _ScanBody,
             variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True, "dropout": True},
             length=self.n_layers,
+            **scan_kwargs,
         )
         self.layers = ScanLayers(self._layer_kwargs(), name="layers")
         self.ln_f = nn.LayerNorm(epsilon=self.ln_eps, name="ln_f")
@@ -230,9 +279,16 @@ class TransformerLM(nn.Module):
 
     # -- pipeline decomposition ----------------------------------------
 
-    def embed(self, ids):
+    def embed(self, ids, paged=None):
         x = self.wte(ids)
         if self.pos_type == "learned":
+            if paged is not None:
+                # Per-row absolute positions (continuous batching mixes
+                # sequences at different depths in one decode batch).
+                pos = paged["positions"][:, None] + jnp.arange(
+                    ids.shape[-1], dtype=jnp.int32
+                )[None, :]
+                return x + self.wpe(jnp.clip(pos, 0, self.max_len - 1))
             start = 0
             if self.decode:
                 start = self._pos_index.value
@@ -264,12 +320,14 @@ class TransformerLM(nn.Module):
             logits, targets, label_smoothing=self.label_smoothing
         )
 
-    def __call__(self, ids, targets=None):
+    def __call__(self, ids, targets=None, paged=None):
         """ids -> logits; with ``targets`` ([B, T] int, -100 = ignored) ->
         per-token fp32 losses instead, via the fused LM-head CE (the
         logits tensor never materializes on the TPU tied-head path).
         Loss mode requires pp == 1 (the pipeline head protocol carries no
-        targets)."""
+        targets). ``paged`` is the smp.serving per-call decode state
+        (block tables / positions / valid), only meaningful on a
+        ``paged_blocks`` clone."""
         if targets is not None:
             from smdistributed_modelparallel_tpu.backend.state import state
 
@@ -278,11 +336,11 @@ class TransformerLM(nn.Module):
                     "model(ids, targets=...) is not available under "
                     "pipeline parallelism; compute the loss from logits."
                 )
-        x = self.embed(ids)
-        x = self._apply_layers(x)
+        x = self.embed(ids, paged=paged)
+        x = self._apply_layers(x, paged=paged)
         return self.head(x, targets)
 
-    def _apply_layers(self, x):
+    def _apply_layers(self, x, paged=None):
         """The layer stack: the lifted ``nn.scan`` normally, or — under
         ``sharded_params: zero3`` at pp=1 — the double-buffered
         just-in-time gather scan (``parallel/zero.zero3_prefetch_scan``):
@@ -293,6 +351,7 @@ class TransformerLM(nn.Module):
         copies. Decode (mutable KV cache) and non-deterministic dropout
         need the lifted scan's collection/rng plumbing and keep it."""
         if not self.is_initializing() and not self.decode and (
+                paged is None) and (
                 self.dropout == 0.0 or self.deterministic):
             import jax as _jax
 
@@ -312,7 +371,7 @@ class TransformerLM(nn.Module):
                 return zero.zero3_prefetch_scan(
                     apply_layer, x, stacked, self.n_layers, specs
                 )
-        x, _ = self.layers(x, None)
+        x, _ = self.layers(x, paged)
         return x
 
     @nn.nowrap

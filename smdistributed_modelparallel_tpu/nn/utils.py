@@ -294,3 +294,118 @@ class DecodeKVCache:
             self._cv.value,
             keep[None, None, None, :],
         )
+
+
+class PagedKVCache:
+    """Block-pooled per-layer K/V cache for continuous-batching serving.
+
+    Where ``DecodeKVCache`` gives every sequence a private contiguous
+    [B, cache_len, H, hd] buffer, this holds ONE pool of
+    ``num_blocks`` fixed-size token blocks ([num_blocks, block_tokens,
+    H, hd] per layer) shared by every in-flight sequence. A host-side
+    allocator (``serving/kv_cache.BlockAllocator``) hands out blocks and
+    builds per-sequence BLOCK TABLES — ordered pool-block ids, logical
+    block ``j`` of a sequence living at pool block ``table[j]`` — passed
+    into the compiled program as device arrays, so sequences of wildly
+    different lengths share the pool and a finished sequence's blocks are
+    reusable the moment the host frees them. Pool block 0 is reserved as
+    the TRASH block: unused table entries point at it, so writes from
+    inactive decode slots and padded prefill tail positions land there
+    harmlessly (and are never attended — the mask is position-derived).
+
+    Like ``DecodeKVCache`` the pool shards over tp on the head axis
+    (``shard_activation``), so the serving KV footprint per device is
+    ``pool_bytes / tp`` and the X-ray's KV replication detector
+    (``hlo_audit.serving_kv_findings``) can hold it to that.
+
+    Call protocol (one compiled program each; driven by
+    ``serving/engine.py``):
+
+    - decode step: ``k``/``v`` are [S, 1, H, hd] (one token per decode
+      slot), ``positions[b]`` is the token's absolute position, and the
+      returned attend set is the whole gathered table ([S, T_max, H, hd]
+      where ``T_max = max_blocks * block_tokens``) with a
+      ``col <= position`` boolean mask.
+    - prefill chunk: ``k``/``v`` are [B, C, H, hd] (usually B=1), written
+      at ``positions[b] + t``; ``valid[b]`` marks how many of the C
+      chunk rows are real (the last chunk of a prompt is padded) — the
+      tail's writes are routed to the trash block. The mask is chunk-
+      causal against absolute positions: col ``j`` is visible to chunk
+      row ``t`` iff ``j <= positions[b] + t``.
+    """
+
+    def __init__(self, mod, num_blocks, block_tokens, heads, head_dim,
+                 dtype):
+        shape = (num_blocks, block_tokens, heads, head_dim)
+        self._pk = mod.variable(
+            "cache", "pool_key", lambda: jnp.zeros(shape, dtype)
+        )
+        self._pv = mod.variable(
+            "cache", "pool_value", lambda: jnp.zeros(shape, dtype)
+        )
+        self.num_blocks = num_blocks
+        self.block_tokens = block_tokens
+
+    def _shard(self, pool):
+        # tp shards the head axis, exactly like the activations/contiguous
+        # caches; trivial-axis meshes make this a no-op.
+        return shard_activation(pool, None, None, TP_AXIS, None)
+
+    def append(self, k, v, block_tables, positions, valid=None,
+               window=None):
+        """Write chunk K/V and return ``(k_all, v_all, mask)``.
+
+        Args:
+          k, v: [B, T, H, hd] chunk K/V (T=1 decode, T=chunk prefill).
+          block_tables: [B, max_blocks] int32 pool-block ids in sequence
+            order; unused entries 0 (the trash block).
+          positions: [B] int32 absolute position of the chunk's first
+            token (number of tokens already cached for that sequence).
+          valid: optional [B] int32 — rows ``t >= valid[b]`` of the chunk
+            are padding: their writes go to the trash block.
+          window: optional local-attention band width.
+        """
+        B, T = k.shape[:2]
+        bt = self.block_tokens
+        max_blocks = block_tables.shape[1]
+        pos = positions[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        blk = jnp.take_along_axis(
+            block_tables, jnp.clip(pos // bt, 0, max_blocks - 1), axis=1
+        )
+        dest = blk * bt + pos % bt                              # [B, T]
+        if valid is not None:
+            # Padded chunk tail: route the write into the trash block
+            # (offset by t so a wide chunk never scatters twice into one
+            # slot of it — the winner would be nondeterministic).
+            trash = jnp.arange(T, dtype=jnp.int32)[None, :] % bt
+            dest = jnp.where(
+                jnp.arange(T)[None, :] < valid[:, None], dest, trash
+            )
+        flat = dest.reshape(-1)
+        H, hd = k.shape[2], k.shape[3]
+        pk = self._pk.value.reshape(self.num_blocks * bt, H, hd)
+        pv = self._pv.value.reshape(self.num_blocks * bt, H, hd)
+        pk = pk.at[flat].set(k.reshape(B * T, H, hd))
+        pv = pv.at[flat].set(v.reshape(B * T, H, hd))
+        self._pk.value = self._shard(
+            pk.reshape(self.num_blocks, bt, H, hd)
+        )
+        self._pv.value = self._shard(
+            pv.reshape(self.num_blocks, bt, H, hd)
+        )
+        # Gather every table slot: logical position of gathered column j
+        # IS j (tables list blocks in sequence order).
+        slots = (
+            block_tables[:, :, None] * bt
+            + jnp.arange(bt, dtype=jnp.int32)[None, None, :]
+        ).reshape(B, max_blocks * bt)
+        pk_flat = self._pk.value.reshape(self.num_blocks * bt, H, hd)
+        pv_flat = self._pv.value.reshape(self.num_blocks * bt, H, hd)
+        k_all = jnp.take(pk_flat, slots, axis=0)        # [B, S, H, hd]
+        v_all = jnp.take(pv_flat, slots, axis=0)
+        cols = jnp.arange(max_blocks * bt, dtype=jnp.int32)
+        # keep[b, t, j]: column j visible to chunk row t of sequence b.
+        keep = cols[None, None, :] <= pos[:, :, None]
+        if window is not None:
+            keep = keep & (pos[:, :, None] - cols[None, None, :] < window)
+        return k_all, v_all, keep[:, None, :, :]

@@ -30,6 +30,13 @@ Faults:
   ``K`` outgoing heartbeats (all peers): false-positive/flap testing for
   the failure detector — ``K`` below the miss budget must NOT produce a
   dead classification, above it must.
+- ``kill_replica@request=N[:rank=R]`` — SIGKILL a SERVING replica
+  mid-decode: fires at the first decode-step boundary where the
+  replica's ``N``-th admitted request (1-based) has produced at least
+  one token and is still unfinished. The replica-failover layer
+  (``serving/replica.py``) must detect the death over the heartbeat bus
+  and the survivor re-admit every unfinished request from its mirrored
+  logs.
 - ``bus_drop@seq=N[:rank=R][:dest=D]`` — silently drop this process's
   ``N``-th native-bus send (0-based ordinal over all sends; heartbeats
   ride their own seam and do not consume ordinals). The receiver never
@@ -73,14 +80,14 @@ CHAOS_ENV = "SMP_CHAOS"
 
 _KNOWN_FAULTS = (
     "sigterm", "kill", "wedge", "heartbeat_drop",
-    "bus_drop", "bus_error", "delay_collective",
+    "bus_drop", "bus_error", "delay_collective", "kill_replica",
 )
 
 # Argument value parsers: validated at PARSE time so a typo degrades to a
 # skipped rule with a warning — never a ValueError at a seam mid-run.
 _NUMERIC_KEYS = {
     "step": int, "rank": int, "seq": int, "dest": int, "count": int,
-    "ms": float,
+    "ms": float, "request": int,
 }
 
 
@@ -231,6 +238,34 @@ class ChaosInjector:
                 )
                 if ms > 0:
                     time.sleep(ms / 1000.0)
+
+    def on_serve_decode(self, progress):
+        """serving/engine.py seam: called once per decode-step boundary.
+        ``progress(n)`` reports ``(tokens_emitted, finished)`` for the
+        engine's n-th admitted request, or None when fewer than n were
+        admitted. Rule ``kill_replica@request=N`` SIGKILLs this process
+        the first time request N is mid-decode (>= 1 token, unfinished)
+        — the hard replica death the serving failover must absorb."""
+        if not os.environ.get(CHAOS_ENV):
+            return
+        for r in self._sync():
+            if r.fault != "kill_replica" or r.fired or not r.rank_matches():
+                continue
+            n = int(r.kv.get("request", -1))
+            got = progress(n) if n >= 1 else None
+            if got is None:
+                continue
+            tokens, finished = got
+            if finished or tokens < 1:
+                continue
+            r.fired += 1
+            record_chaos("kill_replica", f"request={n} tokens={tokens}")
+            logger.warning(
+                "chaos: SIGKILL of serving replica pid %d with request "
+                "#%d mid-decode (%d tokens emitted)",
+                os.getpid(), n, tokens,
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def on_heartbeat(self, dest):
         """supervisor.py seam: called once per outgoing heartbeat. Returns

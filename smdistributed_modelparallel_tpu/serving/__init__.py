@@ -1,0 +1,51 @@
+"""``smp.serving`` — continuous-batching serving engine.
+
+The production serving tier (ISSUE 14 / ROADMAP "millions of users,
+heavy traffic"): a paged/block-allocated KV cache shared by every
+in-flight sequence, a continuous-batching scheduler with chunked
+prefill, exactly two bucket-keyed compiled programs warm-started by the
+persistent executable cache, SLO telemetry through ``smp.telemetry``,
+and replica failover driven by the PR-10 heartbeat supervisor.
+
+Typical use::
+
+    engine = smp.serving.ServingEngine(model)   # or (module, params=...)
+    results = engine.run([
+        smp.serving.ServeRequest("r0", prompt_ids, max_new_tokens=64),
+        smp.serving.ServeRequest("r1", other_ids, max_new_tokens=8,
+                                 temperature=0.8, top_p=0.9, seed=7),
+    ])
+
+Multi-process deployments wrap the engine in
+``ReplicatedServingEngine`` for mirror-log failover.
+
+Import-hygiene contract: importing this package must never initialize an
+accelerator backend (jax work happens only inside the engine's runtime
+entry points).
+"""
+
+from smdistributed_modelparallel_tpu.serving.engine import (
+    ServeRequest,
+    ServingEngine,
+)
+from smdistributed_modelparallel_tpu.serving.kv_cache import (
+    BlockAllocator,
+    block_tokens,
+    prefill_chunk_tokens,
+    serve_slots,
+)
+from smdistributed_modelparallel_tpu.serving.replica import (
+    SERVE_MIRROR_TX,
+    ReplicatedServingEngine,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "ReplicatedServingEngine",
+    "SERVE_MIRROR_TX",
+    "ServeRequest",
+    "ServingEngine",
+    "block_tokens",
+    "prefill_chunk_tokens",
+    "serve_slots",
+]

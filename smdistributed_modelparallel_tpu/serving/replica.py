@@ -1,0 +1,307 @@
+"""Serving-replica failover (``smp.serving.ReplicatedServingEngine``).
+
+Each process of a multi-process serving deployment runs its own
+``ServingEngine`` over its own devices (dp-replicated traffic: the
+control plane is shared, the compute is local) and MIRRORS every
+in-flight request's restartable log — prompt, sampling params, seed,
+sampled-tokens-so-far — to its peers over the native bus (reserved
+control tx ``SERVE_MIRROR_TX``, the quiet ``send_raw`` path heartbeats
+use: mirror traffic must not consume chaos bus-send ordinals or flood
+the flight ring).
+
+Failure detection rides the PR-10 supervisor: with ``SMP_SUPERVISOR=on``
+the heartbeat detector classifies a SIGKILLed replica **dead** within
+the miss budget; without it, the bus's receive-side death marks
+(``peer_down``) carry the signal. Either way, the surviving replica
+re-admits the dead replica's unfinished requests from its mirror shadow
+— idempotent by request id (a request the survivor already served is
+skipped), and EXACT: the resumed request continues the dead replica's
+key schedule at ``len(tokens_so_far)``, so the survivor emits
+token-for-token what the dead replica would have (asserted by the
+2-process E2E in ``tests/test_multiprocess.py``).
+
+The MTTR gauges become availability SLOs: a completed failover records
+``smp_recoveries_total`` / ``smp_recovery_seconds`` with the serving
+phase breakdown ``detect`` (last mirror frame -> classification) /
+``readmit`` (shadow scan + re-admission) / ``first_token`` (first
+re-admitted token sampled), which ``scripts/resilience_probe.py
+--recovery`` parses and gates exactly like training recoveries.
+"""
+
+import json
+import time
+
+from smdistributed_modelparallel_tpu.backend.state import state
+from smdistributed_modelparallel_tpu.serving.engine import ServeRequest
+from smdistributed_modelparallel_tpu.utils.logger import get_logger
+from smdistributed_modelparallel_tpu.utils.telemetry import (
+    record_failure_detected,
+    record_recovery,
+    record_serve_request,
+)
+
+logger = get_logger()
+
+#: Reserved control tx for serving mirror frames (-1 exit relay, -2
+#: preempt notice, -3 preempt step-edge, -4 heartbeats, -5 recovery
+#: rendezvous — see backend/native.py).
+SERVE_MIRROR_TX = -6
+
+
+def _flight():
+    from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+        flight_recorder,
+    )
+
+    return flight_recorder
+
+
+class ReplicatedServingEngine:
+    """Failover wrapper around a local ``ServingEngine``."""
+
+    def __init__(self, engine, bus=None):
+        self.engine = engine
+        if bus is None:
+            comm = state._comm
+            bus = comm._bus if comm is not None else None
+        if bus is None or bus.world <= 1:
+            raise ValueError(
+                "ReplicatedServingEngine needs a multi-process native bus "
+                "(replica failover is between processes)."
+            )
+        self.bus = bus
+        self.rank = bus.rank
+        self.peers = [p for p in range(bus.world) if p != bus.rank]
+        self.shadow = {p: {} for p in self.peers}   # peer -> rid -> record
+        self._last_frame = {p: time.monotonic() for p in self.peers}
+        self._handled = set()                        # peers failed over
+        # Per-peer pending MTTR closures: concurrent failovers (3+
+        # replicas, two deaths in one window) each record their own
+        # recovery with their own re-admitted streams.
+        self._pending_mttr = {}                      # peer -> pending
+        self._sent_tokens = {}   # rid -> tokens already mirrored out
+
+    # -- mirror plane ---------------------------------------------------
+    #
+    # Wire format: the FIRST frame for a request ships the full
+    # restartable record; every later frame ships only the token tail
+    # since the last send ({"rid", "base", "tokens", "done"}). The bus
+    # delivers in order per link, so the receiver reconstructs by
+    # appending at ``base`` — without the delta form, a long stream
+    # re-serializes its whole history every token (O(n^2) per stream).
+
+    def _mirror_out(self):
+        updates = self.engine.drain_dirty()
+        if not updates:
+            return
+        wire = []
+        for rid, rec in updates:
+            sent = self._sent_tokens.get(rid)
+            if sent is None or sent > len(rec["tokens"]):
+                wire.append(dict(rec, full=True))
+            else:
+                wire.append({
+                    "rid": rid, "base": sent,
+                    "tokens": rec["tokens"][sent:],
+                    "done": rec["done"],
+                })
+            self._sent_tokens[rid] = len(rec["tokens"])
+        payload = json.dumps(
+            {"from": self.rank, "records": wire}
+        ).encode()
+        for p in self.peers:
+            if p in self._handled:
+                continue
+            # Quiet best-effort enqueue: a dead link's rc is detection
+            # signal, not an error — the detector owns classification.
+            self.bus.send_raw(p, payload, SERVE_MIRROR_TX)
+
+    def _mirror_in(self):
+        now = time.monotonic()
+        for p in self.peers:
+            frames = self.bus.drain_bytes(p, SERVE_MIRROR_TX)
+            if frames:
+                self._last_frame[p] = now
+            for raw in frames:
+                try:
+                    frame = json.loads(raw)
+                except ValueError:
+                    continue
+                for rec in frame.get("records", ()):
+                    rid = rec.get("rid")
+                    if not rid:
+                        continue
+                    if rec.get("full") or "prompt" in rec:
+                        rec = dict(rec)
+                        rec.pop("full", None)
+                        self.shadow[p][rid] = rec
+                        continue
+                    known = self.shadow[p].get(rid)
+                    if known is None:
+                        continue  # never saw the header; cannot apply
+                    base = int(rec.get("base", 0))
+                    if base <= len(known["tokens"]):
+                        known["tokens"] = (
+                            known["tokens"][:base] + list(rec["tokens"])
+                        )
+                        known["done"] = bool(rec.get("done"))
+
+    # -- failure detection + re-admission -------------------------------
+
+    def _failed_peers(self):
+        from smdistributed_modelparallel_tpu.resilience.supervisor import (
+            supervisor,
+        )
+
+        failed = {}
+        detector = supervisor.detector
+        if detector is not None:
+            failed.update(detector.failures())
+        for p in self.peers:
+            if p not in failed and self.bus.peer_down(p):
+                failed[p] = "dead"
+        return {
+            p: kind for p, kind in failed.items()
+            if p in self.peers and p not in self._handled
+        }
+
+    def _failover(self, peer, kind):
+        from smdistributed_modelparallel_tpu.resilience.supervisor import (
+            heartbeat_interval,
+            miss_budget,
+            supervisor,
+        )
+
+        t0 = time.monotonic()
+        # The mirror-frame gap over-reports detection latency for a peer
+        # that was idle (nothing dirty = nothing sent); the heartbeat
+        # detector's classification window bounds the REAL latency, so
+        # cap the phase by it when the detector is armed.
+        detect_s = max(t0 - self._last_frame.get(peer, t0), 0.0)
+        if supervisor.detector is not None:
+            detect_s = min(
+                detect_s, heartbeat_interval() * (miss_budget() + 1)
+            )
+        self._handled.add(peer)
+        _flight().record_supervisor(
+            "recover_begin", peer=peer,
+            detail=f"mode=serving kind={kind}",
+        )
+        if supervisor.detector is None:
+            # No heartbeat detector running (SMP_SUPERVISOR=off): the bus
+            # death mark was the classification — count it ourselves.
+            record_failure_detected(kind, peer, detail="serving bus probe")
+        readmitted = {}
+        for rid, rec in sorted(self.shadow[peer].items()):
+            if rec.get("done"):
+                continue
+            req = ServeRequest(
+                request_id=rid,
+                prompt=rec["prompt"],
+                max_new_tokens=rec["max_new_tokens"],
+                temperature=rec.get("temperature", 0.0),
+                top_k=rec.get("top_k"),
+                top_p=rec.get("top_p"),
+                eos_token_id=rec.get("eos_token_id"),
+                seed=rec.get("seed", 0),
+                deadline_s=rec.get("deadline_s"),
+                resume_tokens=tuple(rec.get("tokens", ())),
+            )
+            if self.engine.submit(req):
+                readmitted[rid] = len(req.resume_tokens)
+                record_serve_request("readmitted")
+        t1 = time.monotonic()
+        logger.warning(
+            "[serving] replica %d is %s: re-admitted %d unfinished "
+            "request(s) from the mirror shadow (%.3fs).",
+            peer, kind, len(readmitted), t1 - t0,
+        )
+        pending = {
+            "peer": peer,
+            "t_detect": t0,
+            "detect_s": detect_s,
+            "readmit_s": t1 - t0,
+            # rid -> token count at re-admission: closure needs progress
+            # BEYOND this baseline, not just the resumed prefix.
+            "rids": readmitted,
+        }
+        if readmitted:
+            self._pending_mttr[peer] = pending
+        else:
+            # Nothing in flight died with the replica: close immediately.
+            self._close_mttr(pending, first_token_s=0.0)
+
+    def _close_mttr(self, pending, first_token_s):
+        self._pending_mttr.pop(pending["peer"], None)
+        phases = {
+            "detect": pending["detect_s"],
+            "readmit": pending["readmit_s"],
+            "first_token": first_token_s,
+        }
+        mttr = sum(phases.values())
+        record_recovery(mttr, phases=phases)
+        logger.warning(
+            "[serving] FAILOVER complete: first re-admitted token %.2fs "
+            "after detection (phases: %s).", mttr,
+            {k: round(v, 3) for k, v in phases.items()},
+        )
+
+    def _check_mttr_closure(self):
+        for pending in list(self._pending_mttr.values()):
+            for rid, baseline in pending["rids"].items():
+                rec = self.engine.mirror_log.get(rid)
+                if rec is None:
+                    continue
+                if len(rec["tokens"]) > baseline or rec["done"]:
+                    self._close_mttr(
+                        pending,
+                        first_token_s=max(
+                            time.monotonic() - pending["t_detect"]
+                            - pending["readmit_s"], 0.0,
+                        ),
+                    )
+                    break
+
+    # -- driving --------------------------------------------------------
+
+    def step(self):
+        """One replicated tick: local engine tick, mirror exchange,
+        failover check. Returns True while local work remains."""
+        busy = self.engine.step()
+        self._mirror_out()
+        self._mirror_in()
+        for peer, kind in self._failed_peers().items():
+            self._failover(peer, kind)
+        self._check_mttr_closure()
+        return busy or bool(self._pending_mttr)
+
+    def run(self, requests=(), timeout_s=300.0, linger_s=0.0):
+        """Serve ``requests`` (plus any failover re-admissions) to
+        completion. ``linger_s`` keeps ticking that long after local work
+        drains so late peer deaths are still absorbed (the E2E uses it to
+        hold the survivor open across the kill window)."""
+        for req in requests:
+            self.engine.submit(req)
+        deadline = time.monotonic() + timeout_s
+        linger_until = None
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError("replicated serving run timed out")
+            busy = self.step()
+            if busy:
+                linger_until = None
+                if not self.engine.last_tick_worked:
+                    time.sleep(0.001)  # blocked on arrivals/blocks/MTTR
+                continue
+            if linger_s <= 0.0:
+                break
+            if self._handled >= set(self.peers):
+                # Every peer already failed over — nothing left to linger
+                # for.
+                break
+            if linger_until is None:
+                linger_until = time.monotonic() + linger_s
+            elif time.monotonic() >= linger_until:
+                break
+            time.sleep(0.02)
+        return dict(self.engine.results)

@@ -283,7 +283,7 @@ def _delete_entry(path):
 
 
 def load(name, key_hash, module_sha=None, params=None,
-         expected_param_shardings=None):
+         expected_param_shardings=None, extra_findings_fn=None):
     """Deserialize a cached step executable, or None.
 
     Returns ``(compiled, audit)``; ``audit`` is the fresh post-load X-ray
@@ -347,7 +347,7 @@ def load(name, key_hash, module_sha=None, params=None,
         return None, None
     audit = _verify_and_republish(
         name, key_hash, compiled, meta, params, expected_param_shardings,
-        t0,
+        t0, extra_findings_fn=extra_findings_fn,
     )
     if audit is False:  # fingerprint veto
         record_exec_cache("reject_fingerprint")
@@ -384,7 +384,8 @@ def _version_skew(meta):
 
 
 def _verify_and_republish(name, key_hash, compiled, meta, params,
-                          expected_param_shardings, t0):
+                          expected_param_shardings, t0,
+                          extra_findings_fn=None):
     """X-ray the deserialized executable and diff it against the entry's
     stored fingerprint. Returns the fresh audit on success (gauges +
     flight event re-published — cache hits do not bypass the PR-9
@@ -400,6 +401,7 @@ def _verify_and_republish(name, key_hash, compiled, meta, params,
             name, compiled, key=key_hash, params=params,
             expected_param_shardings=expected_param_shardings,
             publish=False, persist=False,
+            extra_findings_fn=extra_findings_fn,
         )
     except Exception as e:  # pragma: no cover - defensive
         logger.warning("[exec_cache] %s: post-load audit failed (%s); "
@@ -418,6 +420,52 @@ def _verify_and_republish(name, key_hash, compiled, meta, params,
             return False
     hlo_audit.republish(fresh, seconds=time.perf_counter() - t0)
     return fresh
+
+
+def aot_compile(name, key_src, lowered, params=None,
+                extra_findings_fn=None):
+    """Compile a lowered program through the full warm-start sequence the
+    step engine runs — consult the disk cache (content-verified by the
+    lowered-module hash, fingerprint-diffed on hit), else
+    ``lowered.compile()`` + X-ray audit + store — packaged for other
+    program owners (the serving engine's prefill/decode programs).
+
+    ``key_src`` is any repr-stable tuple identifying the program family
+    (shapes, knobs, topology facts the caller deems key-worthy); the
+    topology itself is folded in by the entry path as usual. Returns
+    ``(compiled, audit, source)`` with ``source`` in
+    {"fresh", "disk_cache"}; the compile event lands in the module
+    ledger either way (the supervisor's MTTR split reads it).
+    """
+    from smdistributed_modelparallel_tpu.utils import hlo_audit
+
+    key_hash = stable_key_hash(key_src)
+    compiled = None
+    audit = None
+    source = "fresh"
+    module_sha = None
+    t0 = time.perf_counter()
+    if enabled():
+        module_sha = module_hash(lowered)
+        compiled, audit = load(
+            name, key_hash, module_sha=module_sha, params=params,
+            extra_findings_fn=extra_findings_fn,
+        )
+        if compiled is not None:
+            source = "disk_cache"
+    if compiled is None:
+        compiled = lowered.compile()
+        audit = hlo_audit.maybe_audit(
+            name, compiled, key=key_hash, params=params,
+            extra_findings_fn=extra_findings_fn,
+        )
+        if enabled():
+            store(
+                name, key_hash, compiled, module_sha=module_sha,
+                audit=audit, compile_seconds=time.perf_counter() - t0,
+            )
+    record_compile_event(name, source, time.perf_counter() - t0)
+    return compiled, audit, source
 
 
 def store(name, key_hash, compiled, module_sha=None, audit=None,

@@ -721,6 +721,82 @@ def _loop_findings(hlo_text, census, cfg, mesh):
     return findings
 
 
+def serving_kv_findings(compiled, mesh, cache_template=None,
+                        min_bytes=1024):
+    """Replication detector for the serving programs' paged KV pool
+    (``smp.serving``): under a tp > 1 mesh every ``pool_key`` /
+    ``pool_value`` output leaf must be tp-partitioned on its head axis
+    (the ``PagedKVCache`` sharding contract) — a replicated pool
+    multiplies the dominant serving HBM cost by tp. ``cache_template``
+    (shape/dtype tree of the engine's cache) sizes the wasted bytes; the
+    detector itself reads the compiled program's output shardings, so it
+    audits fresh compiles and deserialized exec-cache hits alike."""
+    from smdistributed_modelparallel_tpu.backend.topology import TP_AXIS
+
+    findings = []
+    tp = dict(mesh.shape).get(TP_AXIS, 1) if mesh is not None else 1
+    if tp <= 1:
+        return findings
+    sizes = {}
+    if cache_template is not None:
+        try:
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                cache_template
+            )[0]:
+                name = _leaf_path(path)
+                size = 1
+                for d in leaf.shape:
+                    size *= int(d)
+                sizes[name] = size * jnp_dtype_bytes(leaf.dtype)
+        except Exception:
+            sizes = {}
+    try:
+        out_shardings = compiled.output_shardings
+        leaves = jax.tree_util.tree_flatten_with_path(
+            out_shardings, is_leaf=lambda x: hasattr(x, "is_fully_replicated")
+        )[0]
+    except Exception:
+        return findings
+    for path, sharding in leaves:
+        name = _leaf_path(path)
+        if "pool_key" not in name and "pool_value" not in name:
+            continue
+        try:
+            replicated = sharding.is_fully_replicated
+        except Exception:
+            continue
+        if not replicated:
+            continue
+        nbytes = 0
+        for known, size in sizes.items():
+            if name.endswith(known) or known.endswith(name):
+                nbytes = size
+                break
+        if sizes and nbytes < min_bytes:
+            continue
+        findings.append({
+            "kind": "replicated_kv_cache",
+            "tensor": name,
+            "bytes": nbytes,
+            "bytes_wasted": int(nbytes * (tp - 1) / tp),
+            "detail": (
+                f"tensor_parallel_degree={tp} but the paged KV pool "
+                "output is fully replicated (expected head-axis tp "
+                "sharding)"
+            ),
+        })
+    return findings
+
+
+def jnp_dtype_bytes(dtype):
+    try:
+        import numpy as np
+
+        return int(np.dtype(dtype).itemsize)
+    except Exception:
+        return 4
+
+
 # ----------------------------------------------------------------------
 # The audit itself
 # ----------------------------------------------------------------------
@@ -834,7 +910,8 @@ def cache_key_hash(key):
 
 def audit_compiled(name, compiled, key=None, params=None,
                    expected_param_shardings=None, mesh=None, cfg=None,
-                   min_bytes=1024, publish=True, persist=True):
+                   min_bytes=1024, publish=True, persist=True,
+                   extra_findings_fn=None):
     """Run the full audit over one compiled executable. Explicit calls
     always run (the ``SMP_HLO_AUDIT`` gate lives in ``maybe_audit``)."""
     from smdistributed_modelparallel_tpu.backend.state import state
@@ -871,6 +948,15 @@ def audit_compiled(name, compiled, key=None, params=None,
         compiled, params, expected_param_shardings, mesh, min_bytes
     )
     findings += _loop_findings(text, census, cfg, mesh)
+    if extra_findings_fn is not None:
+        # Program-owner-specific detectors (e.g. the serving engine's
+        # replicated-KV-pool check) — run on whatever executable is being
+        # audited, fresh compile or deserialized cache hit.
+        try:
+            findings += list(extra_findings_fn(compiled, mesh) or [])
+        except Exception as e:  # pragma: no cover - defensive
+            logger.warning("[xray] %s: extra findings pass failed: %s",
+                           name, e)
     flops = bytes_accessed = None
     try:
         from smdistributed_modelparallel_tpu.utils.profiling import cost_of
@@ -903,7 +989,7 @@ def audit_compiled(name, compiled, key=None, params=None,
 
 
 def maybe_audit(name, compiled, key=None, params=None,
-                expected_param_shardings=None):
+                expected_param_shardings=None, extra_findings_fn=None):
     """Post-compile hook from the step engine. ``SMP_HLO_AUDIT=off`` is a
     hard no-op (returns before touching the executable); failures are
     logged, never raised into the step path."""
@@ -914,6 +1000,7 @@ def maybe_audit(name, compiled, key=None, params=None,
         audit = audit_compiled(
             name, compiled, key=key, params=params,
             expected_param_shardings=expected_param_shardings,
+            extra_findings_fn=extra_findings_fn,
         )
     except Exception as e:  # pragma: no cover - defensive
         logger.warning("[xray] hlo audit of %s failed: %s", name, e)

@@ -848,6 +848,89 @@ def record_zero3_xray(name, zero_block):
             ).set(float(val))
 
 
+def record_serve_request(event, n=1):
+    """One serving-request lifecycle event (serving/engine.py):
+    ``admitted`` / ``finished`` / ``readmitted`` (failover re-admission of
+    a dead replica's in-flight request) / ``deadline_miss``."""
+    telemetry.counter(
+        "smp_serve_requests_total", "serving requests by lifecycle event"
+    ).labels(event=event).inc(n)
+
+
+def record_serve_tokens(kind, n):
+    """Serving token throughput counter: ``kind`` is prompt (prefilled)
+    or generated (sampled)."""
+    if n:
+        telemetry.counter(
+            "smp_serve_tokens_total", "serving tokens by kind"
+        ).labels(kind=kind).inc(int(n))
+
+
+def record_serve_slo(ttft_s=None, itl_s=None, ttft_mean_s=None,
+                     itl_mean_s=None, requests_per_sec=None,
+                     tokens_per_sec=None, tokens_per_sec_chip=None):
+    """Serving SLO gauges — time-to-first-token and inter-token latency
+    (last + running mean), plus throughput (engine-wide and per local
+    chip). Updated by the engine as requests produce tokens/finish."""
+    g_ttft = telemetry.gauge(
+        "smp_serve_ttft_seconds",
+        "time to first token (arrival -> first sampled token)",
+    )
+    if ttft_s is not None:
+        g_ttft.labels(stat="last").set(float(ttft_s))
+    if ttft_mean_s is not None:
+        g_ttft.labels(stat="mean").set(float(ttft_mean_s))
+    g_itl = telemetry.gauge(
+        "smp_serve_itl_seconds", "inter-token latency of decode streams"
+    )
+    if itl_s is not None:
+        g_itl.labels(stat="last").set(float(itl_s))
+    if itl_mean_s is not None:
+        g_itl.labels(stat="mean").set(float(itl_mean_s))
+    if requests_per_sec is not None:
+        telemetry.gauge(
+            "smp_serve_requests_per_sec", "completed requests per second"
+        ).set(float(requests_per_sec))
+    if tokens_per_sec is not None:
+        telemetry.gauge(
+            "smp_serve_tokens_per_sec", "generated tokens per second"
+        ).labels(scope="engine").set(float(tokens_per_sec))
+    if tokens_per_sec_chip is not None:
+        telemetry.gauge(
+            "smp_serve_tokens_per_sec", "generated tokens per second"
+        ).labels(scope="chip").set(float(tokens_per_sec_chip))
+
+
+def record_serve_occupancy(queue_depth, active_slots, total_slots,
+                           kv_used, kv_free, kv_reserved, kv_total):
+    """Continuous-batching occupancy gauges: request queue depth, decode
+    slots in use, and KV-pool block accounting (used / free / promised-
+    but-unallocated reservations / total)."""
+    telemetry.gauge(
+        "smp_serve_queue_depth", "requests waiting for a decode slot"
+    ).set(int(queue_depth))
+    g_slots = telemetry.gauge(
+        "smp_serve_slots", "decode slots by state"
+    )
+    g_slots.labels(state="active").set(int(active_slots))
+    g_slots.labels(state="total").set(int(total_slots))
+    g_kv = telemetry.gauge(
+        "smp_serve_kv_blocks", "paged KV-pool blocks by state"
+    )
+    g_kv.labels(state="used").set(int(kv_used))
+    g_kv.labels(state="free").set(int(kv_free))
+    g_kv.labels(state="reserved").set(int(kv_reserved))
+    g_kv.labels(state="total").set(int(kv_total))
+
+
+def record_serve_programs(n):
+    telemetry.gauge(
+        "smp_serve_programs",
+        "compiled serving programs (the engine holds exactly two: "
+        "prefill-chunk and decode-step)",
+    ).set(int(n))
+
+
 def _atexit_dump():  # pragma: no cover - exercised via subprocess test
     try:
         # An empty registry must not clobber the dump smp.shutdown already

@@ -176,6 +176,12 @@ _SLOW_TESTS = (
     # pay 2-3 extra pipeline compiles.
     "test_recompute.py::TestStashParity",
     "test_recompute.py::TestAutoDegradation",
+    # Serving heavy extra-compile cases: the composite end-to-end (one
+    # engine, every behavioral claim) and the tp2 golden gate stay fast
+    # in test_serving.py; the neutered-constraint detector e2e and the
+    # exec-cache warm start each pay 2+ extra serving-program compiles.
+    "test_serving.py::TestServingXray::test_detector_fires_on_replicated_pool",
+    "test_serving.py::TestExecCacheWarmStart",
 )
 
 

@@ -54,11 +54,23 @@ CONFIGS = {
     # gate): ZB-H1 with the W pass consuming stashed vjp residuals — the
     # fingerprint carries the `recompute` block (plan decisions + ring
     # sizes) and a remat fraction far below the `full` golden's 0.79.
-    # LAST in this dict: cache keys embed the per-process init
-    # generation, so appending keeps every earlier golden byte-stable.
+    # LAST among the train-step configs: cache keys embed the per-process
+    # init generation, so appending keeps every earlier golden
+    # byte-stable.
     "zero_bubble_stash_weight_pp2_mb4": {
         "pipeline_parallel_degree": 2, "microbatches": 4, "ddp": True,
         "pipeline": "zero_bubble", "recompute": "stash_weight",
+    },
+}
+
+# Serving programs (tests/test_serving.py gate): the engine's decode-step
+# program at tp=2 — the census carries the tp collectives of the paged
+# attention and the replicated-KV-pool detector must report zero
+# findings (the pool shards over tp on the head axis). Built through the
+# engine itself, not a train step, so it rides after the train configs.
+SERVING_CONFIGS = {
+    "serving_decode_tp2": {
+        "tensor_parallel_degree": 2, "ddp": True,
     },
 }
 
@@ -98,6 +110,35 @@ def fingerprint_of(cfg):
     return audit.as_dict()
 
 
+def serving_fingerprint_of(cfg):
+    """Compile the serving engine's decode-step program under ``cfg``
+    (the exact geometry tests/test_serving.py's golden gate uses) and
+    return its audit fingerprint."""
+    import jax
+
+    import smdistributed_modelparallel_tpu as smp
+    from smdistributed_modelparallel_tpu.models.transformer_lm import (
+        TransformerLM,
+    )
+
+    smp.reset()
+    smp.init(cfg)
+    mod = TransformerLM(
+        vocab_size=64, max_len=32, d_model=32, n_layers=2, n_heads=4,
+    )
+    ids = jax.random.randint(jax.random.key(1), (1, 6), 0, 64)
+    params = mod.init(jax.random.key(0), ids)["params"]
+    engine = smp.serving.ServingEngine(
+        mod, params=params, max_slots=2, block_tokens_override=4,
+        prefill_chunk=4,
+    )
+    engine._program("decode")
+    audit = engine.audits["decode"]
+    if audit is None:
+        raise RuntimeError("serving decode audit unavailable")
+    return audit.as_dict()
+
+
 def main():
     jax_cfg = None
     import jax
@@ -111,6 +152,11 @@ def main():
         fp = fingerprint_of(cfg)
         # The golden id, not the step name, keys diffs of this file (all
         # three programs share the step name "step_pipeline_1f1b").
+        fp["name"] = name
+        programs[name] = fp
+    for name, cfg in SERVING_CONFIGS.items():
+        sys.stderr.write(f"compiling {name} ...\n")
+        fp = serving_fingerprint_of(cfg)
         fp["name"] = name
         programs[name] = fp
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),

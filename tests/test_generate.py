@@ -899,6 +899,92 @@ class TestHFGreedyParity:
         np.testing.assert_array_equal(got, want)
 
 
+class TestDecodeLengthBuckets:
+    """ISSUE 14 satellite: SMP_SHAPE_BUCKETS "seq" sizes bucket
+    (prompt-len, max-new-tokens) so ragged serving-style prompts reuse
+    one cached program instead of churning the _COMPILED LRU."""
+
+    @staticmethod
+    def _head():
+        return DistributedTransformerLMHead(
+            num_layers=2, num_attention_heads=4, attention_head_size=8,
+            hidden_size=32, intermediate_size=64, vocab_size=97,
+            num_positions=64, causal_mask_size=64,
+            attention_dropout_prob=0.0, hidden_dropout_prob=0.0,
+            embedding_dropout_prob=0.0, deterministic=True,
+        )
+
+    def test_ragged_prompts_share_one_program(self, monkeypatch):
+        from smdistributed_modelparallel_tpu.generation import _COMPILED
+
+        smp.init({})
+        mod = self._head()
+        ids5 = jax.random.randint(jax.random.key(60), (2, 5), 1, 97)
+        ids7 = jax.random.randint(jax.random.key(61), (2, 7), 1, 97)
+        params = mod.init(jax.random.key(0), ids5)["params"]
+        ref5 = np.asarray(smp.generate(mod, ids5, 3, params=params))
+        ref7 = np.asarray(smp.generate(mod, ids7, 5, params=params))
+
+        monkeypatch.setenv("SMP_SHAPE_BUCKETS", "seq:8,16")
+        got5 = np.asarray(smp.generate(mod, ids5, 3, params=params))
+        entries_after_first = len(_COMPILED)
+        got7 = np.asarray(smp.generate(mod, ids7, 5, params=params))
+        # Both (5, +3) and (7, +5) land in the (8, +8) bucket: the second
+        # call HITS the first call's compiled entry.
+        assert len(_COMPILED) == entries_after_first
+        # Bucketing is output-invariant (greedy): callers see exactly the
+        # (prompt, max_new) they asked for.
+        np.testing.assert_array_equal(got5, ref5)
+        np.testing.assert_array_equal(got7, ref7)
+
+    def test_zoo_family_buckets_decode_length_only(self, monkeypatch):
+        # No attention_mask support: the prompt stays exact, only
+        # max_new_tokens rounds up (and the extra steps are sliced off).
+        smp.init({})
+        mod = _zoo("rotary")
+        ids = jax.random.randint(jax.random.key(62), (2, 5), 0, 97)
+        params = mod.init(jax.random.key(0), ids)["params"]
+        ref = np.asarray(smp.generate(mod, ids, 3, params=params))
+        monkeypatch.setenv("SMP_SHAPE_BUCKETS", "seq:8,16")
+        got = np.asarray(smp.generate(mod, ids, 3, params=params))
+        np.testing.assert_array_equal(got, ref)
+        assert got.shape == (2, 8)
+
+    def test_eos_rows_and_overflow(self, monkeypatch):
+        smp.init({})
+        mod = self._head()
+        ids = jax.random.randint(jax.random.key(63), (2, 6), 1, 97)
+        params = mod.init(jax.random.key(0), ids)["params"]
+        probe = np.asarray(smp.generate(mod, ids, 4, params=params))
+        eos = int(probe[0, 6])
+        ref = np.asarray(smp.generate(mod, ids, 4, params=params,
+                                      eos_token_id=eos, pad_token_id=0))
+        ref_big = np.asarray(smp.generate(mod, ids, 12, params=params))
+        monkeypatch.setenv("SMP_SHAPE_BUCKETS", "seq:8")
+        # EOS-frozen rows emit pad through the bucketed extra steps —
+        # sliced off, identical output.
+        got = np.asarray(smp.generate(mod, ids, 4, params=params,
+                                      eos_token_id=eos, pad_token_id=0))
+        np.testing.assert_array_equal(got, ref)
+        # max_new beyond every bucket: decode length compiles exact,
+        # identical output.
+        got_big = np.asarray(smp.generate(mod, ids, 12, params=params))
+        np.testing.assert_array_equal(got_big, ref_big)
+
+    def test_bucket_never_exceeds_position_limit(self, monkeypatch):
+        # (6, +9) fits a 16-position model exactly; both bucket
+        # components would push past the limit and must be skipped.
+        smp.init({})
+        mod = _zoo("rotary", max_len=16)
+        ids = jax.random.randint(jax.random.key(64), (1, 6), 0, 97)
+        params = mod.init(jax.random.key(0), ids)["params"]
+        ref = np.asarray(smp.generate(mod, ids, 9, params=params))
+        monkeypatch.setenv("SMP_SHAPE_BUCKETS", "seq:8,16")
+        got = np.asarray(smp.generate(mod, ids, 9, params=params))
+        np.testing.assert_array_equal(got, ref)
+        assert got.shape == (1, 15)
+
+
 class TestHalfPrecision:
     def test_bf16_config_casts_decode_params(self):
         """Under a bf16 config, generation runs the half-cast forward
