@@ -47,6 +47,12 @@ def _model_flops_per_step(n_layers, d_model, vocab, batch, seq):
     return 3 * (n_layers * (per_layer + attn) + head)
 
 
+def _median(xs):
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
 def _readback(x):
     import numpy as np
 
@@ -403,12 +409,7 @@ def _pipeline_interleave_probe(deadline):
             break
     smp.reset()
 
-    def median(xs):
-        s = sorted(xs)
-        n = len(s)
-        return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
-
-    med = {name: median(ts) for name, ts in times.items()}
+    med = {name: _median(ts) for name, ts in times.items()}
     best = min(med, key=med.get)
     result = {
         "component": "pipeline_schedule",
@@ -564,12 +565,7 @@ def _zero_probe(deadline):
             break
     smp.reset()
 
-    def median(xs):
-        s = sorted(xs)
-        n = len(s)
-        return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
-
-    med = {name: median(ts) for name, ts in times.items()}
+    med = {name: _median(ts) for name, ts in times.items()}
     result = {
         "component": "zero_probe",
         "rdp": rdp,
@@ -579,6 +575,159 @@ def _zero_probe(deadline):
         "memory": memory,
         "zero": zero_block,
         "blocks": len(times["zero3"]),
+        "on_tpu": on_tpu,
+    }
+    sys.stderr.write(json.dumps(result) + "\n")
+    sys.stderr.flush()
+    return result
+
+
+def _tp_probe(deadline):
+    """SMP_BENCH_TP_PROBE=1: overlapped-tensor-parallelism A/B at tp=2 —
+    GSPMD (tp_overlap off) vs the ring decomposition vs ring + fused
+    kernels (Pallas fused QKV + bias-GELU), on the smp.nn transformer
+    family the ring lives in.
+
+    Same interleaved-blocks methodology as the pipeline/zero probes
+    (each block re-inits — the knob changes the compiled program — and
+    pays its compile in warmup, outside the timed region). Emits one
+    stderr JSON line {"component": "tp_overlap", off_ms, ring_ms,
+    ring_fused_ms, speedup_ring, ...} plus the ring leg's X-ray
+    ``tp_overlap`` block, and returns the dict for the stdout result
+    block. The pass criterion is a TPU criterion recorded in
+    BENCH_NOTES.md Round 15 — the CPU smoke serializes the ring's
+    ppermute hops (no async collectives on XLA:CPU), so ring legs READ
+    SLOWER there and the number only proves the plumbing, exactly like
+    the zero3 probe. Never fails the bench.
+    """
+    import jax
+
+    if len(jax.devices()) < 2:
+        sys.stderr.write(
+            "bench: skipping tp probe (needs >= 2 devices for tp=2).\n")
+        return None
+    if deadline - time.time() < 180:
+        sys.stderr.write(
+            f"bench: skipping tp probe ({deadline - time.time():.0f}s "
+            "left in window < 180s floor).\n")
+        return None
+    import jax.numpy as jnp
+    import optax
+
+    import smdistributed_modelparallel_tpu as smp
+    from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+        vocab_parallel_cross_entropy,
+    )
+    from smdistributed_modelparallel_tpu.nn.transformer import (
+        DistributedTransformerLMHead,
+    )
+    from smdistributed_modelparallel_tpu.utils import hlo_audit
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    n_layers, d_model, n_heads, hd, ff, seq, vocab = (
+        (8, 1024, 16, 64, 4096, 1024, 32000) if on_tpu
+        else (2, 32, 4, 8, 64, 16, 96)
+    )
+    batch = 8
+    iters = 10 if on_tpu else 3
+
+    def build(extra, fused_model=False):
+        smp.reset()
+        cfg = {"microbatches": 2, "ddp": True,
+               "tensor_parallel_degree": 2, "bf16": bool(on_tpu)}
+        cfg.update(extra)
+        smp.init(cfg)
+        model = smp.DistributedModel(DistributedTransformerLMHead(
+            num_layers=n_layers, num_attention_heads=n_heads,
+            attention_head_size=hd, hidden_size=d_model,
+            intermediate_size=ff, vocab_size=vocab, num_positions=seq,
+            causal_mask_size=seq, pre_layernorm=True,
+            post_layernorm=False, final_layernorm=True,
+            attention_dropout_prob=0.0, hidden_dropout_prob=0.0,
+            embedding_dropout_prob=0.0, fused_bias_gelu=fused_model,
+        ))
+        optimizer = smp.DistributedOptimizer(optax.sgd(1e-3), model)
+        ids = jax.random.randint(jax.random.key(0), (batch, seq), 0, vocab)
+
+        @smp.step
+        def train_step(model, b):
+            logits = model(b)
+            loss = jnp.mean(
+                vocab_parallel_cross_entropy(logits[:, :-1], b[:, 1:])
+            )
+            model.backward(loss)
+            return loss
+
+        return model, optimizer, train_step, ids
+
+    variants = (
+        ("off", {}, False),
+        ("ring", {"tp_overlap": "ring"}, False),
+        ("ring_fused", {"tp_overlap": "ring", "fused_qkv": True}, True),
+    )
+    times = {name: [] for name, _, _ in variants}
+    tp_block = None
+
+    def _pallas_qkv_dispatches():
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            telemetry,
+        )
+
+        fam = telemetry.report()["metrics"].get(
+            "smp_fused_kernel_dispatch_total"
+        )
+        return sum(
+            s["value"] for s in (fam["series"] if fam else ())
+            if s["labels"].get("kernel") == "qkv"
+            and s["labels"].get("path") == "pallas"
+        )
+
+    # Measured, not assumed: did the ring_fused leg's trace actually
+    # dispatch the Pallas QKV kernel? (It won't off-TPU, or when
+    # use_pallas_kernels is disabled, or when no VMEM tile fits.)
+    fused_engaged = False
+    for _round in range(3):
+        for name, extra, fused_model in variants:
+            model, optimizer, train_step, ids = build(
+                extra, fused_model=fused_model
+            )
+            out = None
+            d0 = _pallas_qkv_dispatches() if fused_model else 0
+            for _ in range(2):     # warmup: compile + first dispatch
+                out = train_step(model, ids)
+                optimizer.step()
+            _readback(out.reduce_mean())
+            if fused_model and _pallas_qkv_dispatches() > d0:
+                fused_engaged = True
+            if name == "ring" and tp_block is None:
+                audit = hlo_audit.of_step_function(train_step)
+                if audit is not None:
+                    tp_block = audit.tp_overlap
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = train_step(model, ids)
+                optimizer.step()
+            _readback(out.reduce_mean())
+            times[name].append((time.perf_counter() - t0) / iters)
+        if time.time() > deadline:
+            sys.stderr.write(
+                "bench: tp probe hit the window deadline; using the "
+                f"{len(times['ring'])} block round(s) measured so far.\n")
+            break
+    smp.reset()
+
+    med = {name: _median(ts) for name, ts in times.items()}
+    result = {
+        "component": "tp_overlap",
+        "tp": 2,
+        "off_ms": round(med["off"] * 1e3, 3),
+        "ring_ms": round(med["ring"] * 1e3, 3),
+        "ring_fused_ms": round(med["ring_fused"] * 1e3, 3),
+        "speedup_ring": round(med["off"] / med["ring"], 4),
+        "speedup_fused": round(med["off"] / med["ring_fused"], 4),
+        "tp_overlap": tp_block,
+        "fused_engaged": fused_engaged,
+        "blocks": len(times["ring"]),
         "on_tpu": on_tpu,
     }
     sys.stderr.write(json.dumps(result) + "\n")
@@ -871,8 +1020,10 @@ def main():
         sys.stderr.flush()
         os.environ["JAX_PLATFORMS"] = "cpu"
         if (os.environ.get("SMP_BENCH_PIPELINE_PROBE", "0") == "1"
-                or os.environ.get("SMP_BENCH_ZERO_PROBE", "0") == "1"):
-            # The pp=2 / rdp A/B probes need a multi-device mesh; provision
+                or os.environ.get("SMP_BENCH_ZERO_PROBE", "0") == "1"
+                or os.environ.get("SMP_BENCH_TP_PROBE", "0") == "1"):
+            # The pp=2 / rdp / tp=2 A/B probes need a multi-device mesh;
+            # provision
             # virtual CPU devices BEFORE the first jax import (the main
             # smoke numbers are single-core either way).
             flags = os.environ.get("XLA_FLAGS", "")
@@ -1181,6 +1332,13 @@ def main():
         # afterwards.
         zero_probe_out = _zero_probe(deadline=start_time + probe_window)
 
+    tp_probe_out = None
+    if os.environ.get("SMP_BENCH_TP_PROBE", "0") == "1":
+        # Re-inits the framework per block (tp_overlap changes the
+        # compiled program); the headline model/step must not be reused
+        # afterwards.
+        tp_probe_out = _tp_probe(deadline=start_time + probe_window)
+
     exec_cache_out = None
     if os.environ.get("SMP_BENCH_COMPILE_PROBE", "0") == "1":
         # Also re-inits the framework; anything after this point must not
@@ -1226,6 +1384,8 @@ def main():
         result["serving"] = serving_out
     if zero_probe_out is not None:
         result["zero_probe"] = zero_probe_out
+    if tp_probe_out is not None:
+        result["tp_overlap"] = tp_probe_out
     if pipeline_probe_out is not None:
         result["pipeline_probe"] = pipeline_probe_out
     print(json.dumps(result))

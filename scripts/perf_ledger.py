@@ -43,6 +43,13 @@ CI stays unflaky):
   warm compile A/B through the persistent executable cache) is
   schema-checked when present (numeric ``cold_s``/``warm_s``/``speedup``,
   internally consistent) and rendered per round;
+- the ``zero_probe`` / ``pipeline_probe`` / ``serving`` / ``tp_overlap``
+  blocks (the other bench probe A/Bs, SMP_BENCH_ZERO_PROBE /
+  SMP_BENCH_PIPELINE_PROBE / SMP_BENCH_SERVE_PROBE /
+  SMP_BENCH_TP_PROBE — for ``tp_overlap``,
+  GSPMD vs the ring decomposition vs ring + fused Pallas kernels at
+  tp=2) are schema-checked when present (numeric timings, speedups
+  internally consistent) and rendered per round;
 - the ``hlo_audit`` block (bench.py >= round 9: the headline program's
   X-ray summary — fingerprint, collective ops/bytes by kind, remat
   fraction, replicated bytes) is schema-checked when present, and
@@ -207,6 +214,36 @@ def _zero_probe_schema_problem(probe):
     return None
 
 
+def _tp_probe_schema_problem(probe):
+    """Why a round's ``tp_overlap`` block (bench.py SMP_BENCH_TP_PROBE
+    GSPMD-vs-ring-vs-ring+fusions A/B at tp=2) is malformed, or None.
+    Absent blocks are fine — rounds predating overlapped tp, or probe
+    not requested."""
+    if probe is None:
+        return None
+    if not isinstance(probe, dict):
+        return f"'tp_overlap' must be an object, got {type(probe).__name__}"
+    if probe.get("component") != "tp_overlap":
+        return "'tp_overlap.component' must be the string 'tp_overlap'"
+    for key in ("off_ms", "ring_ms", "ring_fused_ms", "speedup_ring",
+                "speedup_fused"):
+        if not isinstance(probe.get(key), (int, float)):
+            return f"'tp_overlap' lacks a numeric '{key}'"
+    if probe["ring_ms"] > 0 and abs(
+        probe["speedup_ring"] - probe["off_ms"] / probe["ring_ms"]
+    ) > max(0.05 * probe["speedup_ring"], 0.05):
+        return "'tp_overlap.speedup_ring' inconsistent with off_ms/ring_ms"
+    if probe["ring_fused_ms"] > 0 and abs(
+        probe["speedup_fused"] - probe["off_ms"] / probe["ring_fused_ms"]
+    ) > max(0.05 * probe["speedup_fused"], 0.05):
+        return ("'tp_overlap.speedup_fused' inconsistent with "
+                "off_ms/ring_fused_ms")
+    xray = probe.get("tp_overlap")
+    if xray is not None and not isinstance(xray, dict):
+        return "'tp_overlap.tp_overlap' (X-ray block) must be an object"
+    return None
+
+
 def _pipeline_probe_schema_problem(probe):
     """Why a round's ``pipeline_probe`` block (bench.py
     SMP_BENCH_PIPELINE_PROBE 3-way schedule A/B) is malformed, or None.
@@ -315,6 +352,7 @@ def build_ledger(repo, threshold=0.05):
             "hlo_audit": None,
             "exec_cache": None,
             "zero_probe": None,
+            "tp_overlap": None,
             "pipeline_probe": None,
             "serving": None,
             "documented": n in documented,
@@ -354,6 +392,12 @@ def build_ledger(repo, threshold=0.05):
                     problems.append(f"{name}: {zprobe_problem}")
                     zprobe = None
                 row["zero_probe"] = zprobe
+                tprobe = parsed.get("tp_overlap")
+                tprobe_problem = _tp_probe_schema_problem(tprobe)
+                if tprobe_problem:
+                    problems.append(f"{name}: {tprobe_problem}")
+                    tprobe = None
+                row["tp_overlap"] = tprobe
                 pprobe = parsed.get("pipeline_probe")
                 pprobe_problem = _pipeline_probe_schema_problem(pprobe)
                 if pprobe_problem:
@@ -539,6 +583,24 @@ def render_table(ledger, out=sys.stdout):
             if z.get("overlap_fraction") is not None:
                 parts.append(f"overlap {100 * z['overlap_fraction']:.0f}%")
             w(f"{'':>7}zero_probe: " + "  ".join(parts) + "\n")
+        tprobe = r.get("tp_overlap")
+        if isinstance(tprobe, dict):
+            parts = [
+                f"off {tprobe['off_ms']:.1f}ms",
+                f"ring {tprobe['ring_ms']:.1f}ms",
+                f"ring+fused {tprobe['ring_fused_ms']:.1f}ms",
+                f"speedup {tprobe['speedup_ring']:.2f}x"
+                f"/{tprobe['speedup_fused']:.2f}x",
+            ]
+            xray = tprobe.get("tp_overlap") or {}
+            if xray.get("overlap_evidence") is not None:
+                parts.append(
+                    "overlap proven" if xray["overlap_evidence"]
+                    else "!! overlap NOT proven"
+                )
+            if xray.get("ring_permute_ops"):
+                parts.append(f"{xray['ring_permute_ops']} ring hop(s)")
+            w(f"{'':>7}tp_overlap: " + "  ".join(parts) + "\n")
     if ledger["best_on_chip"]:
         b = ledger["best_on_chip"]
         w(f"\nbest on-chip:   round {b['round']}  vs_baseline "

@@ -396,6 +396,57 @@ def render(report, out=sys.stdout):
                              "gather(s)")
                 w(line + "\n")
 
+    # -- tp overlap (ring-decomposed collective matmuls;
+    # ops/collective_matmul.py + the X-ray's tp_overlap_report) ----------
+    # smp_tp_overlap_* gauges: the decomposed ring-hop census attributed
+    # to the tp axis, the parked-hop double-buffering evidence, residual
+    # synchronous tp collectives, plus the fused-kernel dispatch
+    # counters (smp_fused_kernel_dispatch_total). Rendered identically
+    # for one dump and the cross-rank aggregate.
+    tp_names = sorted({
+        s["labels"].get("step", "?")
+        for s in _series(report, "smp_tp_overlap_ring_permute_ops")
+    })
+    fused_series = _series(report, "smp_fused_kernel_dispatch_total")
+    if tp_names or fused_series:
+        w("\n-- tp overlap --\n")
+        for name in tp_names:
+            hops = _value(report, "smp_tp_overlap_ring_permute_ops",
+                          step=name)
+            hop_bytes = _value(report, "smp_tp_overlap_ring_permute_bytes",
+                               step=name)
+            parked = _value(report, "smp_tp_overlap_parked_hops", step=name)
+            w(f"{name}:\n")
+            w(f"  ring hops: {int(hops or 0)} tp collective-permute(s), "
+              f"{_fmt_bytes(hop_bytes)}/device overlapped"
+              f"; {int(parked or 0)} parked in loop carries "
+              "(double-buffered)\n")
+            ag = _value(report, "smp_tp_overlap_tp_allgather_ops", step=name)
+            rs = _value(report, "smp_tp_overlap_tp_reduce_scatter_ops",
+                        step=name)
+            ar = _value(report, "smp_tp_overlap_tp_allreduce_ops", step=name)
+            w(f"  residual synchronous tp collectives: "
+              f"{int(ag or 0)} all-gather(s), {int(rs or 0)} "
+              f"reduce-scatter(s), {int(ar or 0)} all-reduce(s)\n")
+            ev = _value(report, "smp_tp_overlap_evidence", step=name)
+            if ev is not None:
+                w("  overlap evidence: "
+                  + ("PROVEN (hops feed only data movement into the next "
+                     "partial matmul)" if ev else "NOT PROVEN")
+                  + "\n")
+        if fused_series:
+            counts = {}
+            for s in fused_series:
+                key = (s["labels"].get("kernel", "?"),
+                       s["labels"].get("path", "?"))
+                counts[key] = counts.get(key, 0) + s["value"]
+            parts = [
+                f"{kernel}/{path} {int(v)}"
+                for (kernel, path), v in sorted(counts.items())
+            ]
+            w("  fused-kernel dispatch decisions: " + "  ".join(parts)
+              + "\n")
+
     # -- serving (smp.serving continuous-batching engine) ---------------
     # SLO gauges (TTFT / ITL last+mean, throughput), occupancy (queue
     # depth, decode slots, paged KV-pool blocks), and request lifecycle

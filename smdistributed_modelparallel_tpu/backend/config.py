@@ -144,6 +144,21 @@ class ModelParallelConfig:
                     "integer"
                 )
 
+        # Environment alias for overlapped tensor parallelism
+        # (SMP_TP_OVERLAP), same precedence rule: explicit config wins.
+        env_tp_overlap = os.environ.get("SMP_TP_OVERLAP")
+        if env_tp_overlap is not None and "tp_overlap" not in user_config:
+            val = env_tp_overlap.strip().lower()
+            if val in ("ring",):
+                user_config["tp_overlap"] = "ring"
+            elif val in ("0", "off", "false", "none"):
+                user_config["tp_overlap"] = "off"
+            else:
+                raise ConfigError(
+                    f"SMP_TP_OVERLAP={env_tp_overlap!r}: expected "
+                    "ring or 0/off/false/none"
+                )
+
         # Resolve aliases (e.g. partitions -> pipeline_parallel_degree).
         alias_map = {
             spec["alias"]: key for key, spec in SCHEMA.items() if "alias" in spec

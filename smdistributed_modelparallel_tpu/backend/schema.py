@@ -491,6 +491,35 @@ SCHEMA = {
         "4) above which fused_ce: auto switches to the no-materialize "
         "Pallas CE kernel.",
     },
+    "tp_overlap": {
+        "type": str,
+        "default": "off",
+        "options": ["off", "ring"],
+        "description": "TPU extension: overlapped tensor parallelism "
+        "(env alias SMP_TP_OVERLAP). 'off' (default): the GSPMD path — "
+        "synchronous tp all-gather/reduce-scatter/all-reduce around the "
+        "tp matmuls, byte-identical programs to older builds. 'ring': "
+        "the column-parallel input all-gather and row-parallel output "
+        "reduce-scatter of the tp attention/MLP blocks decompose into "
+        "tp-many ppermute hops, each hidden under the partial matmul on "
+        "the block already in hand (ops/collective_matmul.py; "
+        "double-buffered, custom_vjp mirrored backward ring). Implies "
+        "the sequence-parallel (optimize: memory) residual layout over "
+        "tp. Inert at tensor_parallel_degree 1; does not compose with "
+        "context_parallel_degree > 1 (the ring owns the sequence axis).",
+    },
+    "fused_qkv": {
+        "type": bool,
+        "default": False,
+        "description": "TPU extension: dispatch the attention QKV "
+        "projection to the Pallas fused matmul+bias kernel "
+        "(ops/pallas_qkv.py) — one kernel against the concatenated, "
+        "tp-sharded [in, 3*head] weight, bias folded into the epilogue. "
+        "Engages at tensor_parallel_degree 1 directly, and under "
+        "tp_overlap: ring inside the ring's partial matmuls; the "
+        "GSPMD tp path keeps the einsum (the sharded kernel cannot "
+        "enter a plain pallas_call without a gather).",
+    },
     "recompute": {
         "type": str,
         "default": "full",

@@ -11,6 +11,21 @@ reference codes as ``ScatterAndMergeForTP``/``ReduceScatterForTP``
 partition) is provided for building block use; the reference expresses the
 same two layouts as ``initialize_with_input_partition`` /
 ``initialize_with_output_partition`` (``torch/nn/utils.py:155-249``).
+
+Resharding audit (PR 15): back-to-back tp pairs (column -> row, the
+Megatron block shape) were X-ray-probed on this GSPMD path for redundant
+collectives from ``shard_activation`` re-constraining already-sharded
+activations. The census shows the constraints are free — a matched pair
+compiles to exactly its tp all-reduces, ZERO tp all-gathers (XLA elides
+a ``sharding_constraint`` whose operand already carries the sharding) —
+so no constraint-skipping special case is warranted;
+``tests/test_tp_overlap.py::TestGspmdReshardPin`` pins that census.
+
+``tp_overlap: "ring"`` (ops/collective_matmul.py) replaces the
+GSPMD-inserted synchronous collectives of both layouts with ring
+decompositions whose ppermute hops hide under partial matmuls; the
+layers below dispatch there when the knob and geometry allow and keep
+this GSPMD path byte-identical otherwise.
 """
 
 from typing import Optional
@@ -23,6 +38,7 @@ from smdistributed_modelparallel_tpu.nn.utils import (
     dense_init,
     partitioned,
     shard_activation,
+    tp_ring_active as _ring_active,
 )
 
 
@@ -53,12 +69,31 @@ class DistributedLinear(nn.Module):
             (in_features, self.features),
             self.dtype or x.dtype,
         )
-        # Input features sharded over tp: each rank computes a partial
-        # matmul; XLA reduces. (Reference: scatter_and_merge input then
-        # local matmul, torch/nn/linear.py:40-57.)
-        x = shard_activation(x, *([None] * (x.ndim - 1) + [TP_AXIS]))
-        y = x @ kernel.astype(x.dtype)
-        y = shard_activation(y, *([None] * y.ndim))
+        y = None
+        if x.ndim >= 2 and _ring_active():
+            # Overlapped tp (tp_overlap: ring): the row-parallel output
+            # reduce lowers to an accumulator ppermute ring instead of
+            # the GSPMD all-reduce, and the output stays ROW-sharded
+            # over tp on dim -2 (the Megatron-SP sequence-parallel
+            # contract — a consuming ColumnParallelLinear's ring
+            # regathers it hop by hop). The logical value is identical;
+            # only the layout differs.
+            from smdistributed_modelparallel_tpu.ops.collective_matmul import (  # noqa: E501
+                ring_rs_matmul,
+            )
+
+            y = ring_rs_matmul(x, kernel.astype(x.dtype), n_contract=1)
+            if y is not None:
+                y = shard_activation(
+                    y, *([None] * (y.ndim - 2) + [TP_AXIS, None])
+                )
+        if y is None:
+            # Input features sharded over tp: each rank computes a partial
+            # matmul; XLA reduces. (Reference: scatter_and_merge input then
+            # local matmul, torch/nn/linear.py:40-57.)
+            x = shard_activation(x, *([None] * (x.ndim - 1) + [TP_AXIS]))
+            y = x @ kernel.astype(x.dtype)
+            y = shard_activation(y, *([None] * y.ndim))
         if self.use_bias:
             bias = self.param(
                 "bias", nn.initializers.zeros, (self.features,), self.dtype or x.dtype
@@ -93,7 +128,7 @@ class ColumnParallelLinear(nn.Module):
             (in_features, self.features),
             self.dtype or x.dtype,
         )
-        y = x @ kernel.astype(x.dtype)
+        bias = None
         if self.use_bias:
             bias = self.param(
                 "bias",
@@ -101,6 +136,26 @@ class ColumnParallelLinear(nn.Module):
                 (self.features,),
                 self.dtype or x.dtype,
             )
+        if x.ndim >= 2 and _ring_active():
+            # Overlapped tp: the input arrives row-sharded over tp on
+            # dim -2 (a preceding ring RowParallelLinear's layout, or a
+            # free replicated->sharded slice) and regathers hop by hop
+            # under the partial matmuls; bias folds into the chunks.
+            from smdistributed_modelparallel_tpu.ops.collective_matmul import (  # noqa: E501
+                ring_ag_matmul,
+            )
+
+            y = ring_ag_matmul(
+                x, kernel.astype(x.dtype),
+                bias.astype(x.dtype) if bias is not None else None,
+                w_tp_dim=1,
+            )
+            if y is not None:
+                return shard_activation(
+                    y, *([None] * (y.ndim - 1) + [TP_AXIS])
+                )
+        y = x @ kernel.astype(x.dtype)
+        if bias is not None:
             y = y + bias.astype(y.dtype)
         return shard_activation(y, *([None] * (y.ndim - 1) + [TP_AXIS]))
 

@@ -50,6 +50,7 @@ from smdistributed_modelparallel_tpu.nn.utils import (
     partitioned,
     resolve_deterministic,
     shard_activation,
+    tp_ring_active as _ring_active,
 )
 from smdistributed_modelparallel_tpu.ops.attention import attention_core
 from smdistributed_modelparallel_tpu.parallel.pipeline import PipelineSpec
@@ -84,6 +85,12 @@ def _seq_axes(memory_opt):
 
 def _hidden_spec(memory_opt):
     return (BATCH_AXES, _seq_axes(memory_opt), None)
+
+
+def _seq_parallel(memory_opt):
+    """The residual stream is sequence-sharded over tp: explicitly via
+    optimize='memory', or implicitly by the overlapped-tp ring."""
+    return memory_opt or _ring_active()
 
 
 def _init(range_, use_normal=True):
@@ -169,6 +176,41 @@ class DistributedAttentionLayer(nn.Module):
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
+    @nn.nowrap
+    def _fused_qkv_wanted(self, D, ring):
+        """Whether the fused QKV Pallas kernel should run: the config
+        knob, the generic pallas gate, and the kernel's own dispatch
+        precondition (at tp > 1 only inside the ring's manual region).
+        The ACTUAL path taken is counted per trace by the caller
+        (``_record_qkv_dispatch``) — a ring fallback after this gate
+        passes still counts as ``fallback``."""
+        if not (_cfg("fused_qkv", False)
+                and _cfg("use_pallas_kernels", True)):
+            return False
+        from smdistributed_modelparallel_tpu.nn.utils import tp_size
+        from smdistributed_modelparallel_tpu.ops.pallas_qkv import (
+            fused_qkv_ok,
+        )
+
+        return fused_qkv_ok(D, ring=ring, tp=tp_size())
+
+    @nn.nowrap
+    def _record_qkv_dispatch(self, engaged):
+        """One ``smp_fused_kernel_dispatch_total`` tick for the qkv
+        kernel when the knob requested it, labeled with the path that
+        actually ran (the gate can pass and the ring still fall back —
+        indivisible sequence — leaving the plain einsum)."""
+        if not (_cfg("fused_qkv", False)
+                and _cfg("use_pallas_kernels", True)):
+            return
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            record_fused_kernel_dispatch,
+        )
+
+        record_fused_kernel_dispatch(
+            "qkv", "pallas" if engaged else "fallback"
+        )
+
     @nn.compact
     def __call__(self, hidden, cross_states=None, attention_mask=None, xs=None):
         H, hd, D = self.num_attention_heads, self.attention_head_size, self.hidden_size
@@ -228,8 +270,7 @@ class DistributedAttentionLayer(nn.Module):
                 (D, 3, H, hd),
                 dtype,
             )
-            qkv = jnp.einsum("btd,dchk->bcthk", hidden, qkv_kernel.astype(hidden.dtype))
-            q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            qkv_bias = None
             if self.use_qkv_bias:
                 qkv_bias = self.param(
                     "qkv/bias",
@@ -237,9 +278,50 @@ class DistributedAttentionLayer(nn.Module):
                     (3, H, hd),
                     dtype,
                 )
-                q = q + qkv_bias[0].astype(q.dtype)
-                k = k + qkv_bias[1].astype(k.dtype)
-                v = v + qkv_bias[2].astype(v.dtype)
+            ring = not self.decode and _ring_active()
+            fused_qkv = self._fused_qkv_wanted(D, ring)
+            qkv5 = None
+            if ring:
+                # Overlapped tp: the column-parallel input all-gather
+                # decomposes into a ppermute ring, each hop hidden under
+                # the partial matmul on the sequence block in hand
+                # (ops/collective_matmul.py); bias folds into the chunk
+                # matmuls (the Pallas fused kernel under fused_qkv).
+                from smdistributed_modelparallel_tpu.ops.collective_matmul import (  # noqa: E501
+                    ring_ag_matmul,
+                )
+
+                qkv5 = ring_ag_matmul(
+                    hidden, qkv_kernel.astype(hidden.dtype),
+                    qkv_bias.astype(hidden.dtype)
+                    if qkv_bias is not None else None,
+                    w_tp_dim=2, fused=fused_qkv,
+                )   # [B, T, 3, H, hd] or None (fall through to GSPMD)
+            if qkv5 is None and fused_qkv and not ring:
+                # Fused QKV without the ring (tp=1 per fused_qkv_ok):
+                # one Pallas matmul against the concatenated [D, 3*H*hd]
+                # kernel, bias in the epilogue.
+                from smdistributed_modelparallel_tpu.ops.pallas_qkv import (
+                    matmul_bias,
+                )
+
+                qkv5 = matmul_bias(
+                    hidden.reshape(-1, D),
+                    qkv_kernel.astype(hidden.dtype).reshape(D, 3 * H * hd),
+                    qkv_bias.astype(hidden.dtype)
+                    if qkv_bias is not None else None,
+                    interpret=jax.default_backend() != "tpu",
+                ).reshape(B, T, 3, H, hd)
+            self._record_qkv_dispatch(fused_qkv and qkv5 is not None)
+            if qkv5 is not None:
+                q, k, v = qkv5[:, :, 0], qkv5[:, :, 1], qkv5[:, :, 2]
+            else:
+                qkv = jnp.einsum("btd,dchk->bcthk", hidden, qkv_kernel.astype(hidden.dtype))
+                q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+                if qkv_bias is not None:
+                    q = q + qkv_bias[0].astype(q.dtype)
+                    k = k + qkv_bias[1].astype(k.dtype)
+                    v = v + qkv_bias[2].astype(v.dtype)
 
         head_spec = (BATCH_AXES, CP_AXIS, TP_AXIS, None)
         q = shard_activation(q, *head_spec)
@@ -348,8 +430,22 @@ class DistributedAttentionLayer(nn.Module):
             (H, hd, D),
             dtype,
         )
-        out = jnp.einsum("bthk,hkd->btd", ctx, proj_kernel.astype(ctx.dtype))
-        out = shard_activation(out, *_hidden_spec(memory_opt))
+        out = None
+        if not self.decode and not self.cross_attention and _ring_active():
+            # Overlapped tp: the row-parallel output reduce-scatter
+            # decomposes into an accumulator ring (the bias is added
+            # once, after the reduction, below).
+            from smdistributed_modelparallel_tpu.ops.collective_matmul import (  # noqa: E501
+                ring_rs_matmul,
+            )
+
+            out = ring_rs_matmul(
+                ctx, proj_kernel.astype(ctx.dtype),
+                n_contract=2, x_tp_dim=2,
+            )
+        if out is None:
+            out = jnp.einsum("bthk,hkd->btd", ctx, proj_kernel.astype(ctx.dtype))
+        out = shard_activation(out, *_hidden_spec(_seq_parallel(memory_opt)))
         if self.use_attn_dense_bias:
             proj_bias = self.param(
                 "dense/bias", nn.initializers.zeros, (D,), dtype
@@ -379,42 +475,109 @@ class DistributedTransformerOutputLayer(nn.Module):
     deterministic: Optional[bool] = None
     dtype: Optional[Any] = None
 
+    @nn.nowrap
+    def _fused_gelu_wanted(self):
+        """Whether the fused bias+GELU Pallas kernel should run: the
+        module's ``fused_bias_gelu`` flag (the reference's knob, now
+        actually dispatching), a bias to fold, the tanh-GELU family, and
+        the generic pallas gate. Counted per trace
+        (``smp_fused_kernel_dispatch_total``)."""
+        if not (self.fused_bias_gelu and self.use_mlp_bias
+                and not self.gated_mlp):
+            return False
+        if not _cfg("use_pallas_kernels", True):
+            return False
+        from smdistributed_modelparallel_tpu.ops.pallas_gelu import (
+            bias_gelu_ok,
+        )
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            record_fused_kernel_dispatch,
+        )
+
+        ok = bias_gelu_ok(self.activation)
+        record_fused_kernel_dispatch(
+            "bias_gelu", "pallas" if ok else "fallback"
+        )
+        return ok
+
     @nn.compact
     def __call__(self, hidden):
         D, F = self.hidden_size, self.intermediate_size
         dtype = self.dtype or hidden.dtype
         memory_opt = _cfg("optimize", "speed") == "memory"
         init = _init(self.initializer_range)
+        ring = _ring_active()
+        fused_gelu = self._fused_gelu_wanted()
 
         fc_kernel = self.param(
             "fc/kernel", partitioned(init, (None, TP_AXIS)), (D, F), dtype
         )
-        h = hidden @ fc_kernel.astype(hidden.dtype)
-        h = shard_activation(h, BATCH_AXES, CP_AXIS, TP_AXIS)
+        fc_bias = None
         if self.use_mlp_bias:
             fc_bias = self.param(
                 "fc/bias", partitioned(nn.initializers.zeros, (TP_AXIS,)),
                 (F,), dtype,
             )
-            # Bias+gelu fused by XLA into the matmul epilogue (parity:
-            # fused_bias_gelu, torch/nn/gelu.py).
-            h = h + fc_bias.astype(h.dtype)
-        if self.gated_mlp:
-            gate_kernel = self.param(
-                "gate/kernel", partitioned(init, (None, TP_AXIS)), (D, F),
-                dtype,
+
+        def col_matmul(kernel, bias):
+            """Column-parallel ``hidden @ kernel (+ bias)``: the
+            ring-decomposed overlapped form under tp_overlap, the GSPMD
+            einsum otherwise (where XLA fuses the bias into the matmul
+            epilogue — parity: fused_bias_gelu, torch/nn/gelu.py — or
+            the explicit Pallas kernel takes it below)."""
+            y = None
+            if ring:
+                from smdistributed_modelparallel_tpu.ops.collective_matmul import (  # noqa: E501
+                    ring_ag_matmul,
+                )
+
+                y = ring_ag_matmul(
+                    hidden, kernel.astype(hidden.dtype),
+                    bias.astype(hidden.dtype) if bias is not None else None,
+                    w_tp_dim=1,
+                )
+            if y is None:
+                y = hidden @ kernel.astype(hidden.dtype)
+                y = shard_activation(y, BATCH_AXES, CP_AXIS, TP_AXIS)
+                if bias is not None:
+                    y = y + bias.astype(y.dtype)
+            else:
+                y = shard_activation(y, BATCH_AXES, CP_AXIS, TP_AXIS)
+            return y
+
+        if fused_gelu:
+            from smdistributed_modelparallel_tpu.nn.utils import (
+                fused_bias_gelu,
             )
-            g = hidden @ gate_kernel.astype(hidden.dtype)
-            g = shard_activation(g, BATCH_AXES, CP_AXIS, TP_AXIS)
-            h = _activation(self.activation)(g) * h
+
+            h = col_matmul(fc_kernel, None)
+            h = fused_bias_gelu(h, fc_bias.astype(h.dtype))
         else:
-            h = _activation(self.activation)(h)
+            h = col_matmul(fc_kernel, fc_bias)
+            if self.gated_mlp:
+                gate_kernel = self.param(
+                    "gate/kernel", partitioned(init, (None, TP_AXIS)),
+                    (D, F), dtype,
+                )
+                g = col_matmul(gate_kernel, None)
+                h = _activation(self.activation)(g) * h
+            else:
+                h = _activation(self.activation)(h)
 
         proj_kernel = self.param(
             "proj/kernel", partitioned(init, (TP_AXIS, None)), (F, D), dtype
         )
-        out = h @ proj_kernel.astype(h.dtype)
-        out = shard_activation(out, *_hidden_spec(memory_opt))
+        out = None
+        if ring:
+            from smdistributed_modelparallel_tpu.ops.collective_matmul import (  # noqa: E501
+                ring_rs_matmul,
+            )
+
+            out = ring_rs_matmul(h, proj_kernel.astype(h.dtype),
+                                 n_contract=1)
+        if out is None:
+            out = h @ proj_kernel.astype(h.dtype)
+        out = shard_activation(out, *_hidden_spec(_seq_parallel(memory_opt)))
         if self.use_mlp_bias:
             proj_bias = self.param(
                 "proj/bias", nn.initializers.zeros, (D,), dtype
@@ -1001,7 +1164,7 @@ class DistributedTransformerLMHead(nn.Module):
         if self.embedding_dropout_prob > 0.0 and not resolve_deterministic(self.deterministic):
             x = nn.Dropout(self.embedding_dropout_prob, deterministic=False)(x)
         memory_opt = _cfg("optimize", "speed") == "memory"
-        x = shard_activation(x, *_hidden_spec(memory_opt))
+        x = shard_activation(x, *_hidden_spec(_seq_parallel(memory_opt)))
         return (x, None, attention_mask)
 
     def head(self, carry, targets=None):

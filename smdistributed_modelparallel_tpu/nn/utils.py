@@ -19,6 +19,8 @@ collectives that remain (Ulysses all-to-all, ring permute) live in
 ``smp.ops``.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
@@ -119,6 +121,53 @@ def axis_partitioned(init_fn, names):
     if not any(n for n in names) or _axes_all_trivial(names):
         return init_fn
     return nn.with_partitioning(init_fn, tuple(names))
+
+
+def tp_ring_active():
+    """Whether the overlapped-tp ring path applies right now — the one
+    lazy wrapper over ``ops.collective_matmul.tp_overlap_active`` the tp
+    layer family (nn/linear.py, nn/transformer.py) shares, so gating
+    changes cannot silently split between the two."""
+    from smdistributed_modelparallel_tpu.ops.collective_matmul import (
+        tp_overlap_active,
+    )
+
+    return tp_overlap_active()
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_bias_gelu_region(mesh, ndim, interpret):
+    from smdistributed_modelparallel_tpu.ops.pallas_gelu import bias_gelu
+    from smdistributed_modelparallel_tpu.parallel.sharding import (
+        single_axis_spec,
+    )
+    from smdistributed_modelparallel_tpu.utils.jax_compat import shard_map
+
+    h_spec = single_axis_spec(ndim, ndim - 1, TP_AXIS)
+    b_spec = single_axis_spec(1, 0, TP_AXIS)
+    return jax.jit(shard_map(
+        lambda h, b: bias_gelu(h, b, interpret),
+        mesh=mesh, in_specs=(h_spec, b_spec), out_specs=h_spec,
+        axis_names={TP_AXIS}, check_vma=False,
+    ))
+
+
+def fused_bias_gelu(h, b):
+    """Dispatch ``gelu(h + b)`` to the fused Pallas kernel
+    (``ops/pallas_gelu.py``). Under tensor parallelism the activation's
+    feature dim is tp-sharded, so the call runs inside a tp manual
+    region handing the kernel its local block (a plain pallas_call on
+    the sharded array would force a gather); at tp=1 it is a direct
+    call. Callers guard with ``pallas_gelu.bias_gelu_ok``."""
+    from smdistributed_modelparallel_tpu.ops.pallas_gelu import bias_gelu
+
+    interpret = jax.default_backend() != "tpu"
+    mesh = _mesh()
+    tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
+    if tp <= 1 or h.shape[-1] % tp != 0:
+        return bias_gelu(h, b, interpret)
+    h = shard_activation(h, *([None] * (h.ndim - 1) + [TP_AXIS]))
+    return _fused_bias_gelu_region(mesh, h.ndim, interpret)(h, b)
 
 
 def dense_init(scale=None, stddev=0.02):

@@ -1,0 +1,141 @@
+"""Fused bias+GELU Pallas kernel.
+
+The MLP's ``fc`` epilogue is ``h + bias`` followed by tanh-GELU — two
+elementwise HBM passes over the [*, intermediate] activation when XLA
+declines to fuse them into the matmul. This kernel computes
+``gelu(x + b)`` in one VMEM-resident pass; the backward kernel
+recomputes the pre-activation from the saved (x, b) and emits
+``dpre = g * gelu'(x + b)`` in one pass (db is the row-sum of dpre,
+done host-side) — the same recompute-over-materialize trade as the
+flash/CE kernels, at elementwise cost.
+
+Parity: the reference's ``fused_bias_gelu`` knob (``torch/nn/gelu.py``,
+a hand-written CUDA bias-gelu pair) — the ``DistributedTransformerOutput
+Layer`` field now actually dispatches here. The tanh approximation IS
+the reference's bias_gelu polynomial (HF "gelu_new"); the exact-erf
+variant stays on the jnp path. Interpret-mode fallback on CPU mirrors
+``pallas_ce.py`` (``FORCE_INTERPRET`` test hook). Under tensor
+parallelism the activation arrives sharded on its feature dim — callers
+wrap the call in a tp manual region (``nn/transformer.py``) so the
+kernel always sees a local block.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+# Testing hook, mirroring pallas_ce.FORCE_INTERPRET.
+FORCE_INTERPRET = False
+
+_SQRT_2_OVER_PI = float(np.sqrt(2.0 / np.pi))
+_COEFF = 0.044715
+
+# Rows per grid step; the feature dim stays whole (bias broadcasts over
+# rows, and intermediate dims are at most a few k * 4 bytes per row).
+_BLOCK_ROWS = 256
+
+
+def _gelu_tanh(u):
+    inner = _SQRT_2_OVER_PI * (u + _COEFF * u * u * u)
+    return 0.5 * u * (1.0 + jnp.tanh(inner))
+
+
+def _dgelu_tanh(u):
+    inner = _SQRT_2_OVER_PI * (u + _COEFF * u * u * u)
+    t = jnp.tanh(inner)
+    sech2 = 1.0 - t * t
+    dinner = _SQRT_2_OVER_PI * (1.0 + 3.0 * _COEFF * u * u)
+    return 0.5 * (1.0 + t) + 0.5 * u * sech2 * dinner
+
+
+def _fwd_kernel(x_ref, b_ref, y_ref):
+    u = x_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    y_ref[...] = _gelu_tanh(u).astype(y_ref.dtype)
+
+
+def _bwd_kernel(x_ref, b_ref, g_ref, dpre_ref):
+    u = x_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    dpre_ref[...] = (
+        g_ref[...].astype(jnp.float32) * _dgelu_tanh(u)
+    ).astype(dpre_ref.dtype)
+
+
+def _pad_rows(x, n):
+    if x.shape[0] == n:
+        return x
+    return jnp.pad(x, ((0, n - x.shape[0]), (0, 0)))
+
+
+def _call_rowwise(kernel, outs_dtype, interpret, x2d, b, *extra):
+    N, F = x2d.shape
+    bn = min(_BLOCK_ROWS, max(8, N))
+    n_pad = -(-N // bn) * bn
+    row = pl.BlockSpec((bn, F), lambda i: (i, 0))
+    args = [_pad_rows(x2d, n_pad), b.reshape(1, F)]
+    in_specs = [row, pl.BlockSpec((1, F), lambda i: (0, 0))]
+    for e in extra:
+        args.append(_pad_rows(e, n_pad))
+        in_specs.append(row)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // bn,),
+        in_specs=in_specs,
+        out_specs=row,
+        out_shape=jax.ShapeDtypeStruct((n_pad, F), outs_dtype),
+        interpret=interpret or FORCE_INTERPRET,
+    )(*args)
+    return out[:N]
+
+
+def _bias_gelu_impl(x, b, interpret):
+    lead = x.shape[:-1]
+    x2d = x.reshape(-1, x.shape[-1])
+    return _call_rowwise(
+        _fwd_kernel, x.dtype, interpret, x2d, b
+    ).reshape(lead + (x.shape[-1],))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def bias_gelu(x, b, interpret=False):
+    """``gelu(x + b)`` (tanh approximation) over ``x [..., F]`` and
+    ``b [F]`` in one fused pass. Differentiable in x and b."""
+    return _bias_gelu_impl(x, b, interpret)
+
+
+def _bg_fwd(x, b, interpret):
+    return _bias_gelu_impl(x, b, interpret), (x, b)
+
+
+def _bg_bwd(interpret, res, g):
+    x, b = res
+    lead = x.shape[:-1]
+    F = x.shape[-1]
+    dpre = _call_rowwise(
+        _bwd_kernel, jnp.float32, interpret,
+        x.reshape(-1, F), b, g.reshape(-1, F),
+    )
+    dx = dpre.astype(x.dtype).reshape(lead + (F,))
+    db = jnp.sum(dpre, axis=0).astype(b.dtype)
+    return dx, db
+
+
+bias_gelu.defvjp(_bg_fwd, _bg_bwd)
+
+
+def bias_gelu_ok(activation):
+    """Dispatch precondition: the tanh-GELU family (the reference's
+    fused bias_gelu polynomial) on the kernel's target backend (TPU, or
+    interpret-mode testing)."""
+    if activation not in ("gelu", "gelu_new"):
+        return False
+    return jax.default_backend() == "tpu" or FORCE_INTERPRET
+
+
+def reference_bias_gelu(x, b):
+    """jnp reference: same math, unfused — the parity oracle (matches
+    ``nn.gelu(x + b, approximate=True)`` bit-for-tolerance)."""
+    u = x.astype(jnp.float32) + b.astype(jnp.float32)
+    return _gelu_tanh(u).astype(x.dtype)

@@ -56,6 +56,15 @@ def named(mesh, *spec):
     return NamedSharding(mesh, P(*spec))
 
 
+def single_axis_spec(ndim, dim, axis):
+    """PartitionSpec naming one mesh axis on one dim of an ndim-rank
+    value, everything else replicated — the inverse building block of
+    ``strip_axis``. Shared by the tp-overlap ring regions
+    (``ops/collective_matmul.py``: sequence/feature block specs) and the
+    fused bias+GELU tp wrapper (``nn/utils.py``)."""
+    return P(*(axis if d == dim else None for d in range(ndim)))
+
+
 def strip_axis(spec, axis):
     """PartitionSpec with every occurrence of one mesh axis removed —
     the "gathered over that axis" layout of a sharded value. Shared by

@@ -415,12 +415,14 @@ class ServingEngine:
                     compiled, audit, source = exec_cache.aot_compile(
                         name, key_src, lowered, params=self.params,
                         extra_findings_fn=findings_fn,
+                        tp_ring_expected=False,
                     )
             else:
                 lowered = jitted.lower(*args)
                 compiled, audit, source = exec_cache.aot_compile(
                     name, key_src, lowered, params=self.params,
                     extra_findings_fn=findings_fn,
+                    tp_ring_expected=False,
                 )
         self.audits[kind] = audit
         self._programs[kind] = compiled

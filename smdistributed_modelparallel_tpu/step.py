@@ -380,7 +380,24 @@ class StepFunction:
                    (-1 if _rbudget is None else int(_rbudget))
                    if rmode == "auto" else 0),)
         )
-        key_pre = (pipe_key, zero_key) + recompute_key + (
+        # Overlapped-tp knobs: the ring decomposition and the fused QKV
+        # kernel rebuild the program at identical shapes. Canonicalized
+        # the recompute way: the defaults (mode "off" via
+        # collective_matmul.tp_overlap_mode — which also folds in the
+        # tp<=1 / cp>1 inertness — and fused_qkv False) contribute
+        # NOTHING, so default keys stay byte-identical to pre-knob
+        # builds. Mirrored in the exec-cache knob facts.
+        from smdistributed_modelparallel_tpu.ops.collective_matmul import (
+            fused_qkv_effective,
+            tp_overlap_mode,
+        )
+        tmode = tp_overlap_mode(cfg)
+        _fused_qkv = fused_qkv_effective(cfg)
+        tp_overlap_key = (
+            () if tmode == "off" and not _fused_qkv
+            else ((tmode, _fused_qkv),)
+        )
+        key_pre = (pipe_key, zero_key) + recompute_key + tp_overlap_key + (
                    treedef, tuple(scan_idx), tuple(bcast_idx),
                    tuple((i, _static_key(v)) for i, v in sorted(static.items())),
                    tuple((v.shape, str(v.dtype)) for v in scan_vals),

@@ -220,7 +220,27 @@ def _knob_facts():
         # only under "auto" — the one mode whose program reads it — so a
         # stray SMP_RECOMPUTE_BUDGET_MB never invalidates anything.
         **_recompute_knob_facts(cfg),
+        # Overlapped-tp knobs, same contract: defaults omit the facts
+        # (pre-knob disk entries keep verifying); a knob flip is a
+        # version mismatch, never a warm hit of the other program.
+        **_tp_overlap_knob_facts(cfg),
     }
+
+
+def _tp_overlap_knob_facts(cfg):
+    from smdistributed_modelparallel_tpu.ops.collective_matmul import (
+        fused_qkv_effective,
+        tp_overlap_mode,
+    )
+
+    mode = tp_overlap_mode(cfg)
+    fused = fused_qkv_effective(cfg)
+    facts = {}
+    if mode != "off":
+        facts["tp_overlap"] = mode
+    if fused:
+        facts["fused_qkv"] = True
+    return facts
 
 
 def _recompute_knob_facts(cfg):
@@ -283,7 +303,8 @@ def _delete_entry(path):
 
 
 def load(name, key_hash, module_sha=None, params=None,
-         expected_param_shardings=None, extra_findings_fn=None):
+         expected_param_shardings=None, extra_findings_fn=None,
+         tp_ring_expected=None):
     """Deserialize a cached step executable, or None.
 
     Returns ``(compiled, audit)``; ``audit`` is the fresh post-load X-ray
@@ -348,6 +369,7 @@ def load(name, key_hash, module_sha=None, params=None,
     audit = _verify_and_republish(
         name, key_hash, compiled, meta, params, expected_param_shardings,
         t0, extra_findings_fn=extra_findings_fn,
+        tp_ring_expected=tp_ring_expected,
     )
     if audit is False:  # fingerprint veto
         record_exec_cache("reject_fingerprint")
@@ -385,7 +407,7 @@ def _version_skew(meta):
 
 def _verify_and_republish(name, key_hash, compiled, meta, params,
                           expected_param_shardings, t0,
-                          extra_findings_fn=None):
+                          extra_findings_fn=None, tp_ring_expected=None):
     """X-ray the deserialized executable and diff it against the entry's
     stored fingerprint. Returns the fresh audit on success (gauges +
     flight event re-published — cache hits do not bypass the PR-9
@@ -402,6 +424,7 @@ def _verify_and_republish(name, key_hash, compiled, meta, params,
             expected_param_shardings=expected_param_shardings,
             publish=False, persist=False,
             extra_findings_fn=extra_findings_fn,
+            tp_ring_expected=tp_ring_expected,
         )
     except Exception as e:  # pragma: no cover - defensive
         logger.warning("[exec_cache] %s: post-load audit failed (%s); "
@@ -423,7 +446,7 @@ def _verify_and_republish(name, key_hash, compiled, meta, params,
 
 
 def aot_compile(name, key_src, lowered, params=None,
-                extra_findings_fn=None):
+                extra_findings_fn=None, tp_ring_expected=None):
     """Compile a lowered program through the full warm-start sequence the
     step engine runs — consult the disk cache (content-verified by the
     lowered-module hash, fingerprint-diffed on hit), else
@@ -450,6 +473,7 @@ def aot_compile(name, key_src, lowered, params=None,
         compiled, audit = load(
             name, key_hash, module_sha=module_sha, params=params,
             extra_findings_fn=extra_findings_fn,
+            tp_ring_expected=tp_ring_expected,
         )
         if compiled is not None:
             source = "disk_cache"
@@ -458,6 +482,7 @@ def aot_compile(name, key_src, lowered, params=None,
         audit = hlo_audit.maybe_audit(
             name, compiled, key=key_hash, params=params,
             extra_findings_fn=extra_findings_fn,
+            tp_ring_expected=tp_ring_expected,
         )
         if enabled():
             store(

@@ -501,6 +501,234 @@ def zero3_prefetch_evidence(hlo_text):
     return registers
 
 
+def tp_ring_evidence(hlo_text, mesh=None):
+    """Structural double-buffering check for the tp_overlap rings: inside
+    some while-loop body that performs both a collective-permute and
+    matmuls, at least one permute's result never feeds this iteration's
+    compute — its only transitive users are data-movement ops ending at
+    the carry tuple. That is the parked ring hop: the block in transit is
+    consumed only by the NEXT iteration's partial matmul, so the hop
+    rides under the matmul on the block already in hand. Returns the
+    count of such parked hops (the permute-flavored sibling of
+    ``zero3_prefetch_evidence``). With ``mesh``, only TP-ATTRIBUTED
+    permutes count — a parked pipeline-stage or cp-ring hop must not
+    stand in for the tp ring's own double buffering."""
+    from smdistributed_modelparallel_tpu.backend.topology import TP_AXIS
+
+    maps = _mesh_coord_maps(mesh)
+    comps = list(_computations(hlo_text))
+    move_only = {}
+    for name, lines in comps:
+        ok = True
+        for line in lines:
+            m = _INSTR_RE.match(line)
+            if m is None:
+                continue
+            km = _RHS_OP_RE.match(m.group(3))
+            if km is None or km.group(1) not in _MOVE_OPS:
+                ok = False
+                break
+        move_only[name] = ok
+
+    parked = 0
+    for name, lines in comps:
+        users, kinds, dots, hops, calls = {}, {}, set(), [], {}
+        for line in lines:
+            m = _INSTR_RE.match(line)
+            if m is None:
+                continue
+            iname, rhs = m.group(2), m.group(3)
+            for op in _REF_RE.findall(rhs):
+                if op != iname:
+                    users.setdefault(op, set()).add(iname)
+            km = _RHS_OP_RE.match(rhs)
+            kinds[iname] = km.group(1) if km else "?"
+            if kinds[iname] == "fusion":
+                fm = _CALLS_RE.search(rhs)
+                if fm:
+                    calls[iname] = fm.group(1)
+            cm = _COLL_RE.search(line)
+            if cm is not None and cm.group("op") == "collective-permute" \
+                    and cm.group("suffix") != "-done":
+                if maps is None or _attribute_pairs(
+                    _parse_pairs(line), maps,
+                    "use_global_device_ids=true" in line,
+                ) == TP_AXIS:
+                    hops.append(iname)
+            if _DOT_RE.search(line):
+                dots.add(iname)
+        if not hops or not dots:
+            continue
+
+        def moves(iname):
+            kind = kinds.get(iname)
+            if kind == "fusion":
+                return move_only.get(calls.get(iname, ""), False)
+            if kind == "collective-permute-done":
+                return True
+            return kind in _MOVE_OPS
+
+        for h in hops:
+            seen, frontier = set(), list(users.get(h, ()))
+            ok = True
+            while frontier:
+                cur = frontier.pop()
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                if not moves(cur):
+                    ok = False
+                    break
+                frontier.extend(users.get(cur, ()))
+            if ok and seen:
+                parked += 1
+    return parked
+
+
+#: op_name path markers of the per-layer block family (the overlapped
+#: path): the nn transformer's scanned stack and the zoo stack. A tp
+#: all-gather whose op_name carries one of these belongs to a block
+#: matmul the ring was supposed to decompose; collectives at the
+#: embed/head/optimizer boundary (tied LM-head dot, token-id gathers,
+#: param-update resharding GSPMD chooses on its own) are reported
+#: separately and allowed.
+_LAYER_PATH_MARKERS = ("seq_layers/", "/layers/", "layers/block")
+
+
+def tp_overlap_report(hlo_text, mesh=None):
+    """Overlapped-tensor-parallelism report over the compiled program
+    (``tp_overlap: ring``): the decomposed-ppermute census attributed to
+    the tp axis, the parked-hop double-buffering evidence, and the
+    residual synchronous tp collectives the ring is supposed to have
+    eliminated. ``overlap_evidence`` is the gate the golden commits to:
+    parked hops present AND zero residual tp all-gathers on the
+    overlapped path (the per-layer block family — boundary collectives
+    at embed/head/optimizer are reported as ``tp_boundary_*``). Bytes
+    are per-device result payloads, the census convention."""
+    from smdistributed_modelparallel_tpu.backend.topology import TP_AXIS
+
+    maps = _mesh_coord_maps(mesh)
+    report = {
+        "ring_permute_ops": 0, "ring_permute_bytes": 0,
+        "tp_allgather_ops": 0, "tp_allgather_bytes": 0,
+        "tp_boundary_allgather_ops": 0, "tp_boundary_allgather_bytes": 0,
+        "tp_reduce_scatter_ops": 0, "tp_allreduce_ops": 0,
+    }
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.search(line)
+        if m is None or m.group("suffix") == "-done":
+            continue
+        op = m.group("op")
+        use_global = "use_global_device_ids=true" in line
+        if op == "collective-permute":
+            axis = _attribute_pairs(_parse_pairs(line), maps, use_global)
+        else:
+            groups = _parse_replica_groups(line)
+            if groups is None:
+                axis = "unattributed"
+            elif groups == "all":
+                axis = "world"
+            else:
+                axis = _attribute_groups(groups, mesh, maps, use_global)
+        if axis != TP_AXIS:
+            continue
+        nbytes = _shape_bytes(m.group("shape"))
+        if op == "collective-permute":
+            report["ring_permute_ops"] += 1
+            report["ring_permute_bytes"] += nbytes
+        elif op == "all-gather":
+            onm = _OP_NAME_RE.search(line)
+            in_layer = bool(onm) and any(
+                marker in onm.group(1) for marker in _LAYER_PATH_MARKERS
+            )
+            key = "tp_allgather" if in_layer else "tp_boundary_allgather"
+            report[f"{key}_ops"] += 1
+            report[f"{key}_bytes"] += nbytes
+        elif op == "reduce-scatter":
+            report["tp_reduce_scatter_ops"] += 1
+        elif op == "all-reduce":
+            report["tp_allreduce_ops"] += 1
+    report["parked_hops"] = tp_ring_evidence(hlo_text, mesh=mesh)
+    # Known limitation: tp all-REDUCES cannot enter this gate — a clean
+    # ring program legitimately carries them (replicated-param grads:
+    # layernorms, biases, the embed/head boundary), and HLO offers no
+    # robust marker separating those from a row-parallel matmul that
+    # fell back to its synchronous all-reduce. Indivisible-geometry
+    # fallbacks are therefore surfaced by the collective_matmul
+    # warn-once logs and the census's tp_allreduce_ops count (pinned by
+    # the golden), not by this boolean.
+    report["overlap_evidence"] = bool(
+        report["ring_permute_ops"] > 0
+        and report["parked_hops"] > 0
+        and report["tp_allgather_ops"] == 0
+        and report["tp_reduce_scatter_ops"] == 0
+    )
+    return report
+
+
+def _tp_overlap_mode(cfg):
+    """The CANONICAL tp_overlap mode (collective_matmul.tp_overlap_mode):
+    "off" whenever the knob cannot shape the program (tp=1, cp>1 — the
+    documented, warned fallbacks). The audit gates on this, like the
+    step-cache key and exec-cache knob facts, so an intentionally
+    disabled ring never triggers the missing_tp_ring class."""
+    from smdistributed_modelparallel_tpu.ops.collective_matmul import (
+        tp_overlap_mode,
+    )
+
+    return tp_overlap_mode(cfg) if cfg is not None else "off"
+
+
+def _tp_overlap_findings(tp_block, cfg, mesh):
+    """The neutered-ring class: a program built under ``tp_overlap:
+    ring`` whose census shows ZERO tp-axis collective-permutes — the
+    ring decomposition silently did not lower (a neutered constraint, a
+    fallen-back call site) and the layers are back on synchronous GSPMD
+    collectives. Residual LAYER-PATH tp all-gathers alongside a
+    requested ring are a second finding (the overlap claim does not
+    hold for those bytes); boundary collectives (embed/head/optimizer)
+    are reported in the ``tp_overlap`` block but never flagged."""
+    from smdistributed_modelparallel_tpu.backend.topology import TP_AXIS
+
+    findings = []
+    if tp_block is None:
+        return findings
+    mode = _tp_overlap_mode(cfg)
+    tp = int(getattr(cfg, "tensor_parallel_degree", 1) or 1) if cfg else 1
+    mesh_tp = dict(mesh.shape).get(TP_AXIS, 1) if mesh is not None else 1
+    if mode != "ring" or tp <= 1 or mesh_tp <= 1:
+        return findings
+    ag_ops = tp_block.get("tp_allgather_ops", 0)
+    ag_bytes = tp_block.get("tp_allgather_bytes", 0)
+    if tp_block.get("ring_permute_ops", 0) == 0:
+        findings.append({
+            "kind": "missing_tp_ring",
+            "tensor": "(tp matmul family)",
+            "bytes": ag_bytes,
+            "bytes_wasted": 0,
+            "detail": (
+                "tp_overlap=ring but the compiled program has 0 tp-axis "
+                "collective-permutes: the ring decomposition did not "
+                "lower and the tp matmuls are back on synchronous GSPMD "
+                "collectives"
+            ),
+        })
+    if ag_ops > 0:
+        findings.append({
+            "kind": "tp_residual_allgather",
+            "tensor": "(tp layer blocks)",
+            "bytes": ag_bytes,
+            "bytes_wasted": 0,
+            "detail": (
+                f"tp_overlap=ring but {ag_ops} tp-axis all-gather(s) "
+                "remain on the layer-block path "
+                f"({ag_bytes} bytes/device stay synchronous on the "
+                "critical path)"
+            ),
+        })
+    return findings
+
+
 def zero_report(hlo_text, mesh=None):
     """ZeRO-3 collective-traffic report over the compiled program: rdp-axis
     parameter-gather and gradient-scatter volume, how much of it is issued
@@ -807,7 +1035,7 @@ class ProgramAudit:
 
     def __init__(self, name, key, census, remat, memory, findings,
                  flops, bytes_accessed, hlo_sha256, config, zero=None,
-                 recompute=None):
+                 recompute=None, tp_overlap=None):
         self.name = name
         self.key = key
         self.census = census
@@ -820,6 +1048,7 @@ class ProgramAudit:
         self.config = config
         self.zero = zero
         self.recompute = recompute
+        self.tp_overlap = tp_overlap
         self.fingerprint = self._fingerprint()
         self.fingerprint_hash = fingerprint_hash(self.fingerprint)
 
@@ -865,6 +1094,10 @@ class ProgramAudit:
         # plan carry the block — default-knob fingerprints are unchanged.
         if self.recompute is not None:
             fp["recompute"] = self.recompute
+        # Additive likewise: only tp_overlap != "off" programs carry the
+        # ring census/overlap-evidence block.
+        if self.tp_overlap is not None:
+            fp["tp_overlap"] = self.tp_overlap
         return fp
 
     def as_dict(self):
@@ -891,6 +1124,12 @@ def _config_snapshot(cfg):
     recompute = getattr(cfg, "recompute", "full")
     if recompute and recompute != "full":
         snap["recompute"] = recompute
+    # Additive likewise for overlapped tp (default "off" omitted; the
+    # CANONICAL mode, so a knob that cannot shape the program — tp=1,
+    # cp>1 — never enters the snapshot).
+    tp_overlap = _tp_overlap_mode(cfg)
+    if tp_overlap != "off":
+        snap["tp_overlap"] = tp_overlap
     return snap
 
 
@@ -911,7 +1150,7 @@ def cache_key_hash(key):
 def audit_compiled(name, compiled, key=None, params=None,
                    expected_param_shardings=None, mesh=None, cfg=None,
                    min_bytes=1024, publish=True, persist=True,
-                   extra_findings_fn=None):
+                   extra_findings_fn=None, tp_ring_expected=None):
     """Run the full audit over one compiled executable. Explicit calls
     always run (the ``SMP_HLO_AUDIT`` gate lives in ``maybe_audit``)."""
     from smdistributed_modelparallel_tpu.backend.state import state
@@ -931,6 +1170,13 @@ def audit_compiled(name, compiled, key=None, params=None,
     zero = None
     if bool(getattr(cfg, "zero3_enabled", False)):
         zero = zero_report(text, mesh=mesh)
+    # ``tp_ring_expected=False`` marks a program family the ring never
+    # lowers into by design (the serving engine's decode/prefill
+    # programs: decode-guarded attention, S=1 fallbacks) — no census, no
+    # gauges, and crucially no missing_tp_ring false alarm for it.
+    tp_overlap = None
+    if _tp_overlap_mode(cfg) != "off" and tp_ring_expected is not False:
+        tp_overlap = tp_overlap_report(text, mesh=mesh)
     recompute = None
     try:
         from smdistributed_modelparallel_tpu.parallel import (
@@ -948,6 +1194,7 @@ def audit_compiled(name, compiled, key=None, params=None,
         compiled, params, expected_param_shardings, mesh, min_bytes
     )
     findings += _loop_findings(text, census, cfg, mesh)
+    findings += _tp_overlap_findings(tp_overlap, cfg, mesh)
     if extra_findings_fn is not None:
         # Program-owner-specific detectors (e.g. the serving engine's
         # replicated-KV-pool check) — run on whatever executable is being
@@ -970,6 +1217,7 @@ def audit_compiled(name, compiled, key=None, params=None,
     audit = ProgramAudit(
         name, key, census, remat, memory, findings, flops, bytes_accessed,
         hlo_sha, _config_snapshot(cfg), zero=zero, recompute=recompute,
+        tp_overlap=tp_overlap,
     )
     if publish:
         # Unpublished audits stay out of the registry too: a verification
@@ -989,7 +1237,8 @@ def audit_compiled(name, compiled, key=None, params=None,
 
 
 def maybe_audit(name, compiled, key=None, params=None,
-                expected_param_shardings=None, extra_findings_fn=None):
+                expected_param_shardings=None, extra_findings_fn=None,
+                tp_ring_expected=None):
     """Post-compile hook from the step engine. ``SMP_HLO_AUDIT=off`` is a
     hard no-op (returns before touching the executable); failures are
     logged, never raised into the step path."""
@@ -1001,6 +1250,7 @@ def maybe_audit(name, compiled, key=None, params=None,
             name, compiled, key=key, params=params,
             expected_param_shardings=expected_param_shardings,
             extra_findings_fn=extra_findings_fn,
+            tp_ring_expected=tp_ring_expected,
         )
     except Exception as e:  # pragma: no cover - defensive
         logger.warning("[xray] hlo audit of %s failed: %s", name, e)
@@ -1096,7 +1346,7 @@ def bench_summary(audit):
 #: compare (memory/FLOPs/hashes move with jaxlib versions; these move
 #: only when the program's parallel structure does).
 SEMANTIC_FIELDS = ("config", "collectives", "replicated", "remat", "zero",
-                   "recompute")
+                   "recompute", "tp_overlap")
 
 
 def diff(a, b, fields=None, remat_tol=0.02):
@@ -1153,6 +1403,11 @@ def diff(a, b, fields=None, remat_tol=0.02):
         for k in sorted(set(ra) | set(rb)):
             if ra.get(k) != rb.get(k):
                 add(f"recompute.{k}", ra.get(k), rb.get(k))
+    if picked("tp_overlap"):
+        ta, tb = a.get("tp_overlap") or {}, b.get("tp_overlap") or {}
+        for k in sorted(set(ta) | set(tb)):
+            if ta.get(k) != tb.get(k):
+                add(f"tp_overlap.{k}", ta.get(k), tb.get(k))
     if picked("memory"):
         ma, mb = a.get("memory", {}), b.get("memory", {})
         for k in sorted(set(ma) | set(mb)):
@@ -1210,6 +1465,12 @@ def _publish(audit):
         )
 
         record_zero3_xray(audit.name, audit.zero)
+    if audit.tp_overlap is not None:
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            record_tp_overlap_xray,
+        )
+
+        record_tp_overlap_xray(audit.name, audit.tp_overlap)
 
 
 def _persist(audit):

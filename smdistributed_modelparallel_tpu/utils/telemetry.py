@@ -848,6 +848,55 @@ def record_zero3_xray(name, zero_block):
             ).set(float(val))
 
 
+def record_tp_overlap_xray(name, block):
+    """Publish the X-ray's overlapped-tensor-parallelism report
+    (utils/hlo_audit.py ``tp_overlap_report``) as ``smp_tp_overlap_*``
+    gauges: the decomposed ring-hop census attributed to the tp axis,
+    the parked-hop double-buffering evidence, and the residual
+    synchronous tp collectives the ring should have eliminated."""
+    lab = dict(step=name)
+    for key, help_text in (
+        ("ring_permute_ops", "tp-axis collective-permute (ring hop) "
+         "instructions in the compiled tp_overlap program"),
+        ("ring_permute_bytes", "per-device tp-axis collective-permute "
+         "result bytes (overlapped ring-hop traffic) in the compiled "
+         "tp_overlap program"),
+        ("parked_hops", "ring hops parked in a loop carry (consumed only "
+         "by the next iteration's partial matmul) — the double-buffering "
+         "evidence"),
+        ("tp_allgather_ops", "residual synchronous tp-axis all-gather "
+         "instructions (0 on a clean overlapped path)"),
+        ("tp_reduce_scatter_ops", "residual synchronous tp-axis "
+         "reduce-scatter instructions"),
+        ("tp_allreduce_ops", "residual synchronous tp-axis all-reduce "
+         "instructions"),
+    ):
+        val = block.get(key)
+        if val is not None:
+            telemetry.gauge(f"smp_tp_overlap_{key}", help_text).labels(
+                **lab
+            ).set(float(val))
+    ev = block.get("overlap_evidence")
+    if ev is not None:
+        telemetry.gauge(
+            "smp_tp_overlap_evidence",
+            "1 when the structural overlap proof holds (parked ring hops "
+            "present, zero residual tp all-gathers)",
+        ).labels(**lab).set(1.0 if ev else 0.0)
+
+
+def record_fused_kernel_dispatch(kernel, path):
+    """One fused-kernel dispatch decision at trace time (``qkv`` /
+    ``bias_gelu``; path ``pallas`` or ``fallback``) — the hit counters
+    the tp-overlap report section renders. Trace-time counts: one per
+    compiled call site, not per executed step."""
+    telemetry.counter(
+        "smp_fused_kernel_dispatch_total",
+        "fused-kernel dispatch decisions at trace time by kernel and "
+        "chosen path",
+    ).labels(kernel=kernel, path=path).inc()
+
+
 def record_serve_request(event, n=1):
     """One serving-request lifecycle event (serving/engine.py):
     ``admitted`` / ``finished`` / ``readmitted`` (failover re-admission of

@@ -182,6 +182,14 @@ _SLOW_TESTS = (
     # exec-cache warm start each pay 2+ extra serving-program compiles.
     "test_serving.py::TestServingXray::test_detector_fires_on_replicated_pool",
     "test_serving.py::TestExecCacheWarmStart",
+    # Overlapped-tp heavy multi-compile cases: the acceptance gate
+    # (GSPMD baseline + ring compile, parity + census + golden in one
+    # test) and the neutered-ring detector stay fast in
+    # test_tp_overlap.py; the fused-kernel parity runs and the
+    # pp2/indivisible-seq/health compositions each pay 2+ extra
+    # end-to-end compiles.
+    "test_tp_overlap.py::TestFusedParity",
+    "test_tp_overlap.py::TestComposition",
 )
 
 

@@ -75,6 +75,21 @@ SERVING_CONFIGS = {
 }
 
 
+# The overlapped-tensor-parallelism program (tests/test_tp_overlap.py
+# gate): the smp.nn transformer family (the layers the ring lives in) at
+# tp=2 with the collective matmuls ring-decomposed. The fingerprint's
+# `tp_overlap` block commits the decomposed-ppermute census (tp-axis
+# attributed), the parked-hop double-buffering evidence, and ZERO
+# residual layer-path tp all-gathers. Compiled LAST (after the serving
+# configs) so every earlier golden stays byte-stable.
+TP_OVERLAP_CONFIGS = {
+    "tp_overlap_tp2": {
+        "microbatches": 2, "ddp": True, "tensor_parallel_degree": 2,
+        "tp_overlap": "ring",
+    },
+}
+
+
 def fingerprint_of(cfg):
     import jax
     import jax.numpy as jnp
@@ -139,6 +154,53 @@ def serving_fingerprint_of(cfg):
     return audit.as_dict()
 
 
+def tp_overlap_fingerprint_of(cfg):
+    """Compile the smp.nn transformer LM-head train step under ``cfg``
+    (the exact geometry tests/test_tp_overlap.py's golden gate uses) and
+    return its audit fingerprint."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import smdistributed_modelparallel_tpu as smp
+    from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+        vocab_parallel_cross_entropy,
+    )
+    from smdistributed_modelparallel_tpu.nn.transformer import (
+        DistributedTransformerLMHead,
+    )
+    from smdistributed_modelparallel_tpu.utils import hlo_audit
+
+    smp.reset()
+    smp.init(cfg)
+    model = smp.DistributedModel(DistributedTransformerLMHead(
+        num_layers=2, num_attention_heads=4, attention_head_size=8,
+        hidden_size=32, intermediate_size=64, vocab_size=96,
+        num_positions=32, causal_mask_size=32, pre_layernorm=True,
+        post_layernorm=False, final_layernorm=True,
+        attention_dropout_prob=0.0, hidden_dropout_prob=0.0,
+        embedding_dropout_prob=0.0,
+    ))
+    optimizer = smp.DistributedOptimizer(optax.sgd(0.1), model)
+    ids = jax.random.randint(jax.random.key(0), (4, 16), 0, 96)
+
+    @smp.step
+    def train_step(model, batch):
+        logits = model(batch)
+        loss = jnp.mean(
+            vocab_parallel_cross_entropy(logits[:, :-1], batch[:, 1:])
+        )
+        model.backward(loss)
+        return loss
+
+    train_step(model, ids)
+    optimizer.step()
+    audit = hlo_audit.of_step_function(train_step)
+    if audit is None:
+        raise RuntimeError("no AOT executable — cannot build goldens here")
+    return audit.as_dict()
+
+
 def main():
     jax_cfg = None
     import jax
@@ -157,6 +219,11 @@ def main():
     for name, cfg in SERVING_CONFIGS.items():
         sys.stderr.write(f"compiling {name} ...\n")
         fp = serving_fingerprint_of(cfg)
+        fp["name"] = name
+        programs[name] = fp
+    for name, cfg in TP_OVERLAP_CONFIGS.items():
+        sys.stderr.write(f"compiling {name} ...\n")
+        fp = tp_overlap_fingerprint_of(cfg)
         fp["name"] = name
         programs[name] = fp
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
