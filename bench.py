@@ -881,8 +881,13 @@ def _serve_probe(deadline):
     own length. Token parity is asserted row-for-row (greedy), compile is
     excluded from both legs (warmed up beforehand), and the block stamped
     into BENCH_r*.json as ``"serving"`` carries
-    ttft/itl/tokens_per_sec/speedup (schema-checked by
-    scripts/perf_ledger.py). TPU criterion in BENCH_NOTES.md: same
+    ttft/itl mean + p50/p95/p99 and tokens_per_sec/speedup
+    (schema-checked by scripts/perf_ledger.py). The probe also arms the
+    observability artifacts: the metrics time-series JSONL
+    (smp_serve_timeseries.jsonl, with idle tail windows so windowed
+    tok/s visibly diverges from the lifetime rate) and the fused
+    per-request span trace (smp_serve_trace.json via scripts/trace_fuse
+    over the flight-ring dump). TPU criterion in BENCH_NOTES.md: same
     structure at serving batch sizes."""
     import numpy as np
 
@@ -896,6 +901,17 @@ def _serve_probe(deadline):
             "bench: serve probe skipped (probe window exhausted)\n"
         )
         return None
+    # Arm the time-series feed for the probe run (caller env wins);
+    # restored in the finally so the probe leaves no trace in os.environ.
+    ts_env_prev = {
+        k: os.environ.get(k)
+        for k in ("SMP_TIMESERIES_INTERVAL", "SMP_TIMESERIES_PATH")
+    }
+    os.environ.setdefault("SMP_TIMESERIES_INTERVAL", "0.1")
+    os.environ.setdefault(
+        "SMP_TIMESERIES_PATH", "smp_serve_timeseries.jsonl"
+    )
+    engine = None
     try:
         import jax as _jax
 
@@ -980,14 +996,40 @@ def _serve_probe(deadline):
             list(results[f"b{i}"]) == static_out[i]
             for i in range(len(max_news))
         )
-        ttft_ms = (
-            1e3 * engine._ttft_sum / max(engine._ttft_n, 1)
+
+        ts = engine.timeseries
+        if ts is not None:
+            # Two idle tail windows after the burst: windowed tok/s
+            # decays to ~0 while the lifetime rate stays positive — the
+            # divergence the autoscaler feed exists to carry.
+            for _ in range(2):
+                time.sleep(ts.interval)
+                ts.maybe_sample()
+
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            serve_latency_summary,
         )
-        itl_ms = 1e3 * engine._itl_sum / max(engine._itl_n, 1)
+
+        qs = (0.5, 0.95, 0.99)
+        ttft = serve_latency_summary("ttft", qs=qs)
+        itl = serve_latency_summary("itl", qs=qs)
+
+        def _pct(summ, q):
+            if not summ:
+                return 0.0
+            return round(1e3 * summ["quantiles_s"][q], 3)
+
+        snaps = ts.snapshots() if ts is not None else []
         result = {
             "component": "serving",
-            "ttft_ms": round(ttft_ms, 2),
-            "itl_ms": round(itl_ms, 2),
+            "ttft_ms": round(1e3 * ttft["mean_s"], 2) if ttft else 0.0,
+            "itl_ms": round(1e3 * itl["mean_s"], 2) if itl else 0.0,
+            "ttft_p50_ms": _pct(ttft, 0.5),
+            "ttft_p95_ms": _pct(ttft, 0.95),
+            "ttft_p99_ms": _pct(ttft, 0.99),
+            "itl_p50_ms": _pct(itl, 0.5),
+            "itl_p95_ms": _pct(itl, 0.95),
+            "itl_p99_ms": _pct(itl, 0.99),
             "tokens_per_sec": round(cont_tps, 2),
             "static_tokens_per_sec": round(static_tps, 2),
             "static_ttft_ms": round(
@@ -998,7 +1040,53 @@ def _serve_probe(deadline):
             "decode_steps": int(engine.stats["decode_steps"]),
             "prefill_chunks": int(engine.stats["prefill_chunks"]),
             "token_parity": bool(parity),
+            "timeseries_windows": len(snaps),
         }
+        if snaps:
+            result["tokens_per_sec_last_window"] = round(
+                snaps[-1]["tokens_per_s"], 2
+            )
+            result["tokens_per_sec_lifetime"] = round(
+                snaps[-1]["lifetime_tokens_per_s"], 2
+            )
+
+        # Fused span trace: dump the flight ring and run trace_fuse over
+        # it. Best-effort — the trace artifact failing must not void the
+        # probe numbers.
+        try:
+            from smdistributed_modelparallel_tpu.utils.flight_recorder import (
+                flight_recorder,
+            )
+
+            ring_path = flight_recorder.dump("smp_serve_flight.jsonl")
+            if ring_path:
+                scripts_dir = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "scripts"
+                )
+                if scripts_dir not in sys.path:
+                    sys.path.insert(0, scripts_dir)
+                import trace_fuse
+
+                trace_fuse.main(
+                    ["-o", "smp_serve_trace.json", "--no-report",
+                     ring_path]
+                )
+                stream = trace_fuse.load_stream(ring_path)
+                spans, _, findings = trace_fuse.serve_request_spans(
+                    [e for e in stream.events if e.get("kind") == "serve"]
+                )
+                result["trace_slot_lanes"] = len({
+                    sp["tid"] for sp in spans
+                    if sp["tid"].startswith("slot ")
+                })
+                result["trace_open_spans"] = sum(
+                    1 for f in findings if "left open" in f
+                )
+        except Exception as te:
+            sys.stderr.write(
+                f"bench: serve trace artifacts skipped ({te!r})\n"
+            )
+
         sys.stderr.write(json.dumps(result) + "\n")
         sys.stderr.flush()
         return result
@@ -1006,6 +1094,13 @@ def _serve_probe(deadline):
         sys.stderr.write(f"bench: serve probe failed ({e!r})\n")
         return None
     finally:
+        for k, v in ts_env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if engine is not None:
+            engine.close()
         smp.reset()
 
 

@@ -307,6 +307,22 @@ def _serve_probe_schema_problem(probe):
     if probe.get("token_parity") is False:
         # A speedup at unequal outputs measures nothing.
         return "'serving.token_parity' is false — the A/B is invalid"
+    # Streaming percentile columns: optional (older rounds predate the
+    # histogram telemetry), but when present they must be numeric and
+    # ordered — a p99 below p50 means the quantile math regressed.
+    for kind in ("ttft", "itl"):
+        pcts = {}
+        for stat in ("p50", "p95", "p99"):
+            v = probe.get(f"{kind}_{stat}_ms")
+            if v is None:
+                continue
+            if not isinstance(v, (int, float)):
+                return f"'serving.{kind}_{stat}_ms' must be numeric"
+            pcts[stat] = v
+        if ("p50" in pcts and "p99" in pcts
+                and pcts["p99"] < pcts["p50"] - 1e-9):
+            return (f"'serving.{kind}_p99_ms' < '{kind}_p50_ms' — "
+                    "percentiles are not monotonic")
     return None
 
 
@@ -563,6 +579,26 @@ def render_table(ledger, out=sys.stdout):
             if sprobe.get("token_parity"):
                 parts.append("parity ok")
             w(f"{'':>7}serving: " + "  ".join(parts) + "\n")
+            for kind in ("ttft", "itl"):
+                pcts = [sprobe.get(f"{kind}_{s}_ms")
+                        for s in ("p50", "p95", "p99")]
+                if all(isinstance(v, (int, float)) for v in pcts):
+                    w(f"{'':>7}serving {kind} p50/p95/p99: "
+                      f"{pcts[0]:.1f}/{pcts[1]:.1f}/{pcts[2]:.1f}ms\n")
+            if sprobe.get("timeseries_windows"):
+                parts = [f"{sprobe['timeseries_windows']} window(s)"]
+                tw = sprobe.get("tokens_per_sec_last_window")
+                if tw is not None:
+                    parts.append(f"last-window {tw:,.0f} tok/s")
+                tl = sprobe.get("tokens_per_sec_lifetime")
+                if tl is not None:
+                    parts.append(f"lifetime {tl:,.0f} tok/s")
+                if sprobe.get("trace_slot_lanes") is not None:
+                    parts.append(
+                        f"trace lanes {sprobe['trace_slot_lanes']}"
+                        f" (open spans {sprobe.get('trace_open_spans', 0)})"
+                    )
+                w(f"{'':>7}serving timeseries: " + "  ".join(parts) + "\n")
         zprobe = r.get("zero_probe")
         if isinstance(zprobe, dict):
             parts = [

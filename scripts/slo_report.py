@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Evaluate serving SLOs over a metrics time-series JSONL and gate on
+the result.
+
+Usage:
+    python scripts/slo_report.py smp_serve_timeseries.jsonl
+    python scripts/slo_report.py ts.jsonl --slo "ttft_p99_ms=500,itl_p99_ms=50"
+    python scripts/slo_report.py ts.jsonl --check                 # CI gate
+    python scripts/slo_report.py ts.jsonl --check --min-goodput 0.95
+    python scripts/slo_report.py dumps/                           # rank files
+
+Inputs are the ``serve_window`` JSONL records the engine's time-series
+snapshotter appends when ``SMP_TIMESERIES_PATH`` is set
+(``utils/timeseries.MetricsTimeSeries`` — one line per
+``SMP_TIMESERIES_INTERVAL`` window: windowed rates, window latency
+percentiles, and — when ``SMP_SLO`` was set at run time — the embedded
+per-window SLO verdict). Directories are scanned for every file in
+them, so per-rank ``path.rank<i>`` feeds aggregate naturally.
+
+With ``--slo`` the spec is re-evaluated against each window (offline
+what-if: try a tighter SLO against a recorded run); without it the
+embedded verdicts are used. ``--check`` turns the report into a gate:
+exit 0 when the goodput fraction (windows with zero violations /
+windows) is at least ``--min-goodput`` (default 1.0), 1 when below, 2
+when there is nothing to evaluate (no windows, or neither ``--slo`` nor
+embedded verdicts).
+
+Stdlib only — runnable anywhere the JSONL can be copied to. The SLO key
+grammar duplicates ``utils/timeseries.parse_slo`` on purpose: this
+script stays a single copyable file with no package import.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_KINDS = ("ttft", "itl", "queue_wait", "prefill", "decode_step")
+_SLO_KEYS = tuple(
+    f"{kind}_{stat}_ms"
+    for kind in _KINDS
+    for stat in ("p50", "p90", "p99", "mean")
+) + ("queue_depth", "tokens_per_s_min", "requests_per_s_min")
+
+
+def parse_slo(spec):
+    """"ttft_p99_ms=500,queue_depth=8" -> {key: threshold}. Raises
+    ValueError on unknown keys/bad thresholds."""
+    out = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, raw = part.partition("=")
+        key = key.strip()
+        if not sep:
+            raise ValueError(f"SLO term {part!r} lacks '=<threshold>'")
+        if key not in _SLO_KEYS:
+            raise ValueError(
+                f"unknown SLO key {key!r}; supported: "
+                f"{', '.join(_SLO_KEYS)}"
+            )
+        try:
+            out[key] = float(raw)
+        except ValueError:
+            raise ValueError(
+                f"SLO threshold {raw!r} for {key!r} is not a number"
+            )
+    return out
+
+
+def evaluate_slo(slo, window):
+    """Same semantics as utils/timeseries.evaluate_slo: ``*_min`` keys
+    are lower bounds, everything else an upper bound; a key the window
+    has no value for (no samples that window) is not a violation."""
+    violations = {}
+    for key in sorted(slo):
+        limit = slo[key]
+        if key.endswith("_min"):
+            value = window.get(key[: -len("_min")])
+            bad = value is not None and value < limit
+        else:
+            value = window.get(key)
+            bad = value is not None and value > limit
+        if bad:
+            violations[key] = {"limit": limit, "value": value}
+    return {"ok": not violations, "violations": violations}
+
+
+def load_windows(paths):
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(
+                os.path.join(p, n) for n in sorted(os.listdir(p))
+                if os.path.isfile(os.path.join(p, n))
+            )
+        else:
+            files.append(p)
+    windows = []
+    for f in files:
+        try:
+            with open(f) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (isinstance(rec, dict)
+                            and rec.get("kind") == "serve_window"):
+                        windows.append(rec)
+        except OSError as e:
+            sys.stderr.write(f"slo_report: skipping {f}: {e}\n")
+    windows.sort(key=lambda wn: (wn.get("t_wall", 0.0), wn.get("seq", 0)))
+    return windows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Evaluate serving SLOs over a metrics time-series "
+        "JSONL (and gate on goodput with --check)."
+    )
+    ap.add_argument("inputs", nargs="+",
+                    help="time-series JSONL file(s) or directories")
+    ap.add_argument("--slo", default=None,
+                    help="SLO spec to (re-)evaluate, e.g. "
+                    "'ttft_p99_ms=500,itl_p99_ms=50,queue_depth=8'; "
+                    "default: the embedded per-window verdicts recorded "
+                    "under SMP_SLO at run time")
+    ap.add_argument("--check", action="store_true",
+                    help="gate: exit 0 iff goodput >= --min-goodput")
+    ap.add_argument("--min-goodput", type=float, default=1.0,
+                    help="goodput fraction required by --check "
+                    "(default %(default)s)")
+    args = ap.parse_args(argv)
+
+    windows = load_windows(args.inputs)
+    if not windows:
+        sys.stderr.write("slo_report: no serve_window records found\n")
+        return 2
+    if args.slo:
+        try:
+            slo = parse_slo(args.slo)
+        except ValueError as e:
+            sys.stderr.write(f"slo_report: {e}\n")
+            return 2
+        if not slo:
+            sys.stderr.write("slo_report: --slo spec is empty\n")
+            return 2
+        verdicts = [evaluate_slo(slo, wn) for wn in windows]
+        source = f"--slo {args.slo!r}"
+    else:
+        verdicts = [wn.get("slo") for wn in windows]
+        if any(v is None for v in verdicts):
+            sys.stderr.write(
+                "slo_report: windows carry no embedded SLO verdicts "
+                "(run with SMP_SLO=... or pass --slo)\n"
+            )
+            return 2
+        source = "embedded verdicts (SMP_SLO at run time)"
+
+    ok = sum(1 for v in verdicts if v.get("ok"))
+    goodput = ok / len(windows)
+    per_key = {}
+    worst = {}
+    for v in verdicts:
+        for key, d in (v.get("violations") or {}).items():
+            per_key[key] = per_key.get(key, 0) + 1
+            value = (d or {}).get("value")
+            if value is None:
+                continue
+            if key.endswith("_min"):
+                worst[key] = min(worst.get(key, value), value)
+            else:
+                worst[key] = max(worst.get(key, value), value)
+
+    w = sys.stdout.write
+    w("=== serving SLO report ===\n")
+    span = windows[-1].get("t_wall", 0.0) - windows[0].get("t_wall", 0.0)
+    w(f"{len(windows)} window(s) spanning {span:.1f}s   source: "
+      f"{source}\n")
+    w(f"goodput: {100.0 * goodput:.1f}% ({ok}/{len(windows)} windows "
+      "with zero violations)\n")
+    if per_key:
+        w(f"\n{'violated key':<22}{'windows':>8}  {'limit':>12}  "
+          f"{'worst value':>12}\n")
+        for key in sorted(per_key):
+            limit = None
+            for v in verdicts:
+                d = (v.get("violations") or {}).get(key)
+                if d:
+                    limit = d.get("limit")
+                    break
+            w(f"{key:<22}{per_key[key]:>8}  "
+              f"{limit if limit is not None else 'n/a':>12}  "
+              f"{worst.get(key, 'n/a'):>12}\n")
+    else:
+        w("no violations\n")
+
+    if args.check:
+        passed = goodput >= args.min_goodput - 1e-12
+        w(f"\ncheck: goodput {100.0 * goodput:.1f}% "
+          f"{'>=' if passed else '<'} required "
+          f"{100.0 * args.min_goodput:.1f}% -> "
+          f"{'PASS' if passed else 'FAIL'}\n")
+        return 0 if passed else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,53 @@ def _hist_totals(report, name):
     return total, count
 
 
+def _quantile_from_counts(buckets, counts, q):
+    """q-quantile of a bucketed distribution (stdlib copy of
+    utils/telemetry.quantile_from_counts — same interpolation, so
+    percentiles of cross-rank MERGED bucket counts match what a single
+    rank would have published). Log-interpolates inside geometric
+    buckets; the overflow bucket clamps to the last boundary; None when
+    empty."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = min(max(float(q), 0.0), 1.0) * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if c > 0 and acc + c >= target:
+            if i >= len(buckets):
+                return float(buckets[-1])
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            f = (target - acc) / c
+            if lo > 0.0:
+                return float(lo * (hi / lo) ** f)
+            return float(lo + (hi - lo) * f)
+        acc += c
+    return float(buckets[-1])
+
+
+def _hist_quantiles(report, name, qs, **labels):
+    """(count, mean, [quantile...]) of one histogram series (bucket
+    counts merged across matching label sets), or None when empty."""
+    buckets, counts, total, n = None, None, 0.0, 0
+    for s in _series(report, name):
+        if not all(s["labels"].get(k) == v for k, v in labels.items()):
+            continue
+        b = s.get("buckets") or []
+        c = s.get("counts") or []
+        if buckets is None:
+            buckets, counts = list(b), list(c)
+        elif b == buckets and len(c) == len(counts):
+            counts = [x + y for x, y in zip(counts, c)]
+        total += s.get("sum", 0.0)
+        n += s.get("count", 0)
+    if not n or buckets is None:
+        return None
+    return (n, total / n,
+            [_quantile_from_counts(buckets, counts, q) for q in qs])
+
+
 def _fmt_bytes(n):
     if n is None:
         return "n/a"
@@ -104,6 +151,13 @@ def render(report, out=sys.stdout):
           f"({disp_sum / disp_count:.3f}s/step)\n")
         if tokens and disp_sum > 0:
             w(f"tokens/sec (host dispatch bound): {_fmt_num(tokens / disp_sum)}\n")
+    step_q = _hist_quantiles(
+        report, "smp_step_time_seconds", (0.5, 0.9, 0.99)
+    )
+    if step_q:
+        _, _, (p50, p90, p99) = step_q
+        w(f"step time p50/p90/p99: {1e3 * p50:.1f}/{1e3 * p90:.1f}/"
+          f"{1e3 * p99:.1f} ms\n")
 
     # -- pipeline bubble ------------------------------------------------
     bubbles = _series(report, "smp_pipeline_bubble_fraction")
@@ -448,9 +502,12 @@ def render(report, out=sys.stdout):
               + "\n")
 
     # -- serving (smp.serving continuous-batching engine) ---------------
-    # SLO gauges (TTFT / ITL last+mean, throughput), occupancy (queue
-    # depth, decode slots, paged KV-pool blocks), and request lifecycle
-    # counters incl. failover re-admissions.
+    # Latency distributions (percentiles from the merged log-bucketed
+    # histograms — identical in single-dump and cross-rank dir modes,
+    # because aggregate() sums bucket counts element-wise), windowed
+    # throughput, SLO goodput, occupancy (queue depth, decode slots,
+    # paged KV-pool blocks), and request lifecycle counters incl.
+    # failover re-admissions.
     serve_events = {
         s["labels"].get("event", "?"): s["value"]
         for s in _series(report, "smp_serve_requests_total")
@@ -484,6 +541,22 @@ def render(report, out=sys.stdout):
                              + (f" ({1e3 * itl_last:.1f}ms last)"
                                 if itl_last is not None else ""))
             w("  latency: " + "  ".join(parts) + "\n")
+        lat_rows = []
+        for kind in ("ttft", "itl", "queue_wait", "prefill",
+                     "decode_step"):
+            hq = _hist_quantiles(
+                report, "smp_serve_latency_seconds", (0.5, 0.9, 0.99),
+                kind=kind,
+            )
+            if hq:
+                lat_rows.append((kind, hq))
+        if lat_rows:
+            w(f"  {'latency (ms)':<14}{'n':>8}{'mean':>9}{'p50':>9}"
+              f"{'p90':>9}{'p99':>9}\n")
+            for kind, (n, mean, (p50, p90, p99)) in lat_rows:
+                w(f"  {kind:<14}{n:>8}{1e3 * mean:>9.1f}"
+                  f"{1e3 * p50:>9.1f}{1e3 * p90:>9.1f}"
+                  f"{1e3 * p99:>9.1f}\n")
         rps = _value(report, "smp_serve_requests_per_sec")
         tps = _value(report, "smp_serve_tokens_per_sec", scope="engine")
         tps_chip = _value(report, "smp_serve_tokens_per_sec", scope="chip")
@@ -495,7 +568,29 @@ def render(report, out=sys.stdout):
                 parts.append(f"{tps:,.1f} tok/s")
             if tps_chip is not None:
                 parts.append(f"{tps_chip:,.1f} tok/s/chip")
-            w("  throughput: " + "  ".join(parts) + "\n")
+            w("  throughput (last window): " + "  ".join(parts) + "\n")
+        windows = _value(report, "smp_timeseries_windows")
+        goodput = _value(report, "smp_slo_goodput_fraction")
+        violations = _series(report, "smp_slo_violations_total")
+        if windows or goodput is not None or violations:
+            parts = []
+            if windows:
+                parts.append(f"{int(windows)} window(s)")
+            if goodput is not None:
+                parts.append(f"goodput {100.0 * goodput:.1f}%")
+            n_viol = int(sum(s["value"] for s in violations))
+            if n_viol:
+                detail = ", ".join(
+                    f"{s['labels'].get('slo', '?')} x{int(s['value'])}"
+                    for s in sorted(
+                        violations,
+                        key=lambda s: s["labels"].get("slo", ""),
+                    ) if s["value"]
+                )
+                parts.append(f"{n_viol} violation(s): {detail}")
+            elif goodput is not None:
+                parts.append("0 violations")
+            w("  slo: " + "  ".join(parts) + "\n")
         q = _value(report, "smp_serve_queue_depth")
         active = _value(report, "smp_serve_slots", state="active")
         total = _value(report, "smp_serve_slots", state="total")

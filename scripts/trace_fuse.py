@@ -15,8 +15,10 @@ Usage:
 
 Output: ONE Perfetto/chrome://tracing-loadable JSON — one pid per rank
 (named ``rank N``), per-rank tracks preserved (pipeline/host/sync/...),
-flight-recorder events as instants on a ``flight_recorder`` track — with
-every rank's clock aligned:
+flight-recorder events as instants on a ``flight_recorder`` track, and
+serving request traces (recorder ``serve`` events) paired into duration
+spans on one lane per decode slot (``slot N``; pre-admission queue wait
+on a ``serve queue`` lane) — with every rank's clock aligned:
 
 1. each stream carries a wall-clock anchor (the
    ``smp_clock_anchor/<unix_us>/<rank>`` instant / the recorder meta's
@@ -255,6 +257,102 @@ def align(streams):
 # ----------------------------------------------------------------------
 
 
+def serve_request_spans(events):
+    """Pair serving trace events (recorder kind ``serve``) into
+    per-request spans.
+
+    Returns ``(spans, chunk_marks, findings)``: ``spans`` are dicts with
+    name/tid/ts/dur/args — the pre-admission wait as ``queued:<rid>`` on
+    the ``serve queue`` lane, then ``prefill:<rid>`` (admission -> first
+    token) and ``decode:<rid>`` (first token -> finished) on the
+    request's ``slot <n>`` lane, so Perfetto shows one span lane per
+    decode slot with requests succeeding each other on it.
+    ``chunk_marks`` pass ``prefill_chunk`` events through as instants on
+    the slot lane. ``findings`` are human-readable problems: events out
+    of lifecycle order, or spans left open (a request admitted but never
+    finished in this ring — e.g. in flight on the replica that died).
+    Events are grouped by TRACE id, not request id: a failover
+    re-admission continues the original trace."""
+    order = {"queued": 0, "admitted": 1, "readmitted": 1,
+             "prefill_chunk": 2, "first_token": 3, "finished": 4}
+    by_trace = {}
+    for ev in events:
+        key = ev.get("trace") or ev.get("rid") or "?"
+        by_trace.setdefault(key, []).append(ev)
+    spans, chunk_marks, findings = [], [], []
+    for trace in sorted(by_trace):
+        evs = sorted(
+            by_trace[trace],
+            key=lambda e: (e.get("ts_us", 0.0), e.get("id", 0)),
+        )
+        names = [e.get("event") for e in evs]
+        ranks = [order.get(n, 99) for n in names]
+        if any(b < a for a, b in zip(ranks, ranks[1:])):
+            findings.append(
+                f"trace {trace}: events out of lifecycle order: {names}"
+            )
+        rid = evs[0].get("rid", trace)
+        t_queued = t_admit = t_first = None
+        slot = -1
+        for ev in evs:
+            e, ts = ev.get("event"), ev.get("ts_us", 0.0)
+            args = {"rid": rid, "trace": trace}
+            if e == "queued":
+                t_queued = ts
+            elif e in ("admitted", "readmitted"):
+                slot = ev.get("slot", -1)
+                if t_queued is not None:
+                    spans.append({
+                        "name": f"queued:{rid}", "tid": "serve queue",
+                        "ts": t_queued, "dur": ts - t_queued,
+                        "args": dict(args, admission=e),
+                    })
+                    t_queued = None
+                t_admit = ts
+            elif e == "prefill_chunk":
+                chunk_marks.append(ev)
+            elif e == "first_token":
+                if t_admit is not None:
+                    spans.append({
+                        "name": f"prefill:{rid}", "tid": f"slot {slot}",
+                        "ts": t_admit, "dur": ts - t_admit, "args": args,
+                    })
+                    t_admit = None
+                t_first = ts
+            elif e == "finished":
+                if t_first is not None:
+                    spans.append({
+                        "name": f"decode:{rid}", "tid": f"slot {slot}",
+                        "ts": t_first, "dur": ts - t_first, "args": args,
+                    })
+                    t_first = None
+                elif t_admit is not None:
+                    # Finished during prefill (EOS on the first sample
+                    # never happens, but deadline eviction could): close
+                    # the admitted span.
+                    spans.append({
+                        "name": f"prefill:{rid}", "tid": f"slot {slot}",
+                        "ts": t_admit, "dur": ts - t_admit, "args": args,
+                    })
+                    t_admit = None
+                elif t_queued is not None:
+                    # Fully-resumed re-admission: finished straight from
+                    # the queue without touching a slot.
+                    spans.append({
+                        "name": f"resumed:{rid}", "tid": "serve queue",
+                        "ts": t_queued, "dur": ts - t_queued, "args": args,
+                    })
+                    t_queued = None
+        for edge, t in (("queued", t_queued), ("admitted", t_admit),
+                        ("decoding", t_first)):
+            if t is not None:
+                findings.append(
+                    f"trace {trace} ({rid}): span left open after "
+                    f"'{edge}' — the request never finished in this ring"
+                )
+    return spans, chunk_marks, findings
+
+
 def fuse(streams):
     out = []
     ranks = sorted({s.rank for s in streams})
@@ -270,8 +368,34 @@ def fuse(streams):
                     ev["ts"] = ev["ts"] + s.offset_us
                 out.append(ev)
         elif s.kind == "recorder":
+            # Serving trace events become duration spans on per-slot
+            # lanes instead of instants on the flight_recorder track.
+            serve_events = [e for e in s.events
+                            if e.get("kind") == "serve"]
+            if serve_events:
+                spans, chunk_marks, _ = serve_request_spans(serve_events)
+                for sp in spans:
+                    out.append({
+                        "name": sp["name"], "ph": "X",
+                        "ts": sp["ts"] + s.offset_us,
+                        "dur": max(sp["dur"], 1.0),
+                        "pid": s.rank, "tid": sp["tid"],
+                        "args": sp["args"],
+                    })
+                for ev in chunk_marks:
+                    out.append({
+                        "name": f"prefill_chunk:{ev.get('rid', '?')}",
+                        "ph": "i",
+                        "ts": ev.get("ts_us", 0.0) + s.offset_us,
+                        "pid": s.rank,
+                        "tid": f"slot {ev.get('slot', -1)}", "s": "t",
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("ts_us", "id")},
+                    })
             for ev in s.events:
                 kind = ev.get("kind", "?")
+                if kind == "serve":
+                    continue
                 name = kind
                 if kind == "collective":
                     name = f"{ev.get('op', '?')}#{ev.get('seq', '?')}"
@@ -433,6 +557,38 @@ def schedule_slot_table(streams):
     return counts, truncated
 
 
+def serve_trace_table(streams):
+    """Per-rank serving-trace summary over recorder ``serve`` events:
+    {rank: {"requests", "spans", "open", "slots", "findings"}}. ``open``
+    counts spans left unclosed (requests that never finished in that
+    rank's ring)."""
+    rows = {}
+    for s in streams:
+        if s.kind != "recorder":
+            continue
+        events = [e for e in s.events if e.get("kind") == "serve"]
+        if not events:
+            continue
+        spans, _, findings = serve_request_spans(events)
+        entry = rows.setdefault(
+            s.rank,
+            {"requests": 0, "spans": 0, "open": 0, "slots": set(),
+             "findings": []},
+        )
+        entry["requests"] += len(
+            {e.get("trace") or e.get("rid") for e in events}
+        )
+        entry["spans"] += len(spans)
+        entry["open"] += sum(1 for f in findings if "left open" in f)
+        entry["slots"].update(
+            sp["tid"] for sp in spans if sp["tid"].startswith("slot ")
+        )
+        entry["findings"].extend(findings)
+    for entry in rows.values():
+        entry["slots"] = sorted(entry["slots"])
+    return rows
+
+
 def render_report(streams, clock_table, out=sys.stdout):
     w = out.write
     ranks = sorted({s.rank for s in streams})
@@ -534,6 +690,19 @@ def render_report(streams, clock_table, out=sys.stdout):
             w(f"!! rank(s) {sorted(slot_truncated)}: schedule recording "
               "hit the flight-recorder cap; counts are lower bounds "
               "(raise SMP_FLIGHT_RECORDER_SIZE / record_schedule cap)\n")
+
+    serve_rows = serve_trace_table(streams)
+    if serve_rows:
+        w("\n-- serving request traces --\n")
+        w(f"{'rank':>4}  {'requests':>8}  {'spans':>6}  {'open':>5}  "
+          "slot lanes\n")
+        for rank in sorted(serve_rows):
+            e = serve_rows[rank]
+            lanes = ", ".join(e["slots"]) or "-"
+            w(f"{rank:>4}  {e['requests']:>8}  {e['spans']:>6}  "
+              f"{e['open']:>5}  {lanes}\n")
+            for finding in e["findings"]:
+                w(f"!! rank {rank}: {finding}\n")
 
     findings = desync_check(streams)
     w("\n-- collective consistency --\n")

@@ -25,13 +25,24 @@ through ``exec_cache.aot_compile`` so the PR-11 persistent cache
 warm-starts them and the PR-9 X-ray audits them (including the serving-
 specific replicated-KV-pool detector).
 
-**SLO telemetry.** Time-to-first-token, inter-token latency, queue
-depth, requests/sec and tokens/sec(/chip), and KV-pool occupancy land in
-``smp.telemetry`` gauges (rendered by ``scripts/telemetry_report.py``);
-per-request logs (prompt + sampled tokens) are retained while a request
-is in flight, which is what makes requests RESTARTABLE — the replica-
-failover layer (``serving/replica.py``) re-admits a dead replica's
-unfinished requests from its mirrored logs, idempotent by request id.
+**SLO observability.** Every latency the SLOs care about — queue wait,
+TTFT, ITL, prefill wall, decode-step wall — streams into log-bucketed
+histograms (``utils/telemetry.record_serve_latency``) with p50/p90/p99
+gauges; queue depth and KV-pool occupancy are gauges; windowed rates
+(req/s, tok/s over the last ``SMP_TIMESERIES_INTERVAL`` window, not
+lifetime averages) come from the metrics time-series snapshotter
+(``utils/timeseries.MetricsTimeSeries`` — the autoscaler feed, with
+``SMP_SLO`` verdicts per window). Each request also carries a trace id
+through queued → admitted → prefill chunk → first token → finished as
+flight-recorder events, fused by ``scripts/trace_fuse.py`` into one
+Perfetto span lane per decode slot. All timestamps are host-side reads
+taken after the device call returns — tracing adds no per-token device
+sync. Per-request logs (prompt + sampled tokens) are retained while a
+request is in flight, which is what makes requests RESTARTABLE — the
+replica-failover layer (``serving/replica.py``) re-admits a dead
+replica's unfinished requests from its mirrored logs (trace id
+included, so the resumed stream continues the same trace), idempotent
+by request id.
 
 Sampling parity contract: a request served here produces token-for-token
 what ``smp.generate`` produces for the same prompt at batch size 1 with
@@ -67,11 +78,15 @@ from smdistributed_modelparallel_tpu.utils.exceptions import (
 )
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.utils.telemetry import (
+    record_serve_latency,
     record_serve_occupancy,
     record_serve_programs,
     record_serve_request,
-    record_serve_slo,
     record_serve_tokens,
+    record_serve_trace,
+)
+from smdistributed_modelparallel_tpu.utils.timeseries import (
+    MetricsTimeSeries,
 )
 
 logger = get_logger()
@@ -91,7 +106,11 @@ class ServeRequest:
     re-admits a dead replica's in-flight request: the engine prefills
     prompt+resume and continues the key schedule at index
     ``len(resume_tokens)``, reproducing the exact tokens the dead replica
-    would have produced.
+    would have produced. ``trace_id`` names the request's span trace in
+    the flight-recorder ring (defaults to the request id at submit);
+    failover re-admission carries the original id through the mirror
+    log, so the resumed stream continues the SAME trace on the
+    surviving replica.
     """
 
     request_id: str
@@ -105,18 +124,20 @@ class ServeRequest:
     arrival_s: float = 0.0
     deadline_s: Optional[float] = None
     resume_tokens: Tuple[int, ...] = ()
+    trace_id: Optional[str] = None
 
 
 class _Slot:
     __slots__ = (
-        "req", "sid", "prompt_full", "resume_len", "pos", "new_tokens",
-        "state", "rng_data", "t_arrival", "t_admit", "t_first_token",
-        "t_last_token", "itl_sum", "itl_n",
+        "req", "sid", "idx", "prompt_full", "resume_len", "pos",
+        "new_tokens", "state", "rng_data", "t_arrival", "t_admit",
+        "t_first_token", "t_last_token",
     )
 
-    def __init__(self, req, rng_data, t_arrival, t_admit):
+    def __init__(self, req, rng_data, t_arrival, t_admit, idx):
         self.req = req
         self.sid = req.request_id
+        self.idx = idx                   # decode-slot index (trace lane)
         self.prompt_full = list(map(int, req.prompt)) + list(
             map(int, req.resume_tokens)
         )
@@ -129,8 +150,6 @@ class _Slot:
         self.t_admit = t_admit
         self.t_first_token = None
         self.t_last_token = None
-        self.itl_sum = 0.0
-        self.itl_n = 0
 
     @property
     def sample_index(self):
@@ -281,11 +300,6 @@ class ServingEngine:
         self._arrival_s = {}     # rid -> effective arrival (engine clock)
         self._occupancy_snap = None
         self.last_tick_worked = True
-        # Sliding window behind the throughput gauges: (finish time,
-        # generated tokens) per completed request. Lifetime averages
-        # would decay toward zero across idle gaps on a long-lived
-        # engine, which is exactly when an operator reads them.
-        self._finish_window = collections.deque(maxlen=256)
         self.mirror_log = {}     # rid -> restartable record (failover)
         self._dirty = set()      # rids with unmirrored progress
         self._admit_order = []   # rids in admission order (chaos seam)
@@ -293,13 +307,23 @@ class ServingEngine:
         self.audits = {}         # program kind -> ProgramAudit | None
         self.stats = collections.Counter()
         self._t0 = None
-        self._ttft_sum = 0.0
-        self._ttft_n = 0
-        self._itl_sum = 0.0
-        self._itl_n = 0
         self._gen_tokens = 0
         self._cache = self._init_cache()
         self._chips = max(len(jax.local_devices()), 1)
+        # Metrics time-series snapshotter (the autoscaler feed):
+        # SMP_TIMESERIES_INTERVAL=0 (the default) constructs NOTHING —
+        # no ring, no thread. When armed, the engine also polls it from
+        # the tick path so window edges stay sharp while the loop is
+        # busy; the thread only covers idle gaps.
+        self.timeseries = MetricsTimeSeries.from_env(chips=self._chips)
+        if self.timeseries is not None:
+            self.timeseries.start()
+
+    def close(self):
+        """Stop the time-series snapshotter thread, if armed. Idempotent;
+        the engine remains usable (sampling continues via tick polling)."""
+        if self.timeseries is not None:
+            self.timeseries.stop()
 
     # -- device state ---------------------------------------------------
 
@@ -460,6 +484,7 @@ class ServingEngine:
                 f"per-sequence table capacity "
                 f"({self.max_blocks_per_seq * self.bt})."
             )
+        req.trace_id = req.trace_id or req.request_id
         if len(req.resume_tokens) >= req.max_new_tokens:
             # Nothing left to generate: the dead replica had finished
             # sampling but not reported — complete it locally.
@@ -467,6 +492,11 @@ class ServingEngine:
             self.finished.add(req.request_id)
             self._mirror(req, list(req.resume_tokens), done=True)
             record_serve_request("finished")
+            record_serve_trace("queued", req.request_id, trace=req.trace_id)
+            record_serve_trace(
+                "finished", req.request_id, trace=req.trace_id,
+                pos=len(req.resume_tokens), detail="fully_resumed",
+            )
             return True
         self._queue.append(req)
         # A live submission "arrives" NOW (long-lived engine clock);
@@ -479,6 +509,7 @@ class ServingEngine:
         # requests still queued must not lose them — the survivor
         # re-admits queued and in-flight requests alike.
         self._mirror(req, list(req.resume_tokens), done=False)
+        record_serve_trace("queued", req.request_id, trace=req.trace_id)
         return True
 
     def _rng_schedule(self, req):
@@ -509,6 +540,10 @@ class ServingEngine:
             "deadline_s": req.deadline_s,
             "tokens": list(map(int, tokens)),
             "done": bool(done),
+            # Trace continuity across failover: the surviving replica
+            # re-admits under the SAME trace id, so the fused timeline
+            # shows one request spanning both replicas' rings.
+            "trace_id": req.trace_id or rid,
         }
         self._dirty.add(rid)
 
@@ -552,14 +587,21 @@ class ServingEngine:
             self._queue.popleft()
             self._arrival_s.pop(req.request_id, None)
             self.alloc.reserve(req.request_id, need)
+            idx = free[0]
             slot = _Slot(
                 req, self._rng_schedule(req),
-                t_arrival=arrival, t_admit=now,
+                t_arrival=arrival, t_admit=now, idx=idx,
             )
-            self._slots[free[0]] = slot
+            self._slots[idx] = slot
             self._admit_order.append(req.request_id)
             self._mirror(req, slot.all_tokens, done=False)
             record_serve_request("admitted")
+            record_serve_latency("queue_wait", max(now - arrival, 0.0))
+            record_serve_trace(
+                "readmitted" if slot.resume_len else "admitted",
+                req.request_id, trace=req.trace_id, slot=idx,
+                pos=slot.resume_len,
+            )
             self.stats["admitted"] += 1
             admitted += 1
         return admitted
@@ -586,44 +628,26 @@ class ServingEngine:
         ):
             record_serve_request("deadline_miss")
         self.stats["finished"] += 1
-        self._finish_window.append((now, len(slot.new_tokens)))
-        horizon = 30.0
-        while self._finish_window and (
-            now - self._finish_window[0][0] > horizon
-        ):
-            self._finish_window.popleft()
-        # Floor the window span at this request's own service time so a
-        # lone finish reads as its true rate, not tokens / ~0.
-        span = max(
-            now - self._finish_window[0][0], now - slot.t_admit, 1e-3
-        )
-        reqs = len(self._finish_window)
-        toks = sum(n for _, n in self._finish_window)
-        record_serve_slo(
-            requests_per_sec=reqs / span,
-            tokens_per_sec=toks / span,
-            tokens_per_sec_chip=toks / span / self._chips,
+        # Throughput gauges (req/s, tok/s) are owned by the time-series
+        # snapshotter now: counter deltas over its window, not lifetime
+        # or ad-hoc sliding averages.
+        record_serve_trace(
+            "finished", rid, trace=slot.req.trace_id, slot=slot.idx,
+            pos=len(slot.all_tokens),
         )
 
     def _on_token(self, slot, tok, now):
         first = slot.t_first_token is None
         if first:
             slot.t_first_token = now
-            ttft = now - slot.t_arrival
-            self._ttft_sum += ttft
-            self._ttft_n += 1
-            record_serve_slo(
-                ttft_s=ttft, ttft_mean_s=self._ttft_sum / self._ttft_n
+            record_serve_latency("ttft", now - slot.t_arrival)
+            record_serve_latency("prefill", now - slot.t_admit)
+            record_serve_trace(
+                "first_token", slot.sid, trace=slot.req.trace_id,
+                slot=slot.idx, pos=slot.sample_index,
             )
         else:
-            itl = now - slot.t_last_token
-            slot.itl_sum += itl
-            slot.itl_n += 1
-            self._itl_sum += itl
-            self._itl_n += 1
-            record_serve_slo(
-                itl_s=itl, itl_mean_s=self._itl_sum / self._itl_n
-            )
+            record_serve_latency("itl", now - slot.t_last_token)
         slot.t_last_token = now
         slot.new_tokens.append(int(tok))
         self._gen_tokens += 1
@@ -660,6 +684,7 @@ class ServingEngine:
             temps[i], top_ks[i], top_ps[i] = self._sampling_row(slot)
             kd[i] = slot.rng_data[slot.sample_index]
         program = self._program("decode")
+        t_dispatch = self._now()
         with profiling.region("serve/decode_step"):
             sampled, self._cache = program(
                 self.params, self._cache, toks, positions, tables, temps,
@@ -668,8 +693,11 @@ class ServingEngine:
         sampled = np.asarray(sampled)
         self.stats["decode_steps"] += 1
         # Token timestamps read the clock AFTER the device call — the
-        # dispatch+compute wall belongs to this token's latency.
+        # dispatch+compute wall belongs to this token's latency. (The
+        # np.asarray transfer above is the step's natural sync point; no
+        # extra block_until_ready is ever issued on this path.)
         now = self._now()
+        record_serve_latency("decode_step", max(now - t_dispatch, 0.0))
         for i, slot in active:
             slot.pos += 1
             if self._on_token(slot, sampled[i], now):
@@ -710,6 +738,10 @@ class ServingEngine:
         slot.pos += valid
         self.stats["prefill_chunks"] += 1
         record_serve_tokens("prompt", valid)
+        record_serve_trace(
+            "prefill_chunk", slot.sid, trace=slot.req.trace_id,
+            slot=slot.idx, pos=slot.pos, detail=f"valid={valid}",
+        )
         if slot.pos >= P:
             # Prompt fully cached: the program's sample from the last
             # real position is the stream's first token (TTFT).
@@ -764,6 +796,8 @@ class ServingEngine:
         chaos.on_serve_decode(self._progress_of_admitted)
         worked = self._prefill_tick() or worked
         self._publish_occupancy()
+        if self.timeseries is not None:
+            self.timeseries.maybe_sample()
         self.last_tick_worked = worked
         return self.busy
 

@@ -206,6 +206,10 @@ class ReplicatedServingEngine:
                 seed=rec.get("seed", 0),
                 deadline_s=rec.get("deadline_s"),
                 resume_tokens=tuple(rec.get("tokens", ())),
+                # Continue the dead replica's trace: the fused timeline
+                # shows one request spanning both rings instead of a new
+                # request materializing on the survivor.
+                trace_id=rec.get("trace_id"),
             )
             if self.engine.submit(req):
                 readmitted[rid] = len(req.resume_tokens)

@@ -53,7 +53,10 @@ from smdistributed_modelparallel_tpu.utils import profiling
 from smdistributed_modelparallel_tpu.utils.exceptions import StepUsageError
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
-from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
+from smdistributed_modelparallel_tpu.utils.telemetry import (
+    record_step_time,
+    telemetry,
+)
 from smdistributed_modelparallel_tpu.nn.utils import half_cast as half_cast_util
 
 logger = get_logger()
@@ -173,6 +176,10 @@ class StepFunction:
         telemetry.histogram(
             "smp_step_dispatch_seconds", "host wall time per step dispatch"
         ).observe(t_step)
+        # Log-bucketed distribution + p50/p90/p99 gauges: the coarse
+        # dispatch histogram above keeps its legacy buckets; this one
+        # resolves tail steps (a p99 blowup is invisible in the mean).
+        record_step_time(t_step)
         profiling.capture.on_step_end(state.step_count, outputs=outputs)
         if exact_time:
             # smp_mfu / smp_roofline_* gauges for this program, from its

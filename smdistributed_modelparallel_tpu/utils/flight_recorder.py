@@ -69,6 +69,7 @@ HEALTH = "health"
 PREEMPT = "preempt"
 CHAOS = "chaos"
 SUPERVISOR = "supervisor"
+SERVE = "serve"
 
 # Field names per kind, applied at dump time (the ring stores bare
 # tuples). Keeping the schema here — not at the record sites — is what
@@ -87,6 +88,7 @@ _FIELDS = {
     PREEMPT: ("event", "step", "detail"),
     CHAOS: ("fault", "detail"),
     SUPERVISOR: ("event", "peer", "detail", "wall_us"),
+    SERVE: ("event", "rid", "trace", "slot", "pos", "detail"),
 }
 
 
@@ -263,6 +265,20 @@ class FlightRecorder:
             return
         self.record(SUPERVISOR, str(event), int(peer), str(detail),
                     int(time.time() * 1e6))
+
+    def record_serve(self, event, rid, trace=None, slot=-1, pos=-1,
+                     detail=""):
+        """Per-request serving span edges (serving/engine.py): queued /
+        admitted / readmitted / prefill_chunk / first_token / finished.
+        ``trace`` is the request's trace id (defaulting to the request
+        id; preserved across failover re-admission via the mirror log,
+        so both replicas' rings carry the same trace key) and ``slot``
+        the decode-slot index — the lane ``scripts/trace_fuse.py`` draws
+        the request's spans on."""
+        if not self.enabled:
+            return
+        self.record(SERVE, str(event), str(rid), str(trace or rid),
+                    int(slot), int(pos), str(detail))
 
     def last_seq(self, group):
         """The group's current collective sequence number (the seq the

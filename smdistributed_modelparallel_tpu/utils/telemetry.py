@@ -57,6 +57,62 @@ DEFAULT_BUCKETS = (
 )
 
 
+def _geometric_buckets(lo, hi, growth):
+    """Geometric bucket boundaries ``lo * growth**i`` up to the first
+    boundary >= ``hi``. Deterministic (the same tuple in every process),
+    which is what makes per-rank histogram dumps mergeable by
+    element-wise count addition."""
+    out = []
+    b = float(lo)
+    while b < hi:
+        out.append(round(b, 9))
+        b *= growth
+    out.append(round(b, 9))
+    return tuple(out)
+
+
+# Log-spaced buckets behind the streaming percentile histograms:
+# 0.5 ms .. ~4 min at 1.3x growth (~50 buckets — fixed memory however
+# many samples stream through). Serving latencies (queue wait, TTFT,
+# ITL, prefill, decode step) and training step times all live in this
+# range; the relative quantile error is bounded by the growth factor.
+LATENCY_BUCKETS = _geometric_buckets(5e-4, 240.0, 1.3)
+
+#: Serving latency distributions the engine feeds (the ``kind`` label of
+#: ``smp_serve_latency_seconds`` and the stem of the per-kind gauges).
+SERVE_LATENCY_KINDS = ("ttft", "itl", "queue_wait", "prefill",
+                       "decode_step")
+
+
+def quantile_from_counts(buckets, counts, q):
+    """Estimate the q-quantile (0..1) of a bucketed distribution.
+
+    Log-interpolates inside geometric buckets (linearly inside the first
+    bucket, which starts at 0); the overflow bucket clamps to the last
+    boundary. Returns None for an empty histogram. Operates on the
+    (buckets, counts) lists a histogram snapshot/dump carries, so report
+    scripts can compute percentiles of cross-rank MERGED counts with the
+    same arithmetic (``scripts/telemetry_report.py`` keeps a stdlib
+    copy)."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = min(max(float(q), 0.0), 1.0) * total
+    acc = 0.0
+    for i, c in enumerate(counts):
+        if c > 0 and acc + c >= target:
+            if i >= len(buckets):
+                return float(buckets[-1])
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            f = (target - acc) / c
+            if lo > 0.0:
+                return float(lo * (hi / lo) ** f)
+            return float(lo + (hi - lo) * f)
+        acc += c
+    return float(buckets[-1])
+
+
 def _label_key(labels):
     return tuple(sorted(labels.items()))
 
@@ -915,39 +971,105 @@ def record_serve_tokens(kind, n):
         ).labels(kind=kind).inc(int(n))
 
 
-def record_serve_slo(ttft_s=None, itl_s=None, ttft_mean_s=None,
-                     itl_mean_s=None, requests_per_sec=None,
-                     tokens_per_sec=None, tokens_per_sec_chip=None):
-    """Serving SLO gauges — time-to-first-token and inter-token latency
-    (last + running mean), plus throughput (engine-wide and per local
-    chip). Updated by the engine as requests produce tokens/finish."""
-    g_ttft = telemetry.gauge(
-        "smp_serve_ttft_seconds",
-        "time to first token (arrival -> first sampled token)",
+_SERVE_LATENCY_HELP = {
+    "ttft": "time to first token (arrival -> first sampled token)",
+    "itl": "inter-token latency of decode streams",
+    "queue_wait": "queue wait (arrival -> decode-slot admission)",
+    "prefill": "prompt prefill wall (admission -> first token sampled)",
+    "decode_step": "batched decode-step dispatch wall",
+}
+
+
+def record_serve_latency(kind, seconds):
+    """One serving latency sample, ``kind`` in SERVE_LATENCY_KINDS.
+
+    Feeds the streaming log-bucketed histogram
+    ``smp_serve_latency_seconds{kind=...}`` (fixed memory, mergeable
+    across ranks — ``scripts/telemetry_report.py`` sums bucket counts
+    element-wise because every rank uses the same LATENCY_BUCKETS tuple)
+    and derives the per-kind gauge family
+    ``smp_serve_<kind>_seconds{stat=last|mean|p50|p90|p99}``. The
+    ``last``/``mean`` stats keep the pre-histogram names and meanings
+    (mean is the histogram's lifetime sum/count), so existing dashboards
+    and the PR-14 serving tests keep reading the same series."""
+    v = float(seconds)
+    child = telemetry.histogram(
+        "smp_serve_latency_seconds",
+        "serving latency distributions by kind (the log-bucketed "
+        "streaming histogram behind the percentile gauges)",
+        buckets=LATENCY_BUCKETS,
+    ).labels(kind=kind)
+    child.observe(v)
+    snap = child._snapshot()
+    g = telemetry.gauge(
+        f"smp_serve_{kind}_seconds",
+        _SERVE_LATENCY_HELP.get(kind, "serving latency"),
     )
-    if ttft_s is not None:
-        g_ttft.labels(stat="last").set(float(ttft_s))
-    if ttft_mean_s is not None:
-        g_ttft.labels(stat="mean").set(float(ttft_mean_s))
-    g_itl = telemetry.gauge(
-        "smp_serve_itl_seconds", "inter-token latency of decode streams"
+    g.labels(stat="last").set(v)
+    g.labels(stat="mean").set(snap["sum"] / max(snap["count"], 1))
+    for stat, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+        est = quantile_from_counts(snap["buckets"], snap["counts"], q)
+        if est is not None:
+            g.labels(stat=stat).set(est)
+
+
+def serve_latency_summary(kind, qs=(0.5, 0.9, 0.99)):
+    """``{"count", "mean_s", "quantiles_s": {q: seconds}}`` of one
+    serving latency distribution, or None before its first sample
+    (bench.py stamps the serve probe's percentile columns from this)."""
+    with telemetry._lock:
+        fam = telemetry._families.get("smp_serve_latency_seconds")
+    if fam is None:
+        return None
+    snap = fam.labels(kind=kind)._snapshot()
+    if not snap["count"]:
+        return None
+    return {
+        "count": snap["count"],
+        "mean_s": snap["sum"] / snap["count"],
+        "quantiles_s": {
+            q: quantile_from_counts(snap["buckets"], snap["counts"], q)
+            for q in qs
+        },
+    }
+
+
+def record_step_time(seconds):
+    """One training-step wall-time sample into the log-bucketed step-time
+    histogram ``smp_step_time_seconds`` plus p50/p90/p99 gauges — the
+    training-path counterpart of the serving latency distributions (a
+    p99 step blowup is invisible in the dispatch-seconds mean)."""
+    v = float(seconds)
+    child = telemetry.histogram(
+        "smp_step_time_seconds",
+        "per-step dispatch wall-time distribution (log-bucketed)",
+        buckets=LATENCY_BUCKETS,
+    ).labels()
+    child.observe(v)
+    snap = child._snapshot()
+    g = telemetry.gauge(
+        "smp_step_time_quantile_seconds",
+        "step wall-time percentiles from the streaming histogram",
     )
-    if itl_s is not None:
-        g_itl.labels(stat="last").set(float(itl_s))
-    if itl_mean_s is not None:
-        g_itl.labels(stat="mean").set(float(itl_mean_s))
-    if requests_per_sec is not None:
-        telemetry.gauge(
-            "smp_serve_requests_per_sec", "completed requests per second"
-        ).set(float(requests_per_sec))
-    if tokens_per_sec is not None:
-        telemetry.gauge(
-            "smp_serve_tokens_per_sec", "generated tokens per second"
-        ).labels(scope="engine").set(float(tokens_per_sec))
-    if tokens_per_sec_chip is not None:
-        telemetry.gauge(
-            "smp_serve_tokens_per_sec", "generated tokens per second"
-        ).labels(scope="chip").set(float(tokens_per_sec_chip))
+    for stat, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+        est = quantile_from_counts(snap["buckets"], snap["counts"], q)
+        if est is not None:
+            g.labels(stat=stat).set(est)
+
+
+def record_serve_trace(event, rid, trace=None, slot=-1, pos=-1, detail=""):
+    """One per-request serving span edge (``queued`` / ``admitted`` /
+    ``readmitted`` / ``prefill_chunk`` / ``first_token`` / ``finished``)
+    into the flight-recorder ring. Host-side timestamps only — recording
+    costs one perf_counter read and a deque append, and a disabled ring
+    (``SMP_FLIGHT_RECORDER_SIZE=0``) short-circuits to an attribute
+    test. ``scripts/trace_fuse.py`` pairs the edges into one Perfetto
+    span lane per decode slot; the trace id rides the failover mirror
+    log, so a re-admitted request continues its original trace on the
+    surviving replica."""
+    _flight().record_serve(
+        event, rid, trace=trace, slot=slot, pos=pos, detail=detail
+    )
 
 
 def record_serve_occupancy(queue_depth, active_slots, total_slots,
