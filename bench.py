@@ -901,16 +901,31 @@ def _serve_probe(deadline):
             "bench: serve probe skipped (probe window exhausted)\n"
         )
         return None
-    # Arm the time-series feed for the probe run (caller env wins);
-    # restored in the finally so the probe leaves no trace in os.environ.
+    # Arm the time-series feed AND the fleet metrics plane for the probe
+    # run (caller env wins); restored in the finally so the probe leaves
+    # no trace in os.environ. SMP_METRICS_PORT=0 binds an ephemeral port
+    # so the probe can round-trip the /fleet scrape endpoint.
     ts_env_prev = {
         k: os.environ.get(k)
-        for k in ("SMP_TIMESERIES_INTERVAL", "SMP_TIMESERIES_PATH")
+        for k in ("SMP_TIMESERIES_INTERVAL", "SMP_TIMESERIES_PATH",
+                  "SMP_FLEET_INTERVAL", "SMP_FLEET_PATH",
+                  "SMP_METRICS_PORT")
     }
     os.environ.setdefault("SMP_TIMESERIES_INTERVAL", "0.1")
     os.environ.setdefault(
         "SMP_TIMESERIES_PATH", "smp_serve_timeseries.jsonl"
     )
+    os.environ.setdefault("SMP_FLEET_INTERVAL", "0.1")
+    os.environ.setdefault("SMP_FLEET_PATH", "smp_fleet_windows.jsonl")
+    os.environ.setdefault("SMP_METRICS_PORT", "0")
+    if ts_env_prev["SMP_FLEET_PATH"] is None:
+        # The fleet feed is append-only by design (it must survive
+        # aggregator failover); when the probe owns the path, start it
+        # fresh so the stamped window count is this run's.
+        try:
+            os.remove(os.environ["SMP_FLEET_PATH"])
+        except OSError:
+            pass
     engine = None
     try:
         import jax as _jax
@@ -1086,6 +1101,46 @@ def _serve_probe(deadline):
             sys.stderr.write(
                 f"bench: serve trace artifacts skipped ({te!r})\n"
             )
+
+        # Fleet metrics plane block: windows aggregated, straggler
+        # verdicts, and a live round-trip of the /fleet scrape endpoint.
+        # Best-effort like the trace artifacts.
+        try:
+            from smdistributed_modelparallel_tpu.utils.fleet import (
+                fleet as _fleet,
+            )
+
+            plane = _fleet.plane
+            if plane is not None:
+                plane.tick()  # ensure at least one window post-burst
+                fleet_block = {
+                    "windows": len(plane.windows()),
+                    "ranks": plane.world,
+                    "stragglers": sorted(plane.straggling),
+                }
+                if plane.bound_port:
+                    import urllib.request
+
+                    t_rt = time.perf_counter()
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{plane.bound_port}/fleet",
+                        timeout=10,
+                    ) as resp:
+                        doc = json.loads(resp.read())
+                    fleet_block["endpoint_roundtrip_ms"] = round(
+                        1e3 * (time.perf_counter() - t_rt), 3
+                    )
+                    ttft_doc = doc.get("percentiles", {}).get("ttft")
+                    if ttft_doc and ttft_doc.get("p99_s") is not None:
+                        fleet_block["endpoint_ttft_p99_ms"] = round(
+                            1e3 * ttft_doc["p99_s"], 3
+                        )
+                last = (plane.windows() or [{}])[-1]
+                if last.get("slo"):
+                    fleet_block["goodput"] = last["slo"].get("goodput")
+                result["fleet"] = fleet_block
+        except Exception as fe:
+            sys.stderr.write(f"bench: fleet block skipped ({fe!r})\n")
 
         sys.stderr.write(json.dumps(result) + "\n")
         sys.stderr.flush()

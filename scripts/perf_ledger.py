@@ -323,6 +323,23 @@ def _serve_probe_schema_problem(probe):
                 and pcts["p99"] < pcts["p50"] - 1e-9):
             return (f"'serving.{kind}_p99_ms' < '{kind}_p50_ms' — "
                     "percentiles are not monotonic")
+    # Fleet metrics-plane sub-block: optional (rounds predating the
+    # fleet plane, or SMP_FLEET_INTERVAL off), but when present it must
+    # show a live plane — at least one aggregated window, numeric
+    # endpoint round-trip when the scrape server bound, and straggler
+    # verdicts as a list of ranks.
+    fb = probe.get("fleet")
+    if fb is not None:
+        if not isinstance(fb, dict):
+            return "'serving.fleet' must be an object"
+        if not isinstance(fb.get("windows"), (int, float)) \
+                or fb["windows"] < 1:
+            return "'serving.fleet.windows' must be a count >= 1"
+        if not isinstance(fb.get("stragglers"), list):
+            return "'serving.fleet.stragglers' must be a list of ranks"
+        rt = fb.get("endpoint_roundtrip_ms")
+        if rt is not None and not isinstance(rt, (int, float)):
+            return "'serving.fleet.endpoint_roundtrip_ms' must be numeric"
     return None
 
 
@@ -599,6 +616,22 @@ def render_table(ledger, out=sys.stdout):
                         f" (open spans {sprobe.get('trace_open_spans', 0)})"
                     )
                 w(f"{'':>7}serving timeseries: " + "  ".join(parts) + "\n")
+            fb = sprobe.get("fleet")
+            if isinstance(fb, dict):
+                parts = [f"{fb.get('windows', 0)} window(s)",
+                         f"ranks {fb.get('ranks', 1)}"]
+                if fb.get("endpoint_roundtrip_ms") is not None:
+                    parts.append(
+                        f"scrape rt {fb['endpoint_roundtrip_ms']:.1f}ms"
+                    )
+                stragglers = fb.get("stragglers") or []
+                parts.append(
+                    "stragglers " + (",".join(map(str, stragglers))
+                                     if stragglers else "none")
+                )
+                if fb.get("goodput") is not None:
+                    parts.append(f"goodput {100 * fb['goodput']:.0f}%")
+                w(f"{'':>7}serving fleet: " + "  ".join(parts) + "\n")
         zprobe = r.get("zero_probe")
         if isinstance(zprobe, dict):
             parts = [

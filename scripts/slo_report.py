@@ -8,6 +8,8 @@ Usage:
     python scripts/slo_report.py ts.jsonl --check                 # CI gate
     python scripts/slo_report.py ts.jsonl --check --min-goodput 0.95
     python scripts/slo_report.py dumps/                           # rank files
+    python scripts/slo_report.py smp_fleet_windows.jsonl --fleet  # fleet feed
+    python scripts/slo_report.py dumps/ --fleet --slo "ttft_p99_ms=500"
 
 Inputs are the ``serve_window`` JSONL records the engine's time-series
 snapshotter appends when ``SMP_TIMESERIES_PATH`` is set
@@ -24,6 +26,16 @@ exit 0 when the goodput fraction (windows with zero violations /
 windows) is at least ``--min-goodput`` (default 1.0), 1 when below, 2
 when there is nothing to evaluate (no windows, or neither ``--slo`` nor
 embedded verdicts).
+
+``--fleet`` evaluates at FLEET level instead: inputs are the
+``fleet_window`` records the fleet aggregator appends to
+``SMP_FLEET_PATH`` (utils/fleet.py — merged-bucket percentiles across
+every alive rank, same exit-code contract, so CI can gate on fleet
+goodput). When the inputs hold no fleet windows but do hold per-rank
+telemetry dumps, one cumulative fleet window is synthesized by merging
+them with ``utils/telemetry.merge_metric_reports`` — the same function
+the live aggregator runs, so the offline verdict matches the on-fleet
+one bit for bit (this one path needs the package importable).
 
 Stdlib only — runnable anywhere the JSONL can be copied to. The SLO key
 grammar duplicates ``utils/timeseries.parse_slo`` on purpose: this
@@ -87,7 +99,7 @@ def evaluate_slo(slo, window):
     return {"ok": not violations, "violations": violations}
 
 
-def load_windows(paths):
+def _expand_files(paths):
     files = []
     for p in paths:
         if os.path.isdir(p):
@@ -97,8 +109,12 @@ def load_windows(paths):
             )
         else:
             files.append(p)
+    return files
+
+
+def load_windows(paths, kind="serve_window"):
     windows = []
-    for f in files:
+    for f in _expand_files(paths):
         try:
             with open(f) as fh:
                 for line in fh:
@@ -110,12 +126,59 @@ def load_windows(paths):
                     except ValueError:
                         continue
                     if (isinstance(rec, dict)
-                            and rec.get("kind") == "serve_window"):
+                            and rec.get("kind") == kind):
                         windows.append(rec)
         except OSError as e:
             sys.stderr.write(f"slo_report: skipping {f}: {e}\n")
     windows.sort(key=lambda wn: (wn.get("t_wall", 0.0), wn.get("seq", 0)))
     return windows
+
+
+def synthesize_fleet_window(paths):
+    """One cumulative fleet window merged from per-rank telemetry dumps,
+    via the package's canonical cross-rank merge (the function the live
+    fleet aggregator runs). Returns None when the inputs hold no dumps
+    or the package is not importable."""
+    reports = []
+    for f in _expand_files(paths):
+        try:
+            with open(f) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and "metrics" in doc:
+            reports.append(doc)
+    if not reports:
+        return None
+    try:
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            merge_metric_reports,
+            quantile_from_counts,
+        )
+    except Exception:
+        sys.stderr.write(
+            "slo_report: found telemetry dumps but the "
+            "smdistributed_modelparallel_tpu package is not importable; "
+            "cannot synthesize a fleet window (run from the repo, or "
+            "feed the SMP_FLEET_PATH JSONL directly)\n"
+        )
+        return None
+    merged = merge_metric_reports(reports)
+    window = {
+        "kind": "fleet_window", "seq": 1, "t_wall": 0.0, "window_s": 0.0,
+        "synthesized": True, "ranks": merged["meta"]["ranks"],
+    }
+    fam = merged.get("metrics", {}).get("smp_serve_latency_seconds")
+    for s in (fam or {}).get("series", []):
+        kind = (s.get("labels") or {}).get("kind")
+        if not kind or s.get("count", 0) <= 0:
+            continue
+        for stat, q in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99)):
+            v = quantile_from_counts(s["buckets"], s["counts"], q)
+            if v is not None:
+                window[f"{kind}_{stat}_ms"] = round(v * 1e3, 3)
+        window[f"{kind}_mean_ms"] = round(s["sum"] / s["count"] * 1e3, 3)
+    return window
 
 
 def main(argv=None):
@@ -135,11 +198,20 @@ def main(argv=None):
     ap.add_argument("--min-goodput", type=float, default=1.0,
                     help="goodput fraction required by --check "
                     "(default %(default)s)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="evaluate fleet_window records (the SMP_FLEET_PATH "
+                    "feed the fleet aggregator writes), synthesizing one "
+                    "from per-rank telemetry dumps if none are present")
     args = ap.parse_args(argv)
 
-    windows = load_windows(args.inputs)
+    kind = "fleet_window" if args.fleet else "serve_window"
+    windows = load_windows(args.inputs, kind=kind)
+    if not windows and args.fleet:
+        synth = synthesize_fleet_window(args.inputs)
+        if synth is not None:
+            windows = [synth]
     if not windows:
-        sys.stderr.write("slo_report: no serve_window records found\n")
+        sys.stderr.write(f"slo_report: no {kind} records found\n")
         return 2
     if args.slo:
         try:
@@ -178,7 +250,7 @@ def main(argv=None):
                 worst[key] = max(worst.get(key, value), value)
 
     w = sys.stdout.write
-    w("=== serving SLO report ===\n")
+    w(f"=== {'fleet' if args.fleet else 'serving'} SLO report ===\n")
     span = windows[-1].get("t_wall", 0.0) - windows[0].get("t_wall", 0.0)
     w(f"{len(windows)} window(s) spanning {span:.1f}s   source: "
       f"{source}\n")

@@ -26,8 +26,12 @@ Given a DIRECTORY, every telemetry dump in it (the per-rank
 is loaded and the report is the cross-rank aggregate: counters and
 histograms summed, gauges maxed (peak-HBM keeps the worst device), plus a
 per-rank table with step counts, phases, and wall-clock skew measured at
-the last shared barrier sync mark. Stdlib only — runnable anywhere the
-JSON can be copied to, no jax required.
+the last shared barrier sync mark. The merge itself is
+``utils/telemetry.merge_metric_reports`` when the package is importable
+(the same function the live fleet aggregator runs, keeping this offline
+view bit-equal to the ``/fleet`` scrape endpoint) with a pinned-equal
+stdlib fallback, so the script stays runnable anywhere the JSON can be
+copied to — no jax required.
 """
 
 import argparse
@@ -733,10 +737,35 @@ def load_rank_dumps(dirpath):
     return reports
 
 
+def _package_merge():
+    """The canonical cross-rank merge lives in
+    ``utils/telemetry.merge_metric_reports`` (shared with the live fleet
+    aggregator, so offline aggregation stays bit-equal to the on-fleet
+    scrape view). This script prefers it when the package is importable
+    next to the dumps and keeps ``_merge_fallback`` below — pinned equal
+    by tests/test_fleet.py — for the copied-off-box, no-jax case the
+    module docstring promises."""
+    try:
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            merge_metric_reports,
+        )
+
+        return merge_metric_reports
+    except Exception:
+        return None
+
+
 def aggregate(reports):
     """One merged report: counters/histogram series summed element-wise
     across ranks, gauges maxed (peak HBM keeps the worst device). Series
     are matched by (metric, label-set)."""
+    merge = _package_merge()
+    if merge is not None:
+        return merge(reports)
+    return _merge_fallback(reports)
+
+
+def _merge_fallback(reports):
     out = {"meta": {"ranks": sorted(reports)}, "metrics": {}}
     for rank in sorted(reports):
         for name, fam in reports[rank].get("metrics", {}).items():

@@ -411,6 +411,13 @@ def fuse(streams):
                         name += f"/c{ev['chunk']}"
                     if ev.get("pass") is not None:
                         name += f"/{ev['pass']}"
+                elif kind == "fleet":
+                    # Fleet metrics-plane edges (election, detector
+                    # fire/clear): name carries the subject rank so a
+                    # straggler verdict lines up against that rank's
+                    # serve spans at a glance.
+                    name = (f"fleet:{ev.get('event', '?')}"
+                            f"@r{ev.get('rank', '?')}")
                 args = {k: v for k, v in ev.items()
                         if k not in ("ts_us", "id")}
                 out.append({

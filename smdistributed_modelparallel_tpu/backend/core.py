@@ -99,6 +99,16 @@ class ModelParallelCore:
         if not self._initialized:
             return
         self._initialized = False
+        # The fleet metrics plane stops FIRST: its final snapshot/window
+        # flush needs the bus, which the exit-status relay below closes,
+        # and its scrape server must be gone before the telemetry dump
+        # becomes this process's record.
+        from smdistributed_modelparallel_tpu.utils.fleet import fleet
+
+        try:
+            fleet.stop()
+        except Exception as e:
+            logger.warning("fleet metrics plane stop failed: %s", e)
         success = self.exit_status()
         if not success:
             logger.error(

@@ -349,16 +349,19 @@ class MessageBus:
     def send_raw(self, dest, payload, tx):
         """Single unadorned enqueue: no chaos seam, no retries, no flight
         recording. Returns the C return code (0 ok, -1 misuse, -2 link
-        dead). The heartbeat path uses this — a periodic beat must not
-        consume chaos bus-send ordinals or flood the flight ring, and a
-        dead-link result is itself the detection signal, not an error."""
+        dead). The heartbeat (tx -4) and fleet metric snapshot (tx -7)
+        paths use this — a periodic beat must not consume chaos bus-send
+        ordinals or flood the flight ring, and a dead-link result is
+        itself the detection signal, not an error."""
         return self._lib.smp_async_send(dest, payload, len(payload), tx)
 
     def drain_bytes(self, src, tx, limit=256):
         """Drain every already-delivered frame for (src, tx) without
         blocking or flight-recording. Heartbeat receive path: beats arrive
         faster than the detector scans, and each scan wants *all* pending
-        beats (the freshest carries the peer's current step edge)."""
+        beats (the freshest carries the peer's current step edge). The
+        fleet aggregator (tx -7) drains the same way — the freshest
+        snapshot per peer wins."""
         out = []
         while len(out) < limit and self._lib.smp_poll_recv(src, tx):
             n = self._lib.smp_wait_recv(src, tx, 0)

@@ -110,6 +110,12 @@ class ModelParallelState:
         # process, bus up); re-arms on a recovery's re-initialize. Off is
         # a hard no-op: no thread, no bus traffic, step path untouched.
         supervisor.start()
+        from smdistributed_modelparallel_tpu.utils.fleet import fleet
+
+        # Fleet metrics plane (SMP_FLEET_INTERVAL): needs the bus AND
+        # the supervisor's liveness verdicts, so it arms after both.
+        # Unset/0 constructs nothing — no thread, no traffic, no port.
+        fleet.start()
         from smdistributed_modelparallel_tpu.utils import profiling
 
         # SIGUSR2 arms a one-step profiler capture on a live run
@@ -139,6 +145,9 @@ class ModelParallelState:
         )
         from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
 
+        from smdistributed_modelparallel_tpu.utils.fleet import fleet
+
+        fleet.reset()
         telemetry.reset()
         flight_recorder.clear()
         health.reset()

@@ -1151,3 +1151,25 @@ def latest_committed_checkpoint(root):
 
 
 supervisor = Supervisor()
+
+
+def classify_failed(bus, peers, kinds=(DEAD, WEDGED)):
+    """Classify which of ``peers`` have failed, as ``{peer: kind}``.
+
+    Combines the heartbeat detector's verdicts (when the supervisor is
+    on) with the bus's own link-death signal — ``peer_down`` catches a
+    closed socket before the miss budget expires, and is the only
+    signal when ``SMP_SUPERVISOR=off``. Shared by replica failover
+    (serving/replica.py) and fleet aggregator election (utils/fleet.py)
+    so both planes agree on who is alive.
+    """
+    peers = set(peers)
+    failed = {}
+    detector = supervisor.detector
+    if detector is not None:
+        failed.update(detector.failures(kinds=kinds))
+    if bus is not None and DEAD in kinds:
+        for p in peers:
+            if p not in failed and bus.peer_down(p):
+                failed[p] = DEAD
+    return {p: k for p, k in failed.items() if p in peers}

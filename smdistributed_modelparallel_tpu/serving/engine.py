@@ -85,6 +85,7 @@ from smdistributed_modelparallel_tpu.utils.telemetry import (
     record_serve_tokens,
     record_serve_trace,
 )
+from smdistributed_modelparallel_tpu.utils.fleet import fleet
 from smdistributed_modelparallel_tpu.utils.timeseries import (
     MetricsTimeSeries,
 )
@@ -798,6 +799,11 @@ class ServingEngine:
         self._publish_occupancy()
         if self.timeseries is not None:
             self.timeseries.maybe_sample()
+        # Same idle-gap contract as the time-series poll above: the
+        # fleet publisher/aggregator ticks inline so a busy decode loop
+        # keeps the fleet feed fresh (no-op when SMP_FLEET_INTERVAL is
+        # off).
+        fleet.tick()
         self.last_tick_worked = worked
         return self.busy
 

@@ -44,7 +44,7 @@ logger = get_logger()
 
 #: Reserved control tx for serving mirror frames (-1 exit relay, -2
 #: preempt notice, -3 preempt step-edge, -4 heartbeats, -5 recovery
-#: rendezvous — see backend/native.py).
+#: rendezvous, -7 fleet metric snapshots — see backend/native.py).
 SERVE_MIRROR_TX = -6
 
 
@@ -150,19 +150,12 @@ class ReplicatedServingEngine:
 
     def _failed_peers(self):
         from smdistributed_modelparallel_tpu.resilience.supervisor import (
-            supervisor,
+            classify_failed,
         )
 
-        failed = {}
-        detector = supervisor.detector
-        if detector is not None:
-            failed.update(detector.failures())
-        for p in self.peers:
-            if p not in failed and self.bus.peer_down(p):
-                failed[p] = "dead"
+        failed = classify_failed(self.bus, self.peers)
         return {
-            p: kind for p, kind in failed.items()
-            if p in self.peers and p not in self._handled
+            p: kind for p, kind in failed.items() if p not in self._handled
         }
 
     def _failover(self, peer, kind):

@@ -70,6 +70,7 @@ PREEMPT = "preempt"
 CHAOS = "chaos"
 SUPERVISOR = "supervisor"
 SERVE = "serve"
+FLEET = "fleet"
 
 # Field names per kind, applied at dump time (the ring stores bare
 # tuples). Keeping the schema here — not at the record sites — is what
@@ -89,6 +90,7 @@ _FIELDS = {
     CHAOS: ("fault", "detail"),
     SUPERVISOR: ("event", "peer", "detail", "wall_us"),
     SERVE: ("event", "rid", "trace", "slot", "pos", "detail"),
+    FLEET: ("event", "rank", "detail", "wall_us"),
 }
 
 
@@ -264,6 +266,19 @@ class FlightRecorder:
         if not self.enabled:
             return
         self.record(SUPERVISOR, str(event), int(peer), str(detail),
+                    int(time.time() * 1e6))
+
+    def record_fleet(self, event, rank=-1, detail=""):
+        """Fleet metrics-plane events (utils/fleet.py): aggregator
+        (re-)election edges and detector transitions — straggler /
+        stale_feed / kv_imbalance firing or clearing. ``rank`` is the
+        subject replica (the new aggregator, the straggler), not the
+        recording rank. Wall-stamped like supervisor events so
+        ``trace_fuse.py`` can line detector fire-times up against the
+        per-request serve spans."""
+        if not self.enabled:
+            return
+        self.record(FLEET, str(event), int(rank), str(detail),
                     int(time.time() * 1e6))
 
     def record_serve(self, event, rid, trace=None, slot=-1, pos=-1,

@@ -34,6 +34,7 @@ an accelerator backend (stdlib + the package logger/exceptions only).
 """
 
 import atexit
+import copy
 import json
 import os
 import sys
@@ -111,6 +112,103 @@ def quantile_from_counts(buckets, counts, q):
             return float(lo + (hi - lo) * f)
         acc += c
     return float(buckets[-1])
+
+
+def merge_metric_reports(reports):
+    """Merge per-rank telemetry reports into ONE fleet-level report:
+    counters and histogram series summed element-wise across ranks
+    (bucket-count addition — every rank shares the same deterministic
+    bucket tuples, so merged percentiles via ``quantile_from_counts``
+    are exact), gauges maxed (peak HBM keeps the worst device). Series
+    are matched by (metric, label-set).
+
+    This is the single cross-rank merge: the live fleet aggregator
+    (``utils/fleet.py``), ``scripts/telemetry_report.py --dir`` and
+    ``scripts/slo_report.py --fleet`` all call it, so an offline merge
+    of per-rank dumps is bit-equal to the on-fleet live view.
+
+    ``reports`` is either ``{rank: report}`` or an iterable of reports
+    (ranks then come from each report's own meta, falling back to load
+    order). Inputs are not mutated.
+    """
+    if isinstance(reports, dict):
+        items = [(r, reports[r]) for r in sorted(reports)]
+    else:
+        items = [
+            (rep.get("meta", {}).get("rank", i) if isinstance(rep, dict)
+             else i, rep)
+            for i, rep in enumerate(reports)
+        ]
+    out = {"meta": {"ranks": [r for r, _ in items]}, "metrics": {}}
+    for _, report in items:
+        for name, fam in report.get("metrics", {}).items():
+            ofam = out["metrics"].setdefault(
+                name, {"kind": fam["kind"], "help": fam.get("help", ""),
+                       "series": []},
+            )
+            for series in fam.get("series", []):
+                key = _label_key(series.get("labels", {}))
+                dst = None
+                for s in ofam["series"]:
+                    if _label_key(s.get("labels", {})) == key:
+                        dst = s
+                        break
+                if dst is None:
+                    ofam["series"].append(copy.deepcopy(series))
+                    continue
+                if fam["kind"] == "histogram":
+                    dst["sum"] = dst.get("sum", 0.0) + series.get("sum", 0.0)
+                    dst["count"] = dst.get("count", 0) + series.get("count", 0)
+                    if dst.get("buckets") == series.get("buckets"):
+                        dst["counts"] = [
+                            a + b for a, b in zip(dst["counts"],
+                                                  series["counts"])
+                        ]
+                    else:
+                        # Mixed-build dumps: sum/count merge fine, the
+                        # per-bucket distribution cannot — say so rather
+                        # than render a distribution that doesn't add up.
+                        logger.warning(
+                            "histogram %s has differing buckets across "
+                            "ranks; merged bucket counts reflect only "
+                            "the first rank", name,
+                        )
+                elif fam["kind"] == "counter":
+                    dst["value"] = dst.get("value", 0) + series.get("value", 0)
+                else:  # gauge: keep the worst rank
+                    dst["value"] = max(dst.get("value", 0),
+                                       series.get("value", 0))
+    return out
+
+
+def render_prometheus_report(report):
+    """Prometheus text exposition of a report dict — the live registry's
+    ``report()`` or a ``merge_metric_reports`` fleet view (the fleet
+    scrape endpoint renders merged metrics through this same path)."""
+    out = []
+    for name, fam in sorted(report.get("metrics", {}).items()):
+        if fam.get("help"):
+            out.append(f"# HELP {name} {fam['help']}")
+        out.append(f"# TYPE {name} {fam['kind']}")
+        for series in fam["series"]:
+            lab = ",".join(
+                f'{k}="{v}"' for k, v in sorted(series["labels"].items())
+            )
+            if fam["kind"] == "histogram":
+                acc = 0
+                for b, c in zip(
+                    list(series["buckets"]) + ["+Inf"], series["counts"]
+                ):
+                    acc += c
+                    ble = (lab + "," if lab else "") + f'le="{b}"'
+                    out.append(f"{name}_bucket{{{ble}}} {acc}")
+                sfx = f"{{{lab}}}" if lab else ""
+                out.append(f"{name}_sum{sfx} {series['sum']}")
+                out.append(f"{name}_count{sfx} {series['count']}")
+            else:
+                sfx = f"{{{lab}}}" if lab else ""
+                out.append(f"{name}{sfx} {series['value']}")
+    return "\n".join(out) + "\n"
 
 
 def _label_key(labels):
@@ -345,31 +443,7 @@ class TelemetryRegistry:
 
     def render_prometheus(self):
         """Prometheus text exposition format (for scraping or eyeballing)."""
-        out = []
-        rep = self.report()
-        for name, fam in sorted(rep["metrics"].items()):
-            if fam["help"]:
-                out.append(f"# HELP {name} {fam['help']}")
-            out.append(f"# TYPE {name} {fam['kind']}")
-            for series in fam["series"]:
-                lab = ",".join(
-                    f'{k}="{v}"' for k, v in sorted(series["labels"].items())
-                )
-                if fam["kind"] == "histogram":
-                    acc = 0
-                    for b, c in zip(
-                        list(series["buckets"]) + ["+Inf"], series["counts"]
-                    ):
-                        acc += c
-                        ble = (lab + "," if lab else "") + f'le="{b}"'
-                        out.append(f"{name}_bucket{{{ble}}} {acc}")
-                    sfx = f"{{{lab}}}" if lab else ""
-                    out.append(f"{name}_sum{sfx} {series['sum']}")
-                    out.append(f"{name}_count{sfx} {series['count']}")
-                else:
-                    sfx = f"{{{lab}}}" if lab else ""
-                    out.append(f"{name}{sfx} {series['value']}")
-        return "\n".join(out) + "\n"
+        return render_prometheus_report(self.report())
 
     def _rank_path(self, path):
         """Multi-process runs write per-rank files: N processes dumping the

@@ -1,0 +1,115 @@
+"""The metric-name registry gate: every ``smp_*`` metric the runtime
+emits must be documented in README's "Metrics registry" table, and every
+table row must still be emitted — renames and removals cannot drift the
+docs (PR-17, satellite 6).
+
+The scanner is AST-based so it sees both direct registrations
+(``telemetry.gauge("smp_x", ...)``, f-strings become ``*`` wildcards)
+and table-driven ones (``telemetry.gauge(metric, help_)`` where
+``metric`` iterates a literal tuple, e.g. the roofline publisher): any
+function that registers via a bare variable contributes every
+``smp_[a-z0-9_]+`` string constant it contains.
+"""
+
+import ast
+import pathlib
+import re
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+_PKG = _REPO / "smdistributed_modelparallel_tpu"
+_README = _REPO / "README.md"
+
+_REG_METHODS = ("counter", "gauge", "histogram")
+_NAME_RE = re.compile(r"smp_[a-z0-9_]+")
+
+
+def _emitted_names():
+    names = set()
+    for path in sorted(_PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REG_METHODS
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if arg.value.startswith("smp_"):
+                    names.add(arg.value)
+            elif isinstance(arg, ast.JoinedStr):
+                # f"smp_zero3_{key}" -> smp_zero3_* (a name family)
+                name = "".join(
+                    v.value if isinstance(v, ast.Constant) else "*"
+                    for v in arg.values
+                )
+                if name.startswith("smp_"):
+                    names.add(name)
+        # Table-driven publishers register through a variable; collect
+        # the literal names from the enclosing function.
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            registers_via_var = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _REG_METHODS
+                and n.args
+                and isinstance(n.args[0], ast.Name)
+                for n in ast.walk(fn)
+            )
+            if not registers_via_var:
+                continue
+            for n in ast.walk(fn):
+                if (isinstance(n, ast.Constant)
+                        and isinstance(n.value, str)
+                        and _NAME_RE.fullmatch(n.value)):
+                    names.add(n.value)
+    return names
+
+
+def _documented_names():
+    """Backticked smp_* names from the README "Metrics registry" table
+    rows; ``<placeholder>`` segments normalize to ``*``."""
+    text = _README.read_text()
+    m = re.search(r"^### Metrics registry$(.*?)^### ", text,
+                  re.M | re.S)
+    assert m, "README.md must keep a '### Metrics registry' section"
+    names = set()
+    for line in m.group(1).splitlines():
+        if not line.startswith("|"):
+            continue
+        cell = re.match(r"\|\s*`([^`]+)`\s*\|", line)
+        if cell:
+            names.add(re.sub(r"<[^>]+>", "*", cell.group(1)))
+    assert names, "the Metrics registry table parsed to zero rows"
+    return names
+
+
+def test_every_emitted_metric_is_documented():
+    emitted = _emitted_names()
+    assert emitted, "the source scan found no metric registrations"
+    missing = sorted(emitted - _documented_names())
+    assert not missing, (
+        "emitted metrics missing from README '### Metrics registry' "
+        f"(document or rename them): {missing}"
+    )
+
+
+def test_no_orphaned_registry_rows():
+    orphaned = sorted(_documented_names() - _emitted_names())
+    assert not orphaned, (
+        "README '### Metrics registry' rows no longer emitted anywhere "
+        f"(delete or fix the rename): {orphaned}"
+    )
+
+
+def test_scanner_sees_both_registration_styles():
+    """Guard the scanner itself: a direct literal registration, an
+    f-string family, and a table-driven publisher must all be visible —
+    if any style goes dark the two tests above pass vacuously."""
+    emitted = _emitted_names()
+    assert "smp_step_total" in emitted          # direct literal
+    assert "smp_zero3_*" in emitted             # f-string family
+    assert "smp_mfu" in emitted                 # table-driven (roofline)
+    assert "smp_fleet_straggler" in emitted     # this PR's detectors

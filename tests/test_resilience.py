@@ -418,7 +418,8 @@ class TestCheckpointGcHygiene:
 
 
 # ----------------------------------------------------------------------
-# Shutdown ordering: drain async saves BEFORE the observability dumps
+# Shutdown ordering: fleet plane stops first (its final flush needs the
+# bus), then drain async saves, THEN the observability dumps
 # ----------------------------------------------------------------------
 
 
@@ -429,6 +430,7 @@ class TestShutdownOrdering:
         ckpt_mod = importlib.import_module(
             "smdistributed_modelparallel_tpu.checkpoint"
         )
+        from smdistributed_modelparallel_tpu.utils.fleet import fleet
         from smdistributed_modelparallel_tpu.utils.flight_recorder import (
             flight_recorder,
         )
@@ -437,6 +439,9 @@ class TestShutdownOrdering:
         smp.shutdown()
         smp.init({"microbatches": 1})
         order = []
+        monkeypatch.setattr(
+            fleet, "stop", lambda: order.append("fleet")
+        )
         monkeypatch.setattr(
             ckpt_mod, "wait_for_checkpoints", lambda: order.append("drain")
         )
@@ -447,7 +452,7 @@ class TestShutdownOrdering:
             flight_recorder, "dump", lambda *a, **k: order.append("ring")
         )
         state.core.shutdown()
-        assert order == ["drain", "telemetry", "ring"]
+        assert order == ["fleet", "drain", "telemetry", "ring"]
 
     def test_drain_failure_does_not_abort_dumps(self, monkeypatch):
         import importlib
@@ -455,6 +460,7 @@ class TestShutdownOrdering:
         ckpt_mod = importlib.import_module(
             "smdistributed_modelparallel_tpu.checkpoint"
         )
+        from smdistributed_modelparallel_tpu.utils.fleet import fleet
         from smdistributed_modelparallel_tpu.utils.flight_recorder import (
             flight_recorder,
         )
@@ -468,6 +474,11 @@ class TestShutdownOrdering:
             order.append("drain")
             raise RuntimeError("saved failed")
 
+        def fleet_boom():
+            order.append("fleet")
+            raise RuntimeError("plane stuck")
+
+        monkeypatch.setattr(fleet, "stop", fleet_boom)
         monkeypatch.setattr(ckpt_mod, "wait_for_checkpoints", boom)
         monkeypatch.setattr(
             telemetry, "dump", lambda *a, **k: order.append("telemetry")
@@ -476,7 +487,7 @@ class TestShutdownOrdering:
             flight_recorder, "dump", lambda *a, **k: order.append("ring")
         )
         state.core.shutdown()  # must not raise
-        assert order == ["drain", "telemetry", "ring"]
+        assert order == ["fleet", "drain", "telemetry", "ring"]
 
 
 # ----------------------------------------------------------------------
