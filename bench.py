@@ -1162,6 +1162,9 @@ def _serve_probe(deadline):
 def main():
     start_time = time.time()
     probe_window = int(os.environ.get("SMP_BENCH_PROBE_WINDOW", 1200))
+    # Arm the wall-clock attribution ledger for the whole bench run; the
+    # "goodput" block stamped below is schema-checked by perf_ledger.py.
+    os.environ.setdefault("SMP_GOODPUT", "1")
     no_accel = _no_accelerator_reason()
     if no_accel:
         sys.stderr.write(
@@ -1528,6 +1531,11 @@ def main():
         "hlo_audit": hlo_audit_out,
         "final_loss": round(final_loss, 4),
     }
+    from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
+    gp_block = goodput.bench_block()
+    if gp_block is not None:
+        result["goodput"] = gp_block
     if exec_cache_out is not None:
         result["exec_cache"] = exec_cache_out
     if serving_out is not None:

@@ -50,6 +50,9 @@ CI stays unflaky):
   GSPMD vs the ring decomposition vs ring + fused Pallas kernels at
   tp=2) are schema-checked when present (numeric timings, speedups
   internally consistent) and rendered per round;
+- the ``goodput`` block (bench.py's wall-clock attribution ledger stamp)
+  is schema-checked when present — fraction in [0, 1], per-state seconds
+  that sum to the wall clock within 1% — and rendered per round;
 - the ``hlo_audit`` block (bench.py >= round 9: the headline program's
   X-ray summary — fingerprint, collective ops/bytes by kind, remat
   fraction, replicated bytes) is schema-checked when present, and
@@ -343,6 +346,39 @@ def _serve_probe_schema_problem(probe):
     return None
 
 
+def _goodput_schema_problem(block):
+    """Why a round's ``goodput`` block (bench.py's wall-clock attribution
+    ledger stamp) is malformed, or None. Absent blocks are fine — rounds
+    predating the ledger."""
+    if block is None:
+        return None
+    if not isinstance(block, dict):
+        return f"'goodput' must be an object, got {type(block).__name__}"
+    frac = block.get("fraction")
+    if not isinstance(frac, (int, float)) or not 0.0 <= frac <= 1.0:
+        return "'goodput.fraction' must be a number in [0, 1]"
+    wall = block.get("wall_s")
+    if not isinstance(wall, (int, float)) or wall < 0:
+        return "'goodput.wall_s' must be a non-negative number"
+    secs = block.get("seconds")
+    if not isinstance(secs, dict) or not all(
+        isinstance(k, str) and isinstance(v, (int, float)) and v >= -1e-9
+        for k, v in secs.items()
+    ):
+        return ("'goodput.seconds' must map state names to non-negative "
+                "seconds")
+    # The ledger's core invariant travels with the stamp: attributed
+    # seconds must account for the wall clock (1% + rounding slack).
+    if wall > 1.0 and abs(sum(secs.values()) - wall) > max(0.01 * wall, 0.5):
+        return ("'goodput.seconds' do not sum to 'wall_s' — the "
+                "attribution ledger leaked time")
+    for key in ("sentinel", "forensics"):
+        val = block.get(key)
+        if val is not None and not isinstance(val, list):
+            return f"'goodput.{key}' must be a list when present"
+    return None
+
+
 def build_ledger(repo, threshold=0.05):
     """The full trajectory + verdict dict (see module docstring)."""
     rounds = []
@@ -388,6 +424,7 @@ def build_ledger(repo, threshold=0.05):
             "tp_overlap": None,
             "pipeline_probe": None,
             "serving": None,
+            "goodput": None,
             "documented": n in documented,
         }
         if rc == 0:
@@ -443,6 +480,12 @@ def build_ledger(repo, threshold=0.05):
                     problems.append(f"{name}: {sprobe_problem}")
                     sprobe = None
                 row["serving"] = sprobe
+                gp = parsed.get("goodput")
+                gp_problem = _goodput_schema_problem(gp)
+                if gp_problem:
+                    problems.append(f"{name}: {gp_problem}")
+                    gp = None
+                row["goodput"] = gp
                 row.update(
                     on_chip=_is_on_chip(parsed),
                     vs_baseline=parsed["vs_baseline"],
@@ -632,6 +675,22 @@ def render_table(ledger, out=sys.stdout):
                 if fb.get("goodput") is not None:
                     parts.append(f"goodput {100 * fb['goodput']:.0f}%")
                 w(f"{'':>7}serving fleet: " + "  ".join(parts) + "\n")
+        gp = r.get("goodput")
+        if isinstance(gp, dict):
+            parts = [
+                f"{100 * gp['fraction']:.0f}% of {gp['wall_s']:.0f}s wall",
+            ]
+            bad = {k: v for k, v in (gp.get("seconds") or {}).items()
+                   if k != "step" and v > 0}
+            if bad:
+                top = sorted(bad.items(), key=lambda kv: -kv[1])[:3]
+                parts.append("badput " + " ".join(
+                    f"{k}={v:.1f}s" for k, v in top))
+            if gp.get("sentinel"):
+                parts.append(f"!! {len(gp['sentinel'])} regression(s)")
+            if gp.get("forensics"):
+                parts.append(f"{len(gp['forensics'])} forensic bundle(s)")
+            w(f"{'':>7}goodput: " + "  ".join(parts) + "\n")
         zprobe = r.get("zero_probe")
         if isinstance(zprobe, dict):
             parts = [

@@ -10,6 +10,7 @@ Usage:
     python scripts/slo_report.py dumps/                           # rank files
     python scripts/slo_report.py smp_fleet_windows.jsonl --fleet  # fleet feed
     python scripts/slo_report.py dumps/ --fleet --slo "ttft_p99_ms=500"
+    python scripts/slo_report.py fleet.jsonl --fleet --min-train-goodput 0.9
 
 Inputs are the ``serve_window`` JSONL records the engine's time-series
 snapshotter appends when ``SMP_TIMESERIES_PATH`` is set
@@ -202,7 +203,19 @@ def main(argv=None):
                     help="evaluate fleet_window records (the SMP_FLEET_PATH "
                     "feed the fleet aggregator writes), synthesizing one "
                     "from per-rank telemetry dumps if none are present")
+    ap.add_argument("--min-train-goodput", type=float, default=None,
+                    help="gate (requires --fleet): exit 1 unless the last "
+                    "fleet window's train_goodput (wall-clock attribution "
+                    "ledger, rank-weighted) is at least this fraction; "
+                    "exit 2 when the feed carries no train_goodput")
     args = ap.parse_args(argv)
+
+    if args.min_train_goodput is not None and not args.fleet:
+        sys.stderr.write(
+            "slo_report: --min-train-goodput gates the fleet train-"
+            "goodput fold; pass --fleet\n"
+        )
+        return 2
 
     kind = "fleet_window" if args.fleet else "serve_window"
     windows = load_windows(args.inputs, kind=kind)
@@ -272,14 +285,39 @@ def main(argv=None):
     else:
         w("no violations\n")
 
+    rc = 0
+    if args.min_train_goodput is not None:
+        # The wall-clock attribution fold (utils/goodput.py): the last
+        # fleet window carrying a rank-weighted train_goodput is the
+        # evidence; a feed without one cannot be gated.
+        tg = next(
+            (wn["train_goodput"] for wn in reversed(windows)
+             if isinstance(wn.get("train_goodput"), (int, float))),
+            None,
+        )
+        if tg is None:
+            sys.stderr.write(
+                "slo_report: no fleet window carries 'train_goodput' "
+                "(run with SMP_GOODPUT=1 so the ledger's second-counters "
+                "reach the fleet aggregator)\n"
+            )
+            return 2
+        tg_pass = tg >= args.min_train_goodput - 1e-12
+        w(f"\ncheck: train goodput {100.0 * tg:.1f}% "
+          f"{'>=' if tg_pass else '<'} required "
+          f"{100.0 * args.min_train_goodput:.1f}% -> "
+          f"{'PASS' if tg_pass else 'FAIL'}\n")
+        if not tg_pass:
+            rc = 1
     if args.check:
         passed = goodput >= args.min_goodput - 1e-12
         w(f"\ncheck: goodput {100.0 * goodput:.1f}% "
           f"{'>=' if passed else '<'} required "
           f"{100.0 * args.min_goodput:.1f}% -> "
           f"{'PASS' if passed else 'FAIL'}\n")
-        return 0 if passed else 1
-    return 0
+        if not passed:
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

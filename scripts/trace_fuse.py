@@ -396,8 +396,28 @@ def fuse(streams):
                 kind = ev.get("kind", "?")
                 if kind == "serve":
                     continue
+                if kind == "goodput":
+                    # Attribution transitions become duration spans on a
+                    # per-rank "badput" lane: the event marks LEAVING
+                    # ``prev`` after ``elapsed_us`` attributed to it, so
+                    # the span ends at the event. Productive (step) time
+                    # is the lane's silence.
+                    prev = ev.get("prev", "?")
+                    dur = float(ev.get("elapsed_us", 0) or 0)
+                    if prev != "step" and dur > 0:
+                        out.append({
+                            "name": prev, "ph": "X",
+                            "ts": ev.get("ts_us", 0.0) - dur + s.offset_us,
+                            "dur": max(dur, 1.0),
+                            "pid": s.rank, "tid": "badput",
+                            "args": {"to": ev.get("state", "?")},
+                        })
+                    continue
                 name = kind
-                if kind == "collective":
+                if kind == "perf":
+                    name = (f"perf:{ev.get('event', '?')}"
+                            f"({ev.get('source', '?')})")
+                elif kind == "collective":
                     name = f"{ev.get('op', '?')}#{ev.get('seq', '?')}"
                 elif kind == "phase":
                     name = ev.get("phase", "phase")
@@ -596,6 +616,26 @@ def serve_trace_table(streams):
     return rows
 
 
+def goodput_table(streams):
+    """{rank: {state: seconds}} summed over recorder ``goodput``
+    attribution transitions (utils/goodput.py). Ring eviction truncates
+    from the old end, so these are the TAIL of the run — lower bounds,
+    like every recorder-derived table here."""
+    rows = {}
+    for s in streams:
+        if s.kind != "recorder":
+            continue
+        for ev in s.events:
+            if ev.get("kind") != "goodput":
+                continue
+            prev = ev.get("prev", "?")
+            per_state = rows.setdefault(s.rank, {})
+            per_state[prev] = per_state.get(prev, 0.0) + (
+                float(ev.get("elapsed_us", 0) or 0) / 1e6
+            )
+    return rows
+
+
 def render_report(streams, clock_table, out=sys.stdout):
     w = out.write
     ranks = sorted({s.rank for s in streams})
@@ -710,6 +750,20 @@ def render_report(streams, clock_table, out=sys.stdout):
               f"{e['open']:>5}  {lanes}\n")
             for finding in e["findings"]:
                 w(f"!! rank {rank}: {finding}\n")
+
+    gp_rows = goodput_table(streams)
+    if gp_rows:
+        w("\n-- wall-clock attribution (goodput ledger transitions) --\n")
+        w(f"{'rank':>4}  {'state':<22}{'seconds':>10}  {'share':>7}\n")
+        for rank in sorted(gp_rows):
+            per_state = gp_rows[rank]
+            total = sum(per_state.values())
+            for st in sorted(per_state, key=per_state.get, reverse=True):
+                share = per_state[st] / total if total > 0 else 0.0
+                mark = ("  <- badput"
+                        if st != "step" and share >= 0.25 else "")
+                w(f"{rank:>4}  {st:<22}{per_state[st]:>10.3f}  "
+                  f"{100 * share:>6.1f}%{mark}\n")
 
     findings = desync_check(streams)
     w("\n-- collective consistency --\n")

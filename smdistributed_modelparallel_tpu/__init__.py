@@ -64,6 +64,7 @@ from smdistributed_modelparallel_tpu.utils import profiling
 from smdistributed_modelparallel_tpu import resilience
 from smdistributed_modelparallel_tpu.resilience.supervisor import supervisor
 from smdistributed_modelparallel_tpu.utils.fleet import fleet
+from smdistributed_modelparallel_tpu.utils.goodput import goodput
 from smdistributed_modelparallel_tpu.model import DistributedModel
 from smdistributed_modelparallel_tpu.optimizer import DistributedOptimizer
 from smdistributed_modelparallel_tpu.step import step

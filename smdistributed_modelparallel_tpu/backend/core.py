@@ -104,7 +104,14 @@ class ModelParallelCore:
         # and its scrape server must be gone before the telemetry dump
         # becomes this process's record.
         from smdistributed_modelparallel_tpu.utils.fleet import fleet
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
 
+        # Goodput ledger flushes BEFORE the fleet plane stops so the final
+        # second-counters make the fleet's last aggregated window.
+        try:
+            goodput.stop()
+        except Exception as e:
+            logger.warning("goodput ledger stop failed: %s", e)
         try:
             fleet.stop()
         except Exception as e:

@@ -116,6 +116,12 @@ class ModelParallelState:
         # the supervisor's liveness verdicts, so it arms after both.
         # Unset/0 constructs nothing — no thread, no traffic, no port.
         fleet.start()
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
+        # Wall-clock attribution ledger (SMP_GOODPUT and friends): chains
+        # onto the set_phase listener, so it arms after telemetry exists.
+        # Idempotent — a recovery's re-initialize keeps the same ledger.
+        goodput.start()
         from smdistributed_modelparallel_tpu.utils import profiling
 
         # SIGUSR2 arms a one-step profiler capture on a live run
@@ -146,7 +152,9 @@ class ModelParallelState:
         from smdistributed_modelparallel_tpu.utils.telemetry import telemetry
 
         from smdistributed_modelparallel_tpu.utils.fleet import fleet
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
 
+        goodput.reset()
         fleet.reset()
         telemetry.reset()
         flight_recorder.clear()

@@ -321,12 +321,20 @@ def save_checkpoint(path, tag=None, model=None, optimizer=None,
             _finish_checkpoint(path, tag, partial, num_kept_partial_checkpoints)
 
     if blocking:
-        if _SAVER is not None:
-            # Serialize behind any in-flight async saves: running inline
-            # would race the writer thread on `newest` and retention GC.
-            _saver_executor().submit(job).result()
-        else:
-            job()
+        # The calling thread is parked for the whole serialize+IO+commit:
+        # badput, attributed ckpt_save by the goodput ledger. The async
+        # path deliberately records nothing — the saver thread's work
+        # overlaps training, which is the point of blocking=False.
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
+        with goodput.scope("ckpt_save"):
+            if _SAVER is not None:
+                # Serialize behind any in-flight async saves: running
+                # inline would race the writer thread on `newest` and
+                # retention GC.
+                _saver_executor().submit(job).result()
+            else:
+                job()
     else:
         _PENDING_SAVES.append(_saver_executor().submit(job))
 
@@ -593,6 +601,23 @@ def resume_from_checkpoint(path, tag=None, partial=True, strict=True,
     hard-fail is restored with ``elastic=False``).
     Returns the saved user_content.
     """
+    from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
+    # The restore blocks training end to end: badput (ckpt_restore) in
+    # the goodput ledger. One attribute test while disarmed.
+    with goodput.scope("ckpt_restore"):
+        return _resume_from_checkpoint(
+            path, tag=tag, partial=partial, strict=strict,
+            load_optimizer=load_optimizer,
+            load_sharded_optimizer_state=load_sharded_optimizer_state,
+            elastic=elastic,
+        )
+
+
+def _resume_from_checkpoint(path, tag=None, partial=True, strict=True,
+                            load_optimizer=True,
+                            load_sharded_optimizer_state=True,
+                            elastic=True):
     if tag is None:
         newest = os.path.join(path, "newest")
         if not os.path.exists(newest):

@@ -237,7 +237,15 @@ class ChaosInjector:
                     "%gms", os.getpid(), step, ms,
                 )
                 if ms > 0:
-                    time.sleep(ms / 1000.0)
+                    from smdistributed_modelparallel_tpu.utils.goodput import (
+                        goodput,
+                    )
+
+                    # The injected stall is exactly what the ledger's
+                    # `wedged` state models — attribute it there so the
+                    # chaos smoke can assert the badput breakdown.
+                    with goodput.scope("wedged"):
+                        time.sleep(ms / 1000.0)
 
     def on_serve_decode(self, progress):
         """serving/engine.py seam: called once per decode-step boundary.

@@ -331,6 +331,8 @@ class PreemptionListener:
             if self._saving or self.emergency_saved:
                 return self.emergency_saved
             self._saving = True
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
         grace = grace_seconds()
         path = path or os.environ.get(EMERGENCY_PATH_ENV) or DEFAULT_EMERGENCY_PATH
         record_preemption("requested", step=state.step_count, detail=reason)
@@ -340,6 +342,11 @@ class PreemptionListener:
         )
         self._notify_peers()
         try:
+            # Everything from the trigger to the committed emergency
+            # checkpoint is preemption drain in the goodput ledger (the
+            # blocking shard write inside nests as ckpt_save).
+            drain_scope = goodput.scope("preempt_drain")
+            drain_scope.__enter__()
             # In-flight async saves first: they hold the single saver
             # thread, and their shards may be half-written — the emergency
             # save must not interleave with them.
@@ -402,6 +409,7 @@ class PreemptionListener:
             logger.error("emergency checkpoint failed: %s", e)
             raise
         finally:
+            drain_scope.__exit__(None, None, None)
             self._saving = False
 
     def _deferred_save(self):

@@ -675,9 +675,12 @@ class Supervisor:
             "recover_begin", detail=f"world={old_world} error="
             f"{type(error).__name__ if error else None}"
         )
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
         # Phase 1: detection. Bounded wait for a classification; a typed
         # SMPPeerLost from the caller is direct evidence.
-        failures = self._await_detection(detector, error)
+        with goodput.scope("recovery_detect"):
+            failures = self._await_detection(detector, error)
         if not failures:
             if error is not None:
                 raise error
@@ -694,15 +697,19 @@ class Supervisor:
         )
         # Phase 2: survivor rendezvous over the (still-live) old bus.
         t0 = time.monotonic()
-        survivors = sorted(
-            p for p in range(old_world) if p not in failures
-        )
-        survivors, infos = self._rendezvous(bus, survivors, failures, grace)
-        tag, step = self._agree_checkpoint(infos, survivors)
-        coord = next(
-            (i.get("coord") for i in infos.values() if i.get("coord")), None
-        )
-        self._notify_evicted(bus, failures, survivors)
+        with goodput.scope("recovery_rendezvous"):
+            survivors = sorted(
+                p for p in range(old_world) if p not in failures
+            )
+            survivors, infos = self._rendezvous(
+                bus, survivors, failures, grace
+            )
+            tag, step = self._agree_checkpoint(infos, survivors)
+            coord = next(
+                (i.get("coord") for i in infos.values() if i.get("coord")),
+                None,
+            )
+            self._notify_evicted(bus, failures, survivors)
         rendezvous_s = time.monotonic() - t0
         flight_recorder.record_supervisor(
             "rendezvous_ok",
@@ -711,42 +718,43 @@ class Supervisor:
         # Phase 3: tear down the failed world, re-initialize at the
         # shrunken one, resume from the agreed checkpoint.
         t0 = time.monotonic()
-        self._stop_detector()
-        self._teardown_world(state)
-        if old_rank not in survivors:
-            raise SMPEvicted(
-                f"process {old_rank} is not in the agreed survivor set "
-                f"{survivors}; exiting instead of training split-brain."
-            )
-        new_world = len(survivors)
-        my_new_rank = survivors.index(old_rank)
-        self._abandon_distributed()
-        self._clear_jax_runtime(new_world)
-        if new_world > 1:
-            if not coord:
-                raise SMPRecoveryError(
-                    "multi-survivor recovery without an agreed coordinator "
-                    "endpoint (rendezvous info incomplete)."
+        with goodput.scope("recovery_reshard_load"):
+            self._stop_detector()
+            self._teardown_world(state)
+            if old_rank not in survivors:
+                raise SMPEvicted(
+                    f"process {old_rank} is not in the agreed survivor set "
+                    f"{survivors}; exiting instead of training split-brain."
                 )
-            self.initialize_distributed(coord, new_world, my_new_rank)
-        self._reinit_framework(state, new_config)
-        from smdistributed_modelparallel_tpu.checkpoint import (
-            resume_from_checkpoint,
-        )
-        from smdistributed_modelparallel_tpu.utils import exec_cache
+            new_world = len(survivors)
+            my_new_rank = survivors.index(old_rank)
+            self._abandon_distributed()
+            self._clear_jax_runtime(new_world)
+            if new_world > 1:
+                if not coord:
+                    raise SMPRecoveryError(
+                        "multi-survivor recovery without an agreed "
+                        "coordinator endpoint (rendezvous info incomplete)."
+                    )
+                self.initialize_distributed(coord, new_world, my_new_rank)
+            self._reinit_framework(state, new_config)
+            from smdistributed_modelparallel_tpu.checkpoint import (
+                resume_from_checkpoint,
+            )
+            from smdistributed_modelparallel_tpu.utils import exec_cache
 
-        # Warm-start consult: count the persistent-executable-cache
-        # entries available to the shrunken world BEFORE first_step pays
-        # (or skips) the recompile, and mark the compile-event ledger so
-        # the MTTR closure can split first_step into compile_from_cache
-        # vs compile_fresh.
-        exec_cache.note_warm_start("recovery")
-        compile_mark = exec_cache.compile_event_mark()
+            # Warm-start consult: count the persistent-executable-cache
+            # entries available to the shrunken world BEFORE first_step pays
+            # (or skips) the recompile, and mark the compile-event ledger so
+            # the MTTR closure can split first_step into compile_from_cache
+            # vs compile_fresh.
+            exec_cache.note_warm_start("recovery")
+            compile_mark = exec_cache.compile_event_mark()
 
-        resume_from_checkpoint(ckpt_path, tag=tag, partial=True,
-                               elastic=True)
-        if step >= 0:
-            state.step_count = int(step)
+            resume_from_checkpoint(ckpt_path, tag=tag, partial=True,
+                                   elastic=True)
+            if step >= 0:
+                state.step_count = int(step)
         reshard_s = time.monotonic() - t0
         flight_recorder.record_supervisor(
             "resume_done", detail=f"tag={tag} step={step} world={new_world}"
@@ -767,6 +775,9 @@ class Supervisor:
         }
         # MTTR closes at the first trained step (on_step_edge).
         self._await_first_step = report
+        # The ledger sits in recovery_first_step until the resumed loop's
+        # next ambient step/trace phase moves it (same closure point).
+        goodput.enter("recovery_first_step")
         self.active = True
         logger.warning(
             "RECOVERY: world reformed %d -> %d (rank %d -> %d), resumed "

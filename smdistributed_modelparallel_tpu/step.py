@@ -52,6 +52,7 @@ from smdistributed_modelparallel_tpu.utils import hlo_audit
 from smdistributed_modelparallel_tpu.utils import profiling
 from smdistributed_modelparallel_tpu.utils.exceptions import StepUsageError
 from smdistributed_modelparallel_tpu.utils.flight_recorder import flight_recorder
+from smdistributed_modelparallel_tpu.utils.goodput import goodput
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.utils.telemetry import (
     record_step_time,
@@ -180,6 +181,9 @@ class StepFunction:
         # dispatch histogram above keeps its legacy buckets; this one
         # resolves tail steps (a p99 blowup is invisible in the mean).
         record_step_time(t_step)
+        # Goodput ledger tick (publish + sentinel window at most once per
+        # tick interval): one attribute test while disarmed.
+        goodput.on_step_edge(state.step_count)
         profiling.capture.on_step_end(state.step_count, outputs=outputs)
         if exact_time:
             # smp_mfu / smp_roofline_* gauges for this program, from its

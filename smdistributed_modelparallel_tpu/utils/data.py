@@ -25,6 +25,7 @@ import numpy as np
 
 from smdistributed_modelparallel_tpu.backend.state import state
 from smdistributed_modelparallel_tpu.utils.exceptions import SMPValidationError
+from smdistributed_modelparallel_tpu.utils.goodput import goodput as _goodput
 
 
 def _global_batch_sharding(arr):
@@ -139,7 +140,15 @@ class prefetch_to_device:
     def __next__(self):
         if self._terminal is not None:
             raise self._terminal
-        item = self._q.get()
+        led = _goodput.ledger
+        if led is not None and self._q.empty():
+            # The input pipeline is BEHIND (the prefetch queue ran dry):
+            # the blocked wait attributes to data_wait in the goodput
+            # ledger. A ready batch skips the scope entirely.
+            with led.scope("data_wait"):
+                item = self._q.get()
+        else:
+            item = self._q.get()
         if item is self._DONE:
             self._terminal = StopIteration()
             raise StopIteration

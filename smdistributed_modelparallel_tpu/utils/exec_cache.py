@@ -623,6 +623,15 @@ def record_compile_event(name, source, seconds):
         {"name": name, "source": source, "seconds": float(seconds),
          "t": time.monotonic()}
     )
+    # The goodput ledger attributes compile phases compile_fresh
+    # tentatively (the source is only known here, once the load/compile
+    # resolved): a disk_cache event moves its seconds to compile_cache.
+    try:
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
+        goodput.note_compile(source, seconds)
+    except Exception:
+        pass
 
 
 def compile_event_mark():

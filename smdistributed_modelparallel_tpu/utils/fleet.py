@@ -101,6 +101,19 @@ def _flight():
     return flight_recorder
 
 
+def _trigger_forensics(reason, detail):
+    """Detector fire edges request an auto-forensics bundle; a no-op
+    while SMP_FORENSICS_PATH is unset, and never raises — the metrics
+    plane must not die collecting evidence."""
+    try:
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
+        goodput.trigger_forensics(reason, detail=detail)
+    except Exception:
+        logger.warning("forensics trigger (%s) failed", reason,
+                       exc_info=True)
+
+
 def fleet_interval():
     """Publish/aggregate cadence in seconds; 0.0 disables the plane."""
     raw = os.environ.get(FLEET_INTERVAL_ENV, "")
@@ -626,6 +639,30 @@ class FleetMetricsPlane:
         if kv_used:
             window["kv_used_by_rank"] = _skew(kv_used)
 
+        # Fleet goodput: per-rank wall-clock attribution counters merged
+        # exactly like the histograms — counter summing IS rank
+        # weighting (a rank with more attributed seconds weighs more).
+        good = self._counter_values(merged, "smp_goodput_seconds_total")
+        bad = self._counter_values(merged, "smp_badput_seconds_total")
+        if good or bad:
+            good_s = sum(good.values())
+            bad_s = sum(bad.values())
+            total = good_s + bad_s
+            if total > 0:
+                window["train_goodput"] = round(good_s / total, 4)
+                window["badput_by_state"] = {
+                    dict(key).get("state", "?"): round(val, 3)
+                    for key, val in sorted(bad.items())
+                }
+                self.registry.gauge(
+                    "smp_fleet_train_goodput",
+                    "fleet wall-clock goodput fraction (merged goodput "
+                    "seconds / merged attributed seconds, rank-weighted)",
+                ).set(window["train_goodput"])
+            gp = self._per_rank_gauge(ranks, "smp_goodput_fraction")
+            if gp:
+                window["goodput_by_rank"] = _skew(gp)
+
         self._detect_stragglers(ranks, window)
         self._detect_kv_imbalance(kv_used, window)
         self._mark_stale(stale, dead, window)
@@ -711,11 +748,20 @@ class FleetMetricsPlane:
             g_flag.labels(rank=str(r)).set(1 if is_straggler else 0)
             if is_straggler:
                 stragglers.add(r)
-        for r in sorted(stragglers - self._straggling):
+        newly = sorted(stragglers - self._straggling)
+        for r in newly:
             _flight().record_fleet(
                 "straggler", rank=r,
                 detail=f"{source} p99 ratio {ratios[r]} > "
                        f"{self.straggler_ratio}")
+        if newly:
+            # A straggler verdict's fire edge is evidence-worthy: one
+            # rate-limited forensic bundle (no-op while disarmed).
+            _trigger_forensics(
+                "fleet_straggler",
+                f"ranks {newly} {source} p99 over "
+                f"{self.straggler_ratio}x fleet median",
+            )
         for r in sorted(self._straggling - stragglers):
             _flight().record_fleet("straggler_clear", rank=r, detail=source)
         self._straggling = stragglers
@@ -745,6 +791,11 @@ class FleetMetricsPlane:
                     "kv_imbalance", rank=worst,
                     detail=f"max/mean {ratio:.2f} > "
                            f"{self.kv_imbalance_ratio}")
+                _trigger_forensics(
+                    "fleet_kv_imbalance",
+                    f"rank {worst} max/mean {ratio:.2f} > "
+                    f"{self.kv_imbalance_ratio}",
+                )
         elif self._kv_imbalanced:
             _flight().record_fleet("kv_imbalance_clear")
         self._kv_imbalanced = imbalanced

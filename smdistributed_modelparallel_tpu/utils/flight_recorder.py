@@ -71,6 +71,8 @@ CHAOS = "chaos"
 SUPERVISOR = "supervisor"
 SERVE = "serve"
 FLEET = "fleet"
+GOODPUT = "goodput"
+PERF = "perf"
 
 # Field names per kind, applied at dump time (the ring stores bare
 # tuples). Keeping the schema here — not at the record sites — is what
@@ -91,6 +93,8 @@ _FIELDS = {
     SUPERVISOR: ("event", "peer", "detail", "wall_us"),
     SERVE: ("event", "rid", "trace", "slot", "pos", "detail"),
     FLEET: ("event", "rank", "detail", "wall_us"),
+    GOODPUT: ("state", "prev", "elapsed_us"),
+    PERF: ("event", "source", "detail", "wall_us"),
 }
 
 
@@ -294,6 +298,26 @@ class FlightRecorder:
             return
         self.record(SERVE, str(event), str(rid), str(trace or rid),
                     int(slot), int(pos), str(detail))
+
+    def record_goodput(self, state, prev, elapsed_s=0.0):
+        """A goodput-ledger attribution transition (utils/goodput.py):
+        the process left ``prev`` (after ``elapsed_s`` attributed to it)
+        and entered ``state``. The stream ``trace_fuse.py`` renders as
+        the per-rank badput track."""
+        if not self.enabled:
+            return
+        self.record(GOODPUT, str(state), str(prev), int(elapsed_s * 1e6))
+
+    def record_perf(self, event, source, detail=""):
+        """Perf-regression sentinel and auto-forensics events
+        (utils/goodput.py): ``regression``/``regression_clear`` edges
+        (source = step_time | itl), ``goodput_min`` floor breaches, and
+        ``forensics`` bundle captures. Wall-stamped like supervisor
+        events so post-mortems line them up across ranks."""
+        if not self.enabled:
+            return
+        self.record(PERF, str(event), str(source), str(detail),
+                    int(time.time() * 1e6))
 
     def last_seq(self, group):
         """The group's current collective sequence number (the seq the

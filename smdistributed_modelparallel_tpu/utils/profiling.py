@@ -200,6 +200,7 @@ class ProfileCapture:
         self.last_window = None      # (first, last) of the last capture
         self._started_at = None
         self._last_step = None       # most recent step edge seen
+        self._forced_dir = None      # per-capture base dir override
 
     # -- configuration --------------------------------------------------
 
@@ -221,9 +222,21 @@ class ProfileCapture:
         return self._window
 
     def rank_dir(self):
-        base = os.environ.get(PROFILE_PATH_ENV, "smp_profile")
+        base = self._forced_dir or os.environ.get(
+            PROFILE_PATH_ENV, "smp_profile"
+        )
         rank = telemetry.process_index
         return os.path.join(base, f"rank{0 if rank is None else rank}")
+
+    def request_capture(self, path=None):
+        """Arm a one-step capture at the next step edge — the SIGUSR2
+        path, callable in-process (auto-forensics uses it; ``path``
+        overrides the SMP_PROFILE_PATH base for this capture only). Like
+        the signal, it defers to a capture already running or a
+        configured window still pending."""
+        if path is not None and not self.active and self._window is None:
+            self._forced_dir = path
+        self._sig_request = True
 
     def install_signal(self):
         """Install the SIGUSR2 trigger (main thread only; re-entrant)."""
@@ -279,6 +292,7 @@ class ProfileCapture:
                     "profiler capture start failed (%s); window disarmed.", e
                 )
                 self._window = None
+                self._forced_dir = None
                 return
             self.active = True
             self._started_at = step
@@ -338,6 +352,7 @@ class ProfileCapture:
                 "profiler capture stopped: steps %d..%d -> %s",
                 first, step, self.rank_dir(),
             )
+            self._forced_dir = None
 
     @staticmethod
     def _record_overhead(seconds):
@@ -366,6 +381,7 @@ class ProfileCapture:
         self.last_window = None
         self._started_at = None
         self._last_step = None
+        self._forced_dir = None
 
 
 capture = ProfileCapture()

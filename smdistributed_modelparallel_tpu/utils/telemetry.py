@@ -546,6 +546,14 @@ class Watchdog:
                     # numbers, schedule slots, phases): what this rank was
                     # DOING, not just where its threads are parked.
                     "flight_recorder": _flight_snapshot(),
+                    # Wall-clock attribution at the stall: the current
+                    # goodput state, per-state seconds, and the last N
+                    # state TRANSITIONS — strictly more than the phase
+                    # string (the 64-entry _phase_history only shows
+                    # phases, not where the seconds went). None when the
+                    # ledger is disarmed. Marking the stall first means
+                    # the wedged seconds start accruing from the dump.
+                    "goodput": _goodput_snapshot(reason),
                 }
                 path = self._registry._rank_path(
                     os.environ.get(WATCHDOG_PATH_ENV, "smp_watchdog_dump.json")
@@ -643,6 +651,22 @@ def _flight_snapshot():
     try:
         fr = _flight()
         return {"meta": fr._meta(), "events": fr.snapshot()}
+    except Exception:  # pragma: no cover - diagnostics must not throw
+        return None
+
+
+def _goodput_snapshot(reason):
+    """The goodput-ledger block for a watchdog stall dump, or None when
+    the ledger is disarmed. Lazy import: telemetry stays the leaf of the
+    observability import graph."""
+    try:
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
+        if goodput.ledger is None:
+            return None
+        # From the dump on, the stalled seconds accrue to `wedged`.
+        goodput.mark_stalled(reason)
+        return goodput.snapshot()
     except Exception:  # pragma: no cover - diagnostics must not throw
         return None
 

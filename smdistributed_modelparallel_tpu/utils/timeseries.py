@@ -145,6 +145,27 @@ def evaluate_slo(slo, window):
     return {"ok": not violations, "violations": violations}
 
 
+def _goodput_block():
+    """The armed goodput ledger's per-window fold, or None. Lazy lookup:
+    the ledger is optional and this module must not construct it."""
+    try:
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
+        return goodput.window_block()
+    except Exception:
+        return None
+
+
+def _trigger_forensics(reason, detail):
+    try:
+        from smdistributed_modelparallel_tpu.utils.goodput import goodput
+
+        goodput.trigger_forensics(reason, detail=detail)
+    except Exception:
+        logger.warning("forensics trigger (%s) failed", reason,
+                       exc_info=True)
+
+
 class MetricsTimeSeries:
     """Bounded fixed-interval snapshotter of the serving metrics."""
 
@@ -180,6 +201,7 @@ class MetricsTimeSeries:
         self._ring = collections.deque(maxlen=self.size)
         self._seq = 0
         self._ok_windows = 0
+        self._slo_streak = 0
         self._t_start = self._clock()
         self._last_sample = self._t_start
         self._prev = self._read() if self.enabled else None
@@ -345,6 +367,28 @@ class MetricsTimeSeries:
                 "smp_slo_ok", "1 when the last window met every SLO"
             ).set(1.0 if verdict["ok"] else 0.0)
             window["slo"] = verdict
+            # An SLO violation STREAK (not one bad window) is an
+            # anomaly worth evidence: three consecutive violating
+            # windows trigger one auto-forensics bundle (rate-limited
+            # by the engine's own cooldown; no-op when disarmed).
+            if verdict["ok"]:
+                self._slo_streak = 0
+            else:
+                self._slo_streak += 1
+                if self._slo_streak == 3:
+                    _trigger_forensics(
+                        "slo_streak",
+                        f"3 consecutive violating windows: "
+                        f"{sorted(verdict['violations'])}",
+                    )
+        gp = _goodput_block()
+        if gp is not None:
+            # Fold the wall-clock attribution into the window so one
+            # JSONL line answers both "is serving meeting its SLO" and
+            # "where did this rank's seconds go".
+            window["train_goodput"] = gp["fraction"]
+            if gp["badput"]:
+                window["badput_seconds"] = gp["badput"]
         self._ring.append(window)
         self._append_jsonl(window)
         self._prev = raw
