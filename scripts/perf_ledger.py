@@ -43,12 +43,15 @@ CI stays unflaky):
   warm compile A/B through the persistent executable cache) is
   schema-checked when present (numeric ``cold_s``/``warm_s``/``speedup``,
   internally consistent) and rendered per round;
-- the ``zero_probe`` / ``pipeline_probe`` / ``serving`` / ``tp_overlap``
-  blocks (the other bench probe A/Bs, SMP_BENCH_ZERO_PROBE /
-  SMP_BENCH_PIPELINE_PROBE / SMP_BENCH_SERVE_PROBE /
+- the ``zero_probe`` / ``pipeline_probe`` / ``serving`` /
+  ``autoscale`` / ``tp_overlap`` blocks (the other bench probe A/Bs,
+  SMP_BENCH_ZERO_PROBE / SMP_BENCH_PIPELINE_PROBE /
+  SMP_BENCH_SERVE_PROBE / SMP_BENCH_AUTOSCALE_PROBE /
   SMP_BENCH_TP_PROBE — for ``tp_overlap``,
   GSPMD vs the ring decomposition vs ring + fused Pallas kernels at
-  tp=2) are schema-checked when present (numeric timings, speedups
+  tp=2; for ``autoscale``, a bursty ragged-arrival trace served static
+  vs SLO-autoscaled with a mid-run canaried weight update) are
+  schema-checked when present (numeric timings, speedups
   internally consistent) and rendered per round;
 - the ``goodput`` block (bench.py's wall-clock attribution ledger stamp)
   is schema-checked when present — fraction in [0, 1], per-state seconds
@@ -346,6 +349,41 @@ def _serve_probe_schema_problem(probe):
     return None
 
 
+def _autoscale_schema_problem(probe):
+    """Why a round's ``autoscale`` block (bench.py
+    SMP_BENCH_AUTOSCALE_PROBE bursty static-vs-autoscaled A/B) is
+    malformed, or None. Absent blocks are fine — rounds predating the
+    serving control plane, or probe not requested."""
+    if probe is None:
+        return None
+    if not isinstance(probe, dict):
+        return f"'autoscale' must be an object, got {type(probe).__name__}"
+    if probe.get("component") != "autoscale":
+        return "'autoscale.component' must be the string 'autoscale'"
+    se = probe.get("scale_events")
+    if not isinstance(se, int) or se < 1:
+        return ("'autoscale.scale_events' must be an integer >= 1 — a "
+                "burst that never scaled measured nothing")
+    for key in ("p99_ttft_ms_static", "p99_ttft_ms_auto",
+                "weight_update_s"):
+        if not isinstance(probe.get(key), (int, float)):
+            return f"'autoscale' lacks a numeric '{key}'"
+    if probe.get("weight_update_s") < 0:
+        return "'autoscale.weight_update_s' must be non-negative"
+    verdict = probe.get("canary_verdict")
+    if verdict not in ("promoted", "rolled_back", "none"):
+        return ("'autoscale.canary_verdict' must be 'promoted', "
+                "'rolled_back' or 'none'")
+    fresh = probe.get("fresh_compiles")
+    if fresh is not None and (not isinstance(fresh, int) or fresh < 0):
+        return "'autoscale.fresh_compiles' must be a count when present"
+    if probe.get("token_parity") is False:
+        # The scaled run must emit the same tokens as the static run —
+        # a latency win at different output measures nothing.
+        return "'autoscale.token_parity' is false — the A/B is invalid"
+    return None
+
+
 def _goodput_schema_problem(block):
     """Why a round's ``goodput`` block (bench.py's wall-clock attribution
     ledger stamp) is malformed, or None. Absent blocks are fine — rounds
@@ -424,6 +462,7 @@ def build_ledger(repo, threshold=0.05):
             "tp_overlap": None,
             "pipeline_probe": None,
             "serving": None,
+            "autoscale": None,
             "goodput": None,
             "documented": n in documented,
         }
@@ -480,6 +519,12 @@ def build_ledger(repo, threshold=0.05):
                     problems.append(f"{name}: {sprobe_problem}")
                     sprobe = None
                 row["serving"] = sprobe
+                aprobe = parsed.get("autoscale")
+                aprobe_problem = _autoscale_schema_problem(aprobe)
+                if aprobe_problem:
+                    problems.append(f"{name}: {aprobe_problem}")
+                    aprobe = None
+                row["autoscale"] = aprobe
                 gp = parsed.get("goodput")
                 gp_problem = _goodput_schema_problem(gp)
                 if gp_problem:
@@ -675,6 +720,22 @@ def render_table(ledger, out=sys.stdout):
                 if fb.get("goodput") is not None:
                     parts.append(f"goodput {100 * fb['goodput']:.0f}%")
                 w(f"{'':>7}serving fleet: " + "  ".join(parts) + "\n")
+        aprobe = r.get("autoscale")
+        if isinstance(aprobe, dict):
+            parts = [
+                f"{aprobe['scale_events']} scale event(s)",
+                f"p99 ttft {aprobe['p99_ttft_ms_static']:.1f}ms static "
+                f"-> {aprobe['p99_ttft_ms_auto']:.1f}ms autoscaled",
+                f"weight update {aprobe['weight_update_s']:.3f}s",
+                f"canary {aprobe['canary_verdict']}",
+            ]
+            if aprobe.get("fresh_compiles") is not None:
+                parts.append(
+                    f"{aprobe['fresh_compiles']} fresh compile(s)"
+                )
+            if aprobe.get("token_parity"):
+                parts.append("parity ok")
+            w(f"{'':>7}autoscale: " + "  ".join(parts) + "\n")
         gp = r.get("goodput")
         if isinstance(gp, dict):
             parts = [

@@ -11,6 +11,8 @@ Usage:
     python scripts/slo_report.py smp_fleet_windows.jsonl --fleet  # fleet feed
     python scripts/slo_report.py dumps/ --fleet --slo "ttft_p99_ms=500"
     python scripts/slo_report.py fleet.jsonl --fleet --min-train-goodput 0.9
+    python scripts/slo_report.py controller.jsonl --controller
+    python scripts/slo_report.py ctl.jsonl --controller --check --max-scale-seconds 30
 
 Inputs are the ``serve_window`` JSONL records the engine's time-series
 snapshotter appends when ``SMP_TIMESERIES_PATH`` is set
@@ -37,6 +39,17 @@ telemetry dumps, one cumulative fleet window is synthesized by merging
 them with ``utils/telemetry.merge_metric_reports`` — the same function
 the live aggregator runs, so the offline verdict matches the on-fleet
 one bit for bit (this one path needs the package importable).
+
+``--controller`` renders the serving control plane's decision feed
+instead: the ``SMP_CONTROLLER_PATH`` JSONL the ``ServingController``
+appends (``serving/controller.py`` — ``scale_event`` records with their
+MTTR-style phase breakdowns, ``canary`` verdicts, ``weight_update``
+timings). The report is a per-event timeline (trigger window ->
+rendezvous -> warm start -> first token for scale-ups; drain -> reroute
+for scale-downs), and ``--check`` gates it: exit 1 when any canary
+version was never promoted (rolled back or still pending) or any scale
+event took longer than ``--max-scale-seconds``; exit 2 when the inputs
+hold no controller records.
 
 Stdlib only — runnable anywhere the JSONL can be copied to. The SLO key
 grammar duplicates ``utils/timeseries.parse_slo`` on purpose: this
@@ -182,6 +195,103 @@ def synthesize_fleet_window(paths):
     return window
 
 
+def load_controller_records(paths):
+    """All scale_event / canary / weight_update records in the inputs,
+    wall-ordered (the SMP_CONTROLLER_PATH feed)."""
+    records = []
+    for f in _expand_files(paths):
+        try:
+            with open(f) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (isinstance(rec, dict) and rec.get("kind") in
+                            ("scale_event", "canary", "weight_update")):
+                        records.append(rec)
+        except OSError as e:
+            sys.stderr.write(f"slo_report: skipping {f}: {e}\n")
+    records.sort(key=lambda r: (r.get("t_wall", 0.0), r.get("seq", 0)))
+    return records
+
+
+def _controller_report(args):
+    records = load_controller_records(args.inputs)
+    if not records:
+        sys.stderr.write("slo_report: no controller records found\n")
+        return 2
+    events = [r for r in records if r["kind"] == "scale_event"]
+    canaries = [r for r in records if r["kind"] == "canary"]
+    updates = [r for r in records if r["kind"] == "weight_update"]
+
+    w = sys.stdout.write
+    w("=== serving control-plane report ===\n")
+    w(f"{len(events)} scale event(s), {len(updates)} weight update(s), "
+      f"{len(canaries)} canary verdict(s)\n")
+    if events:
+        w("\nscale events:\n")
+        for ev in events:
+            phases = ev.get("phases") or {}
+            timeline = " -> ".join(
+                f"{name} {float(phases[name]):.3f}s"
+                for name in ("trigger", "rendezvous", "warm_start",
+                             "first_token", "drain", "reroute")
+                if name in phases
+            )
+            extra = ""
+            if ev.get("stragglers"):
+                extra = f"  [{ev['stragglers']} straggler(s) re-dispatched]"
+            w(f"  #{ev.get('seq', '?')} {ev.get('direction', '?'):<5}"
+              f"-> {ev.get('replicas', '?')} replica(s)  "
+              f"{float(ev.get('seconds', 0.0)):.3f}s  ({timeline})  "
+              f"reason={ev.get('reason', '?')}{extra}\n")
+    if updates:
+        w("\nweight updates:\n")
+        for up in updates:
+            w(f"  version {up.get('version', '?')} adopted in "
+              f"{float(up.get('seconds', 0.0)):.3f}s\n")
+    if canaries:
+        w("\ncanary verdicts:\n")
+        for c in canaries:
+            detail = c.get("detail") or ""
+            w(f"  version {c.get('version', '?')}: "
+              f"{c.get('verdict', '?')}"
+              f"{'  (' + detail + ')' if detail else ''}\n")
+
+    rc = 0
+    if args.check:
+        if args.max_scale_seconds is not None:
+            slow = [
+                ev for ev in events
+                if float(ev.get("seconds", 0.0)) > args.max_scale_seconds
+            ]
+            ok = not slow
+            w(f"\ncheck: {len(events) - len(slow)}/{len(events)} scale "
+              f"event(s) within {args.max_scale_seconds:g}s -> "
+              f"{'PASS' if ok else 'FAIL'}\n")
+            if not ok:
+                rc = 1
+        # A canary that never reached "promoted" — rolled back, or still
+        # pending when the run ended — fails the gate.
+        final = {}
+        for c in canaries:
+            final[c.get("version")] = c.get("verdict")
+        unpromoted = sorted(
+            str(v) for v, verdict in final.items() if verdict != "promoted"
+        )
+        if unpromoted:
+            w(f"check: canary version(s) {', '.join(unpromoted)} never "
+              "promoted -> FAIL\n")
+            rc = 1
+        elif canaries:
+            w(f"check: {len(final)} canary version(s) promoted -> PASS\n")
+    return rc
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Evaluate serving SLOs over a metrics time-series "
@@ -208,8 +318,23 @@ def main(argv=None):
                     "fleet window's train_goodput (wall-clock attribution "
                     "ledger, rank-weighted) is at least this fraction; "
                     "exit 2 when the feed carries no train_goodput")
+    ap.add_argument("--controller", action="store_true",
+                    help="render the serving control-plane decision feed "
+                    "(SMP_CONTROLLER_PATH JSONL: scale events with phase "
+                    "timelines, canary verdicts, weight updates)")
+    ap.add_argument("--max-scale-seconds", type=float, default=None,
+                    help="gate (requires --controller --check): exit 1 if "
+                    "any scale event took longer than this end to end")
     args = ap.parse_args(argv)
 
+    if args.max_scale_seconds is not None and not args.controller:
+        sys.stderr.write(
+            "slo_report: --max-scale-seconds gates the control-plane "
+            "feed; pass --controller\n"
+        )
+        return 2
+    if args.controller:
+        return _controller_report(args)
     if args.min_train_goodput is not None and not args.fleet:
         sys.stderr.write(
             "slo_report: --min-train-goodput gates the fleet train-"

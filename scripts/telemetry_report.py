@@ -612,6 +612,73 @@ def render(report, out=sys.stdout):
         if progs is not None:
             w(f"  compiled programs: {int(progs)}\n")
 
+    # -- control plane (serving/controller.py, SMP_AUTOSCALE) -----------
+    # Scale events with their phase breakdowns, the live replica count,
+    # routed-request split by weights version, live weight-update
+    # timing, and canary verdicts incl. the rollback latch.
+    scale_dirs = {
+        s["labels"].get("direction", "?"): s["value"]
+        for s in _series(report, "smp_autoscale_events_total")
+    }
+    routed = _series(report, "smp_controller_routed_total")
+    if scale_dirs or routed:
+        w("\n-- control plane --\n")
+        replicas = _value(report, "smp_controller_replicas")
+        if scale_dirs:
+            parts = [f"{k} x{int(v)}" for k, v in sorted(scale_dirs.items())]
+            if replicas is not None:
+                parts.append(f"now {int(replicas)} replica(s)")
+            w("  scale events: " + "  ".join(parts) + "\n")
+            last_s = _value(report, "smp_autoscale_last_scale_seconds")
+            phases = {
+                s["labels"].get("phase", "?"): s["value"]
+                for s in _series(report, "smp_autoscale_phase_seconds")
+            }
+            if last_s is not None:
+                detail = " ".join(
+                    f"{k} {1e3 * v:.0f}ms" for k, v in sorted(phases.items())
+                )
+                w(f"  last event: {last_s:.3f}s"
+                  + (f"  ({detail})" if detail else "") + "\n")
+        elif replicas is not None:
+            w(f"  replicas: {int(replicas)}\n")
+        if routed:
+            w("  routed: " + "  ".join(
+                f"v{s['labels'].get('version', '?')} {int(s['value'])}"
+                for s in sorted(
+                    routed, key=lambda s: s["labels"].get("version", "")
+                )
+            ) + "\n")
+        drained = _value(report, "smp_controller_drain_stragglers_total")
+        if drained:
+            w(f"  drain protocol: {int(drained)} straggler(s) "
+              "re-dispatched\n")
+        wu = {
+            s["labels"].get("outcome", "?"): s["value"]
+            for s in _series(report, "smp_weight_updates_total")
+        }
+        if wu:
+            wv = _value(report, "smp_controller_weights_version")
+            wu_s = _value(report, "smp_weight_update_seconds")
+            parts = [f"{k} x{int(v)}" for k, v in sorted(wu.items())]
+            if wv is not None:
+                parts.append(f"live version {int(wv)}")
+            if wu_s is not None:
+                parts.append(f"last {wu_s:.3f}s")
+            w("  weight updates: " + "  ".join(parts) + "\n")
+        promos = _value(report, "smp_canary_promotions_total")
+        rollbacks = _value(report, "smp_canary_rollback_total")
+        active = _value(report, "smp_canary_active")
+        if promos or rollbacks or active:
+            parts = []
+            if promos:
+                parts.append(f"{int(promos)} promoted")
+            if rollbacks:
+                parts.append(f"{int(rollbacks)} ROLLED BACK")
+            if active:
+                parts.append("1 in flight")
+            w("  canary: " + "  ".join(parts) + "\n")
+
     # -- health ---------------------------------------------------------
     # Fed by utils/health.py (SMP_HEALTH_CHECK sentinel), the fp16 loss
     # scaler, and the optimizer norm gauges; rendered identically for one

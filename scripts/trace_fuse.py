@@ -438,6 +438,12 @@ def fuse(streams):
                     # serve spans at a glance.
                     name = (f"fleet:{ev.get('event', '?')}"
                             f"@r{ev.get('rank', '?')}")
+                elif kind == "controller":
+                    # Serving control-plane edges (scale_up/scale_down,
+                    # weight_update, canary verdicts): the detail rides
+                    # in args, the lane shows WHEN the fleet changed
+                    # shape against the serve spans that caused it.
+                    name = f"controller:{ev.get('event', '?')}"
                 args = {k: v for k, v in ev.items()
                         if k not in ("ts_us", "id")}
                 out.append({

@@ -106,6 +106,16 @@ class ModelParallelCore:
         from smdistributed_modelparallel_tpu.utils.fleet import fleet
         from smdistributed_modelparallel_tpu.utils.goodput import goodput
 
+        # The serving controller closes its open scale events before the
+        # fleet plane (its window source) goes away.
+        try:
+            from smdistributed_modelparallel_tpu.serving import (
+                controller as serving_controller,
+            )
+
+            serving_controller.shutdown_all()
+        except Exception as e:
+            logger.warning("serving controller stop failed: %s", e)
         # Goodput ledger flushes BEFORE the fleet plane stops so the final
         # second-counters make the fleet's last aggregated window.
         try:

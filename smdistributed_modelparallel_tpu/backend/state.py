@@ -154,6 +154,11 @@ class ModelParallelState:
         from smdistributed_modelparallel_tpu.utils.fleet import fleet
         from smdistributed_modelparallel_tpu.utils.goodput import goodput
 
+        from smdistributed_modelparallel_tpu.serving import (
+            controller as serving_controller,
+        )
+
+        serving_controller.reset_all()
         goodput.reset()
         fleet.reset()
         telemetry.reset()

@@ -37,6 +37,18 @@ Faults:
   (``serving/replica.py``) must detect the death over the heartbeat bus
   and the survivor re-admit every unfinished request from its mirrored
   logs.
+- ``kill_replica@scale=K[:rank=R]`` — SIGKILL this process right after
+  the controller's ``K``-th completed autoscale event (1-based,
+  ``serving/controller.py`` seam): the scale-up/scale-down edge is
+  exactly when replica bookkeeping is most easily corrupted, so the
+  failover path must absorb a death there too.
+- ``corrupt_weights@version=N[:rank=R]`` — perturb the parameter tree a
+  serving replica adopts as weights version ``N`` (every float leaf
+  mapped to ``x * 1.01 + 0.01`` — deterministic, and the affine shift
+  breaks greedy token parity even where a pure rescale would preserve
+  every argmax): the canary's token-parity gate must catch it and the
+  controller auto-roll back, latching ``smp_canary_rollback_total`` and
+  one forensics bundle.
 - ``bus_drop@seq=N[:rank=R][:dest=D]`` — silently drop this process's
   ``N``-th native-bus send (0-based ordinal over all sends; heartbeats
   ride their own seam and do not consume ordinals). The receiver never
@@ -81,13 +93,14 @@ CHAOS_ENV = "SMP_CHAOS"
 _KNOWN_FAULTS = (
     "sigterm", "kill", "wedge", "heartbeat_drop",
     "bus_drop", "bus_error", "delay_collective", "kill_replica",
+    "corrupt_weights",
 )
 
 # Argument value parsers: validated at PARSE time so a typo degrades to a
 # skipped rule with a warning — never a ValueError at a seam mid-run.
 _NUMERIC_KEYS = {
     "step": int, "rank": int, "seq": int, "dest": int, "count": int,
-    "ms": float, "request": int,
+    "ms": float, "request": int, "scale": int, "version": int,
 }
 
 
@@ -274,6 +287,61 @@ class ChaosInjector:
                 os.getpid(), n, tokens,
             )
             os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_scale_event(self, n):
+        """serving/controller.py seam: called once after the controller's
+        ``n``-th completed autoscale event (1-based). Rule
+        ``kill_replica@scale=K`` SIGKILLs this process right at that
+        edge — the moment replica bookkeeping (routing table, mirror
+        shadows, standby handshakes) is most fragile."""
+        if not os.environ.get(CHAOS_ENV):
+            return
+        for r in self._sync():
+            if r.fault != "kill_replica" or r.fired or not r.rank_matches():
+                continue
+            k = int(r.kv.get("scale", -1))
+            if k < 1 or k != int(n):
+                continue
+            r.fired += 1
+            record_chaos("kill_replica", f"scale={k}")
+            logger.warning(
+                "chaos: SIGKILL of pid %d after autoscale event #%d",
+                os.getpid(), k,
+            )
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def on_weight_update(self, version, params):
+        """serving/engine.py seam: called with the parameter tree a
+        replica is about to adopt as weights version ``version``. Rule
+        ``corrupt_weights@version=N`` returns a perturbed copy (every
+        float leaf mapped to ``x * 1.01 + 0.01``) — silently wrong
+        weights the canary's token-parity gate must catch. Returns
+        ``params`` untouched otherwise."""
+        if not os.environ.get(CHAOS_ENV):
+            return params
+        for r in self._sync():
+            if (
+                r.fault != "corrupt_weights"
+                or r.fired
+                or not r.rank_matches()
+                or int(r.kv.get("version", -1)) != int(version)
+            ):
+                continue
+            r.fired += 1
+            record_chaos("corrupt_weights", f"version={version}")
+            logger.warning(
+                "chaos: corrupting weights version %s (float leaves "
+                "-> x*1.01 + 0.01)", version,
+            )
+            import jax  # lazy: chaos must import without a backend
+
+            def _perturb(x):
+                if hasattr(x, "dtype") and "float" in str(x.dtype):
+                    return x * 1.01 + 0.01
+                return x
+
+            return jax.tree_util.tree_map(_perturb, params)
+        return params
 
     def on_heartbeat(self, dest):
         """supervisor.py seam: called once per outgoing heartbeat. Returns

@@ -19,14 +19,29 @@ Typical use::
 Multi-process deployments wrap the engine in
 ``ReplicatedServingEngine`` for mirror-log failover.
 
+The serving CONTROL PLANE (ISSUE 19) lives in
+``smp.serving.controller`` / ``smp.serving.router``: SLO-driven
+autoscaling with hysteresis + cooldown, least-loaded request routing
+with per-version traffic splits, the zero-loss drain protocol, and
+canaried live weight updates with automatic rollback. Armed by
+``SMP_AUTOSCALE`` (``ServingController.from_env()`` returns None when
+unset — nothing is constructed).
+
 Import-hygiene contract: importing this package must never initialize an
 accelerator backend (jax work happens only inside the engine's runtime
 entry points).
 """
 
+from smdistributed_modelparallel_tpu.serving import controller, router
+from smdistributed_modelparallel_tpu.serving.controller import (
+    AutoscalePolicy,
+    ServingController,
+)
 from smdistributed_modelparallel_tpu.serving.engine import (
     ServeRequest,
     ServingEngine,
+    serve_request_from_record,
+    serve_request_to_record,
 )
 from smdistributed_modelparallel_tpu.serving.kv_cache import (
     BlockAllocator,
@@ -38,14 +53,32 @@ from smdistributed_modelparallel_tpu.serving.replica import (
     SERVE_MIRROR_TX,
     ReplicatedServingEngine,
 )
+from smdistributed_modelparallel_tpu.serving.router import (
+    ROUTER_TX,
+    LocalReplicaHandle,
+    RemoteReplicaHandle,
+    ReplicaServer,
+    RequestRouter,
+)
 
 __all__ = [
+    "AutoscalePolicy",
     "BlockAllocator",
+    "LocalReplicaHandle",
+    "ROUTER_TX",
+    "RemoteReplicaHandle",
+    "ReplicaServer",
     "ReplicatedServingEngine",
+    "RequestRouter",
     "SERVE_MIRROR_TX",
     "ServeRequest",
+    "ServingController",
     "ServingEngine",
     "block_tokens",
+    "controller",
     "prefill_chunk_tokens",
+    "router",
+    "serve_request_from_record",
+    "serve_request_to_record",
     "serve_slots",
 ]

@@ -84,6 +84,7 @@ from smdistributed_modelparallel_tpu.utils.telemetry import (
     record_serve_request,
     record_serve_tokens,
     record_serve_trace,
+    record_weight_update,
 )
 from smdistributed_modelparallel_tpu.utils.fleet import fleet
 from smdistributed_modelparallel_tpu.utils.timeseries import (
@@ -126,6 +127,48 @@ class ServeRequest:
     deadline_s: Optional[float] = None
     resume_tokens: Tuple[int, ...] = ()
     trace_id: Optional[str] = None
+
+
+def serve_request_from_record(rec):
+    """Rebuild a restartable ``ServeRequest`` from a mirror-log record
+    (the wire format ``_mirror`` writes). Used by replica failover and
+    by the controller's drain protocol: the already-sampled tokens ride
+    as ``resume_tokens`` so the re-admitting engine continues the key
+    schedule exactly where the record left off, and the original trace
+    id rides along so the fused timeline shows ONE request."""
+    return ServeRequest(
+        request_id=rec["rid"],
+        prompt=rec["prompt"],
+        max_new_tokens=rec["max_new_tokens"],
+        temperature=rec.get("temperature", 0.0),
+        top_k=rec.get("top_k"),
+        top_p=rec.get("top_p"),
+        eos_token_id=rec.get("eos_token_id"),
+        seed=rec.get("seed", 0),
+        deadline_s=rec.get("deadline_s"),
+        resume_tokens=tuple(rec.get("tokens", ())),
+        trace_id=rec.get("trace_id"),
+    )
+
+
+def serve_request_to_record(req):
+    """Inverse of ``serve_request_from_record``: serialize a
+    ``ServeRequest`` into the mirror-record wire format so the router
+    can ship it to a remote replica as plain JSON."""
+    return {
+        "rid": req.request_id,
+        "prompt": list(map(int, req.prompt)),
+        "max_new_tokens": int(req.max_new_tokens),
+        "temperature": req.temperature,
+        "top_k": req.top_k,
+        "top_p": req.top_p,
+        "eos_token_id": req.eos_token_id,
+        "seed": req.seed,
+        "deadline_s": req.deadline_s,
+        "tokens": list(map(int, req.resume_tokens)),
+        "done": False,
+        "trace_id": req.trace_id,
+    }
 
 
 class _Slot:
@@ -306,6 +349,8 @@ class ServingEngine:
         self._admit_order = []   # rids in admission order (chaos seam)
         self._programs = {}
         self.audits = {}         # program kind -> ProgramAudit | None
+        self._admitting = True   # drain protocol: False = quiesced
+        self.weights_version = 0  # bumped by adopt_params (live updates)
         self.stats = collections.Counter()
         self._t0 = None
         self._gen_tokens = 0
@@ -321,10 +366,154 @@ class ServingEngine:
             self.timeseries.start()
 
     def close(self):
-        """Stop the time-series snapshotter thread, if armed. Idempotent;
-        the engine remains usable (sampling continues via tick polling)."""
+        """Stop the time-series snapshotter thread, if armed, and stop
+        admitting. Idempotent; the engine remains usable for draining
+        (sampling continues via tick polling).
+
+        A close with work still queued or in flight must not silently
+        abandon it: every unfinished request's restartable record is
+        re-marked dirty so the replica layer's next ``drain_dirty`` ships
+        a final mirror frame — a peer can re-admit what this engine never
+        served — and the abandonment is counted
+        (``smp_serve_requests_total{event="abandoned"}``)."""
+        self.quiesce()
+        abandoned = [q.request_id for q in self._queue] + [
+            s.sid for s in self._slots if s is not None
+        ]
+        for rid in abandoned:
+            if rid in self.mirror_log:
+                self._dirty.add(rid)
+            record_serve_trace("abandoned", rid, detail="close")
+        if abandoned:
+            record_serve_request("abandoned", len(abandoned))
+            logger.warning(
+                "[serving] close() with %d unfinished request(s); their "
+                "restartable records are mirror-logged for re-admission "
+                "elsewhere.", len(abandoned),
+            )
         if self.timeseries is not None:
             self.timeseries.stop()
+
+    # -- drain protocol (scale-down / weight adoption / clean close) ----
+
+    @property
+    def in_flight(self):
+        """Admitted, unfinished streams (excludes the queue)."""
+        return sum(1 for s in self._slots if s is not None)
+
+    def quiesce(self):
+        """Stop admission: queued requests stay queued, in-flight streams
+        keep decoding. ``submit`` refuses new work while quiesced (the
+        router must not route to a draining replica). Idempotent."""
+        if self._admitting:
+            self._admitting = False
+            record_serve_trace("quiesce", "-", detail="admission stopped")
+
+    def resume_admission(self):
+        """Reopen admission after a quiesce/drain (weight adoption and
+        canary flows drain to idle, adopt, then resume)."""
+        if not self._admitting:
+            self._admitting = True
+            record_serve_trace("resume_admission", "-")
+
+    def drain(self, timeout_s=120.0):
+        """The scale-down drain protocol: stop admitting, finish every
+        IN-FLIGHT stream to completion, and hand back the queued-but-
+        never-admitted requests as restartable straggler records for
+        re-admission elsewhere (router/controller re-route them; submit
+        idempotency guarantees zero duplicated tokens, the finished
+        streams guarantee zero dropped ones).
+
+        Returns the list of straggler mirror records (possibly empty).
+        The engine stays usable afterwards — ``resume_admission()``
+        reopens intake."""
+        self.quiesce()
+        stragglers = []
+        while self._queue:
+            req = self._queue.popleft()
+            self._arrival_s.pop(req.request_id, None)
+            rec = self.mirror_log.get(req.request_id)
+            if rec is None:  # pragma: no cover - submit always mirrors
+                self._mirror(req, list(req.resume_tokens), done=False)
+                rec = self.mirror_log[req.request_id]
+            stragglers.append(dict(rec, tokens=list(rec["tokens"])))
+            self._dirty.add(req.request_id)
+            record_serve_trace(
+                "drained_straggler", req.request_id, trace=req.trace_id,
+            )
+        if stragglers:
+            record_serve_request("drained_straggler", len(stragglers))
+        deadline = time.monotonic() + timeout_s
+        while self.in_flight:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"drain exceeded {timeout_s:g}s with "
+                    f"{self.in_flight} stream(s) still in flight."
+                )
+            self.step()
+            if not self.last_tick_worked:
+                time.sleep(0.001)
+        record_serve_trace(
+            "drained", "-", detail=f"stragglers={len(stragglers)}",
+        )
+        return stragglers
+
+    def adopt_params(self, params, *, version=None):
+        """Live weight update: swap the parameter tree between ticks with
+        ZERO recompile. The compiled programs take params as a call
+        argument and their cache keys are weight-free (shapes, knobs,
+        topology — never values), so adoption is a pointer swap; the
+        compile-event ledger proves it (``compile_fresh`` must stay flat
+        across the adoption — asserted in tests, gated by
+        ``smp_weight_update_seconds``).
+
+        Streams must not be mid-flight (their KV holds the OLD weights'
+        activations): quiesce + drain to idle first — queued requests are
+        fine, they prefill under the new weights. Raises on a tree whose
+        structure/shapes/dtypes differ from the serving programs' avals
+        (that WOULD recompile; re-shard the checkpoint instead)."""
+        import jax
+
+        if self.in_flight:
+            raise SMPValidationError(
+                f"adopt_params with {self.in_flight} stream(s) in flight "
+                "would mix weights mid-stream; quiesce() and drain to "
+                "idle first."
+            )
+        t0 = time.perf_counter()
+        mark = exec_cache.compile_event_mark()
+        new_version = (
+            int(version) if version is not None else self.weights_version + 1
+        )
+        params = chaos.on_weight_update(new_version, params)
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(params)
+        if old_def != new_def or [
+            (getattr(a, "shape", None), getattr(a, "dtype", None))
+            for a in old_leaves
+        ] != [
+            (getattr(a, "shape", None), getattr(a, "dtype", None))
+            for a in new_leaves
+        ]:
+            raise SMPValidationError(
+                "adopt_params: the new checkpoint's tree does not match "
+                "the serving programs' parameter avals (structure/shape/"
+                "dtype) — adopting it would force a recompile. Load the "
+                "checkpoint through the shard catalog for this topology."
+            )
+        self.params = params
+        self.weights_version = new_version
+        fresh = sum(
+            1 for e in exec_cache.compile_events_since(mark)
+            if e.get("source") == "fresh"
+        )
+        seconds = time.perf_counter() - t0
+        record_weight_update(seconds, self.weights_version, fresh=fresh)
+        logger.info(
+            "[serving] adopted weights version %s in %.3fs "
+            "(fresh compiles: %d)", self.weights_version, seconds, fresh,
+        )
+        return seconds
 
     # -- device state ---------------------------------------------------
 
@@ -467,6 +656,11 @@ class ServingEngine:
         same request after a failover must not double-serve it."""
         if req.request_id in self.finished:
             return False
+        if not self._admitting:
+            # Quiesced/draining: new work belongs on another replica (the
+            # router never routes here; a direct submit is refused so the
+            # drain's "stop admitting" contract holds).
+            return False
         if any(s is not None and s.sid == req.request_id
                for s in self._slots):
             return False
@@ -569,6 +763,8 @@ class ServingEngine:
         return time.monotonic() - self._t0
 
     def _admit(self, now):
+        if not self._admitting:
+            return 0  # quiesced: the queue holds for drain/stragglers
         admitted = 0
         while self._queue:
             free = [i for i, s in enumerate(self._slots) if s is None]

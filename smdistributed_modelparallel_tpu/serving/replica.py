@@ -32,7 +32,9 @@ import json
 import time
 
 from smdistributed_modelparallel_tpu.backend.state import state
-from smdistributed_modelparallel_tpu.serving.engine import ServeRequest
+from smdistributed_modelparallel_tpu.serving.engine import (
+    serve_request_from_record,
+)
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.utils.telemetry import (
     record_failure_detected,
@@ -44,7 +46,8 @@ logger = get_logger()
 
 #: Reserved control tx for serving mirror frames (-1 exit relay, -2
 #: preempt notice, -3 preempt step-edge, -4 heartbeats, -5 recovery
-#: rendezvous, -7 fleet metric snapshots — see backend/native.py).
+#: rendezvous, -7 fleet metric snapshots, -8 controller/router frames —
+#: see backend/native.py and serving/router.py).
 SERVE_MIRROR_TX = -6
 
 
@@ -188,22 +191,10 @@ class ReplicatedServingEngine:
         for rid, rec in sorted(self.shadow[peer].items()):
             if rec.get("done"):
                 continue
-            req = ServeRequest(
-                request_id=rid,
-                prompt=rec["prompt"],
-                max_new_tokens=rec["max_new_tokens"],
-                temperature=rec.get("temperature", 0.0),
-                top_k=rec.get("top_k"),
-                top_p=rec.get("top_p"),
-                eos_token_id=rec.get("eos_token_id"),
-                seed=rec.get("seed", 0),
-                deadline_s=rec.get("deadline_s"),
-                resume_tokens=tuple(rec.get("tokens", ())),
-                # Continue the dead replica's trace: the fused timeline
-                # shows one request spanning both rings instead of a new
-                # request materializing on the survivor.
-                trace_id=rec.get("trace_id"),
-            )
+            # The record carries the dead replica's trace id, so the
+            # fused timeline shows one request spanning both rings
+            # instead of a new request materializing on the survivor.
+            req = serve_request_from_record(rec)
             if self.engine.submit(req):
                 readmitted[rid] = len(req.resume_tokens)
                 record_serve_request("readmitted")

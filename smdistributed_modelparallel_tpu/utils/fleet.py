@@ -875,6 +875,13 @@ class FleetMetricsPlane:
         with self._lock:
             return list(self._ring)
 
+    def last_window(self):
+        """Newest aggregated window, or None before the first closes.
+        The serving controller's policy tick reads this: one fresh
+        window per evaluation, no ring scan."""
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
     @property
     def straggling(self):
         with self._lock:
@@ -992,6 +999,9 @@ class FleetController:
     def tick(self):
         if self.plane is not None:
             self.plane.tick()
+
+    def last_window(self):
+        return self.plane.last_window() if self.plane is not None else None
 
     def stop(self):
         if self.plane is not None:

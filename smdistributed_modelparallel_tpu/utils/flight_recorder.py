@@ -73,6 +73,7 @@ SERVE = "serve"
 FLEET = "fleet"
 GOODPUT = "goodput"
 PERF = "perf"
+CONTROLLER = "controller"
 
 # Field names per kind, applied at dump time (the ring stores bare
 # tuples). Keeping the schema here — not at the record sites — is what
@@ -95,6 +96,7 @@ _FIELDS = {
     FLEET: ("event", "rank", "detail", "wall_us"),
     GOODPUT: ("state", "prev", "elapsed_us"),
     PERF: ("event", "source", "detail", "wall_us"),
+    CONTROLLER: ("event", "detail", "wall_us"),
 }
 
 
@@ -317,6 +319,18 @@ class FlightRecorder:
         if not self.enabled:
             return
         self.record(PERF, str(event), str(source), str(detail),
+                    int(time.time() * 1e6))
+
+    def record_controller(self, event, detail=""):
+        """Serving control-plane events (serving/controller.py): scale
+        event edges (``scale_up`` / ``scale_down`` with phase timings),
+        weight adoptions, canary verdicts, drain begin/end. Wall-stamped
+        like supervisor events so ``scripts/trace_fuse.py`` and
+        ``slo_report --controller`` can line a scale event up against
+        the request spans that triggered it."""
+        if not self.enabled:
+            return
+        self.record(CONTROLLER, str(event), str(detail),
                     int(time.time() * 1e6))
 
     def last_seq(self, group):

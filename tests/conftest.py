@@ -190,6 +190,12 @@ _SLOW_TESTS = (
     # end-to-end compiles.
     "test_tp_overlap.py::TestFusedParity",
     "test_tp_overlap.py::TestComposition",
+    # Controller heavy extra-compile case: the policy/router units and
+    # the one-engine composite (drain parity, zero-recompile adoption,
+    # canary promote + chaos rollback) stay fast in test_controller.py;
+    # the in-process burst autoscale end-to-end pays 3 engines' compiles
+    # (static reference, replica0, the warm-started standby).
+    "test_controller.py::TestAutoscaleEndToEnd",
 )
 
 

@@ -1100,6 +1100,288 @@ def test_serving_replica_failover(tmp_path):
         return
 
 
+def _worker_controller_autoscale(rank, world, coord_port, cache_dir,
+                                 dump_dir, conn):
+    """ISSUE 19 acceptance E2E: rank 0 runs the armed ServingController
+    over the native bus; rank 1 parks as a ``ReplicaServer`` standby.
+    A burst breaches the queue-depth SLO, the controller scales 1 -> 2
+    by activating rank 1 (a warm start off rank 1's pre-staged exec
+    cache — the ready frame must show zero fresh compiles), routes the
+    rest of the burst to the new replica, then drains it back 2 -> 1
+    once the queue stays empty. Every stream must be token-identical to
+    a never-scaled single-engine reference."""
+    try:
+        import os
+
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        os.environ["SMP_SUPERVISOR"] = "on"
+        os.environ["SMP_EXEC_CACHE"] = "on"
+        os.environ["SMP_EXEC_CACHE_DIR"] = cache_dir
+        if rank == 0:
+            os.environ["SMP_AUTOSCALE"] = "on"
+            os.environ["SMP_SLO"] = "queue_depth=2"
+            os.environ["SMP_AUTOSCALE_COOLDOWN"] = "0.5"
+            os.environ["SMP_AUTOSCALE_MIN"] = "1"
+            os.environ["SMP_AUTOSCALE_MAX"] = "2"
+            os.environ["SMP_AUTOSCALE_HYSTERESIS"] = "2"
+            os.environ["SMP_CONTROLLER_PATH"] = os.path.join(
+                dump_dir, "controller.jsonl"
+            )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
+        import sys
+
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        import time
+
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        import smdistributed_modelparallel_tpu as smp
+        from smdistributed_modelparallel_tpu.backend.state import state
+        from smdistributed_modelparallel_tpu.models.transformer_lm import (
+            TransformerLM,
+        )
+
+        smp.supervisor.initialize_distributed(
+            f"127.0.0.1:{coord_port}", world, rank
+        )
+        smp.init({"ddp": True})
+        bus = state._comm._bus
+        assert bus is not None
+
+        mod = TransformerLM(
+            vocab_size=61, max_len=32, d_model=16, n_layers=2, n_heads=2,
+        )
+        params = mod.init(
+            jax.random.key(0), jnp.zeros((1, 4), jnp.int32)
+        )["params"]
+
+        def factory():
+            eng = smp.serving.ServingEngine(
+                mod, params=params, max_slots=2, block_tokens_override=4,
+                prefill_chunk=4,
+            )
+            # Programs compile lazily; force them NOW so the remote
+            # activation window (and its warm-start report) covers them.
+            eng._program("prefill")
+            eng._program("decode")
+            return eng
+
+        if rank == 1:
+            # Pre-stage the standby image: build (and discard) the
+            # engine once so activation is a pure exec-cache warm start.
+            # The cache key embeds process_index, so a standby warms its
+            # OWN entries — rank 0's are invisible to it.
+            factory().close()
+            # Park until the controller's activate frame, serve until
+            # its deactivate (sent by the scale-down drain).
+            server = smp.serving.ReplicaServer(factory, bus,
+                                               controller_rank=0)
+            results = server.serve(timeout_s=300.0)
+            conn.send(("ok", rank, sorted(results)))
+            return
+
+        def prompt(seed, n):
+            return list(map(int, np.asarray(jax.random.randint(
+                jax.random.key(seed), (n,), 0, 61
+            ))))
+
+        # 16-token generations keep the first burst in flight across
+        # several policy windows — a warm engine clears short requests
+        # faster than the breach hysteresis can observe them.
+        trace = [(f"b{i}", prompt(90 + i, 4 + i % 3), 16)
+                 for i in range(12)]
+
+        # Rank 0 replica + never-scaled reference.
+        eng0 = factory()
+        reference = eng0.run(
+            [smp.serving.ServeRequest(f"ref_{rid}", p, m)
+             for rid, p, m in trace],
+            timeout_s=240.0,
+        )
+
+        router = smp.serving.RequestRouter()
+        wstate = {"seq": 0, "last": 0.0}
+
+        def _win():
+            now = time.monotonic()
+            if now - wstate["last"] < 0.02:
+                return None
+            wstate["last"] = now
+            wstate["seq"] += 1
+            depth = max(
+                (h.load() for h in router.live_handles()), default=0,
+            )
+            return {"seq": wstate["seq"], "t_wall": time.time(),
+                    "queue_depth": depth}
+
+        ctl = smp.serving.ServingController.from_env(
+            router=router, window_source=_win,
+        )
+        assert ctl is not None, "SMP_AUTOSCALE=on must arm the controller"
+        ctl.register_live(
+            smp.serving.LocalReplicaHandle("replica0", eng0, version=0)
+        )
+        remote = smp.serving.RemoteReplicaHandle(
+            "replica1", bus, peer=1, version=0,
+        )
+
+        def _activate():
+            remote.activate(timeout_s=180.0)
+            return remote
+
+        ctl.add_standby("replica1", _activate)
+
+        reqs = [smp.serving.ServeRequest(rid, p, m) for rid, p, m in trace]
+        for req in reqs[:8]:
+            assert router.dispatch(req)
+        sent = 8
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            if sent < len(reqs) and ctl.replicas == 2:
+                # Second half of the burst lands AFTER the scale-up, so
+                # least-loaded routing must involve the fresh replica.
+                assert router.dispatch(reqs[sent])
+                sent += 1
+            busy = router.step_all()
+            ctl.tick()
+            done = sum(
+                1 for rid, _, _ in trace if rid in ctl.results()
+            )
+            if sent == len(reqs) and not busy and done == len(reqs):
+                break
+            if not busy:
+                time.sleep(0.002)
+        assert sent == len(reqs), f"only {sent} dispatched"
+
+        # Queue is empty now: idle-tick until the comfort streak drains
+        # the remote replica back down (its deactivate ends rank 1).
+        down_deadline = time.monotonic() + 60.0
+        while ctl.replicas > 1 and time.monotonic() < down_deadline:
+            router.step_all()
+            ctl.tick()
+            time.sleep(0.01)
+
+        directions = [e["direction"] for e in ctl.scale_events]
+        assert directions and directions[0] == "up", directions
+        assert "down" in directions, directions
+        up = ctl.scale_events[0]
+        # Warm start off rank 1's pre-staged cache: the ready frame
+        # carries its compile sources — both programs from disk, none
+        # fresh.
+        assert up["warm"].get("fresh", 0) == 0, up["warm"]
+        assert up["warm"].get("disk_cache", 0) >= 2, up["warm"]
+        assert set(up["phases"]) >= {
+            "trigger", "rendezvous", "warm_start", "first_token",
+        }, up["phases"]
+        down = next(e for e in ctl.scale_events
+                    if e["direction"] == "down")
+        assert down["stragglers"] == 0, down
+        assert set(down["phases"]) == {"drain", "reroute"}, down["phases"]
+        assert router.routed.get("replica1", 0) >= 1, router.routed
+
+        # Token parity across scale-up, remote serving, and the drain.
+        results = ctl.results()
+        for rid, _, _ in trace:
+            assert list(results[rid]) == list(reference[f"ref_{rid}"]), rid
+
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            telemetry,
+        )
+
+        repm = telemetry.report()["metrics"]
+        dirs = {
+            s["labels"]["direction"]: s["value"]
+            for s in repm["smp_autoscale_events_total"]["series"]
+        }
+        assert dirs.get("up") == 1 and dirs.get("down") == 1, dirs
+        assert repm["smp_controller_replicas"]["series"][0]["value"] == 1
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts",
+        ))
+        import slo_report
+
+        assert slo_report.main(
+            [os.environ["SMP_CONTROLLER_PATH"], "--controller",
+             "--check", "--max-scale-seconds", "180"]
+        ) == 0
+        ctl.stop()
+        conn.send(("ok", rank, directions))
+    except Exception as e:  # pragma: no cover - surfaced in parent
+        import traceback
+
+        conn.send(("err", f"rank {rank}: {e}\n{traceback.format_exc()}"))
+
+
+def test_controller_autoscale_two_process(tmp_path):
+    """Burst -> scale up to a remote standby (zero fresh compiles off
+    the shared exec cache) -> drain back down; all 12 streams
+    token-identical to the never-scaled reference and the decision feed
+    gates green through slo_report --controller."""
+    ctx = mp.get_context("spawn")
+    for attempt in range(3):
+        coord = _free_port()
+        cache_dir = str(tmp_path / f"cache{attempt}")
+        dump_dir = str(tmp_path / f"dumps{attempt}")
+        os.makedirs(cache_dir, exist_ok=True)
+        os.makedirs(dump_dir, exist_ok=True)
+        parents, procs = [], []
+        try:
+            for rank in range(2):
+                parent, child = ctx.Pipe()
+                p = ctx.Process(
+                    target=_worker_controller_autoscale,
+                    args=(rank, 2, coord, cache_dir, dump_dir, child),
+                    daemon=True,
+                )
+                p.start()
+                child.close()
+                parents.append(parent)
+                procs.append(p)
+            assert parents[0].poll(540), "rank 0 timed out"
+            try:
+                r0 = parents[0].recv()
+            except EOFError:
+                r0 = ("err", "rank 0 died without report")
+            assert parents[1].poll(60), "rank 1 timed out"
+            try:
+                r1 = parents[1].recv()
+            except EOFError:
+                r1 = ("err", "rank 1 died without report")
+            for p in procs:
+                p.join(timeout=60)
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+                    p.join(timeout=30)
+        retriable = (
+            r0[0] != "ok" and "in use" in str(r0[1]).lower()
+        ) or (
+            r1[0] != "ok" and "in use" in str(r1[1]).lower()
+        )
+        if retriable and attempt < 2:
+            continue
+        assert r0[0] == "ok", r0
+        assert r1[0] == "ok", r1
+        # The drain is an ORDERLY exit: rank 1 returns its served
+        # results and leaves with status 0 (contrast the failover
+        # test's SIGKILL).
+        assert procs[1].exitcode == 0, procs[1].exitcode
+        directions = r0[2]
+        assert directions[0] == "up" and "down" in directions
+        return
+
+
 def _worker_fleet_aggregator_kill(rank, world, ports, fleet_path, conn):
     """PR-17 acceptance E2E worker: a bare native-bus world (the jax
     coordination service cannot be in the picture — its rank-0 process
