@@ -1432,6 +1432,228 @@ def _autoscale_probe(deadline):
         smp.reset()
 
 
+def _quant_probe(deadline):
+    """SMP_BENCH_QUANT_PROBE=1: the low-precision A/Bs behind smp.quant.
+
+    Two legs, each window-capped and compile-excluded:
+
+    - **train**: bf16 vs ``matmul_precision: fp8`` (delayed-scaling e4m3
+      fwd / e5m2 grad) on the smp.nn transformer family the fp8 seams
+      live in — median step ms per leg, the max relative loss deviation
+      over the measured trajectory (the parity number the tolerance in
+      docs/README quotes), and the fp8 leg's X-ray ``quant`` census.
+    - **decode**: bf16 KV pool vs ``SMP_KV_QUANT=int8`` (per-block-per-
+      head scales) through the serving engine on the same greedy request
+      trace — tokens/sec per leg, per-block pool bytes per leg (the
+      ``smp_serve_kv_bytes`` multiplier, so the ~2x concurrency claim is
+      a measured byte ratio, not an inference), and row-for-row greedy
+      token parity.
+
+    The block stamped into BENCH_r*.json as ``"quant"`` is
+    schema-checked by scripts/perf_ledger.py. The pass criterion is a
+    TPU criterion recorded in BENCH_NOTES.md Round 20 — XLA:CPU has no
+    native fp8 matmul units (the f8 ops lower to convert+f32 dots) and
+    no int8 attention gather fusion, so BOTH quantized legs read slower
+    on the CPU smoke; the CPU numbers prove plumbing, byte ratios, and
+    parity only. Never fails the bench."""
+    import jax
+    import numpy as np
+
+    if time.time() > deadline - 30:
+        sys.stderr.write(
+            "bench: quant probe skipped (probe window exhausted)\n"
+        )
+        return None
+    import jax.numpy as jnp
+    import optax
+
+    import smdistributed_modelparallel_tpu as smp
+    from smdistributed_modelparallel_tpu.nn.cross_entropy import (
+        vocab_parallel_cross_entropy,
+    )
+    from smdistributed_modelparallel_tpu.nn.transformer import (
+        DistributedTransformerLMHead,
+    )
+    from smdistributed_modelparallel_tpu.utils import hlo_audit
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    n_layers, d_model, n_heads, hd, ff, seq, vocab = (
+        (8, 1024, 16, 64, 4096, 1024, 32000) if on_tpu
+        else (2, 32, 4, 8, 64, 16, 96)
+    )
+    batch = 8
+    iters = 10 if on_tpu else 3
+    env_prev = {k: os.environ.get(k)
+                for k in ("SMP_KV_QUANT", "SMP_DECODE_WEIGHTS")}
+    try:
+        # ---- train leg: bf16 vs fp8 -----------------------------------
+        def build(precision):
+            smp.reset()
+            smp.init({"microbatches": 2, "ddp": True,
+                      "bf16": bool(on_tpu),
+                      "matmul_precision": precision})
+            model = smp.DistributedModel(DistributedTransformerLMHead(
+                num_layers=n_layers, num_attention_heads=n_heads,
+                attention_head_size=hd, hidden_size=d_model,
+                intermediate_size=ff, vocab_size=vocab,
+                num_positions=seq, causal_mask_size=seq,
+                pre_layernorm=True, post_layernorm=False,
+                final_layernorm=True, attention_dropout_prob=0.0,
+                hidden_dropout_prob=0.0, embedding_dropout_prob=0.0,
+            ))
+            optimizer = smp.DistributedOptimizer(optax.sgd(1e-3), model)
+            ids = jax.random.randint(
+                jax.random.key(0), (batch, seq), 0, vocab
+            )
+
+            @smp.step
+            def train_step(model, b):
+                logits = model(b)
+                loss = jnp.mean(
+                    vocab_parallel_cross_entropy(logits[:, :-1], b[:, 1:])
+                )
+                model.backward(loss)
+                return loss
+
+            return model, optimizer, train_step, ids
+
+        times = {"bf16": [], "fp8": []}
+        losses = {"bf16": [], "fp8": []}
+        quant_xray = None
+        for _round in range(3):
+            for precision in ("bf16", "fp8"):
+                model, optimizer, train_step, ids = build(precision)
+                out = None
+                for _ in range(2):   # warmup: compile + first dispatch
+                    out = train_step(model, ids)
+                    optimizer.step()
+                _readback(out.reduce_mean())
+                if precision == "fp8" and quant_xray is None:
+                    audit = hlo_audit.of_step_function(train_step)
+                    if audit is not None:
+                        quant_xray = audit.quant
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = train_step(model, ids)
+                    optimizer.step()
+                    if _round == 0:
+                        losses[precision].append(
+                            float(out.reduce_mean())
+                        )
+                if _round > 0:
+                    _readback(out.reduce_mean())
+                times[precision].append(
+                    (time.perf_counter() - t0) / iters
+                )
+            if time.time() > deadline:
+                sys.stderr.write(
+                    "bench: quant train leg hit the window deadline; "
+                    f"using the {len(times['fp8'])} round(s) measured "
+                    "so far.\n")
+                break
+        med = {k: _median(v) for k, v in times.items()}
+        n_cmp = min(len(losses["bf16"]), len(losses["fp8"]))
+        loss_rel = max(
+            (abs(losses["fp8"][i] - losses["bf16"][i])
+             / max(abs(losses["bf16"][i]), 1e-12)
+             for i in range(n_cmp)),
+            default=0.0,
+        )
+        train_block = {
+            "bf16_ms": round(med["bf16"] * 1e3, 3),
+            "fp8_ms": round(med["fp8"] * 1e3, 3),
+            "speedup_fp8": round(med["bf16"] / med["fp8"], 4),
+            "loss_rel_diff": round(loss_rel, 6),
+            "steps_compared": n_cmp,
+            "quant_xray": quant_xray,
+        }
+
+        # ---- decode leg: bf16 KV vs int8 KV ---------------------------
+        from smdistributed_modelparallel_tpu.models.transformer_lm import (
+            TransformerLM,
+        )
+
+        plen = 8
+        max_news = [16, 12, 16, 12, 16, 12]
+        prompts = [
+            list(map(int, np.asarray(jax.random.randint(
+                jax.random.key(200 + i), (plen,), 0, 128
+            ))))
+            for i in range(len(max_news))
+        ]
+
+        def serve(kv_mode):
+            if kv_mode == "none":
+                os.environ.pop("SMP_KV_QUANT", None)
+            else:
+                os.environ["SMP_KV_QUANT"] = kv_mode
+            smp.reset()
+            smp.init({})
+            mod = TransformerLM(
+                vocab_size=512, max_len=64,
+                d_model=384 if on_tpu else 64,
+                n_layers=4 if on_tpu else 2, n_heads=4,
+            )
+            params = mod.init(
+                jax.random.key(0), jnp.asarray(prompts[0])[None]
+            )["params"]
+            engine = smp.serving.ServingEngine(
+                mod, params=params, max_slots=3,
+                block_tokens_override=8, prefill_chunk=8,
+            )
+            engine._program("prefill")   # compile warmup
+            engine._program("decode")
+            reqs = [
+                smp.serving.ServeRequest(f"q{i}", prompts[i], max_news[i])
+                for i in range(len(max_news))
+            ]
+            t0 = time.perf_counter()
+            results = engine.run(
+                reqs, timeout_s=max(deadline - time.time(), 30)
+            )
+            wall = time.perf_counter() - t0
+            toks = {
+                rid: list(map(int, results[rid])) for rid in results
+            }
+            tps = sum(max_news) / wall
+            bb = engine.kv_block_bytes
+            engine.close()
+            return toks, tps, bb
+
+        base_toks, base_tps, base_bb = serve("none")
+        kv_toks, kv_tps, kv_bb = serve("int8")
+        decode_block = {
+            "bf16_tokens_per_sec": round(base_tps, 2),
+            "int8_kv_tokens_per_sec": round(kv_tps, 2),
+            "speedup_kv": round(kv_tps / base_tps, 4),
+            "kv_block_bytes_bf16": int(base_bb),
+            "kv_block_bytes_int8": int(kv_bb),
+            "kv_bytes_ratio": round(kv_bb / base_bb, 4),
+            "token_parity": bool(kv_toks == base_toks),
+            "requests": len(max_news),
+        }
+
+        result = {
+            "component": "quant",
+            "train": train_block,
+            "decode": decode_block,
+            "on_tpu": on_tpu,
+        }
+        sys.stderr.write(json.dumps(result) + "\n")
+        sys.stderr.flush()
+        return result
+    except Exception as e:  # the probe must never kill the bench
+        sys.stderr.write(f"bench: quant probe failed ({e!r})\n")
+        return None
+    finally:
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        smp.reset()
+
+
 def main():
     start_time = time.time()
     probe_window = int(os.environ.get("SMP_BENCH_PROBE_WINDOW", 1200))
@@ -1783,6 +2005,12 @@ def main():
         # Also re-inits the framework (single-device serving config).
         autoscale_out = _autoscale_probe(deadline=start_time + probe_window)
 
+    quant_out = None
+    if os.environ.get("SMP_BENCH_QUANT_PROBE", "0") == "1":
+        # Also re-inits the framework (the precision knob changes the
+        # compiled step program).
+        quant_out = _quant_probe(deadline=start_time + probe_window)
+
     from smdistributed_modelparallel_tpu.ops.attention import _pallas_ok
 
     q_probe = jnp.zeros((batch // num_mb, seq_len, 12, 64), jnp.bfloat16)
@@ -1820,6 +2048,8 @@ def main():
         result["serving"] = serving_out
     if autoscale_out is not None:
         result["autoscale"] = autoscale_out
+    if quant_out is not None:
+        result["quant"] = quant_out
     if zero_probe_out is not None:
         result["zero_probe"] = zero_probe_out
     if tp_probe_out is not None:

@@ -44,10 +44,13 @@ CI stays unflaky):
   schema-checked when present (numeric ``cold_s``/``warm_s``/``speedup``,
   internally consistent) and rendered per round;
 - the ``zero_probe`` / ``pipeline_probe`` / ``serving`` /
-  ``autoscale`` / ``tp_overlap`` blocks (the other bench probe A/Bs,
-  SMP_BENCH_ZERO_PROBE / SMP_BENCH_PIPELINE_PROBE /
+  ``autoscale`` / ``tp_overlap`` / ``quant`` blocks (the other bench
+  probe A/Bs, SMP_BENCH_ZERO_PROBE / SMP_BENCH_PIPELINE_PROBE /
   SMP_BENCH_SERVE_PROBE / SMP_BENCH_AUTOSCALE_PROBE /
-  SMP_BENCH_TP_PROBE — for ``tp_overlap``,
+  SMP_BENCH_TP_PROBE / SMP_BENCH_QUANT_PROBE — for ``quant``, the
+  bf16-vs-fp8 train-step A/B (delayed-scaling e4m3/e5m2, loss-drift
+  parity) plus the bf16-vs-int8 paged-KV decode A/B (token parity and
+  the measured per-block pool byte ratio); for ``tp_overlap``,
   GSPMD vs the ring decomposition vs ring + fused Pallas kernels at
   tp=2; for ``autoscale``, a bursty ragged-arrival trace served static
   vs SLO-autoscaled with a mid-run canaried weight update) are
@@ -384,6 +387,57 @@ def _autoscale_schema_problem(probe):
     return None
 
 
+def _quant_probe_schema_problem(probe):
+    """Why a round's ``quant`` block (bench.py SMP_BENCH_QUANT_PROBE
+    bf16-vs-fp8 train A/B + bf16-vs-int8-KV decode A/B) is malformed,
+    or None. Absent blocks are fine — rounds predating smp.quant, or
+    probe not requested."""
+    if probe is None:
+        return None
+    if not isinstance(probe, dict):
+        return f"'quant' must be an object, got {type(probe).__name__}"
+    if probe.get("component") != "quant":
+        return "'quant.component' must be the string 'quant'"
+    train = probe.get("train")
+    if train is not None:
+        if not isinstance(train, dict):
+            return "'quant.train' must be an object when present"
+        for key in ("bf16_ms", "fp8_ms", "speedup_fp8", "loss_rel_diff"):
+            if not isinstance(train.get(key), (int, float)):
+                return f"'quant.train' lacks a numeric '{key}'"
+        if train["fp8_ms"] > 0 and abs(
+            train["speedup_fp8"] - train["bf16_ms"] / train["fp8_ms"]
+        ) > max(0.05 * train["speedup_fp8"], 0.05):
+            return "'quant.train.speedup_fp8' inconsistent with bf16_ms/fp8_ms"
+        if train["loss_rel_diff"] < 0:
+            return "'quant.train.loss_rel_diff' must be non-negative"
+        xray = train.get("quant_xray")
+        if xray is not None and not isinstance(xray, dict):
+            return "'quant.train.quant_xray' must be an object when present"
+    decode = probe.get("decode")
+    if decode is not None:
+        if not isinstance(decode, dict):
+            return "'quant.decode' must be an object when present"
+        for key in ("bf16_tokens_per_sec", "int8_kv_tokens_per_sec",
+                    "speedup_kv", "kv_block_bytes_bf16",
+                    "kv_block_bytes_int8", "kv_bytes_ratio"):
+            if not isinstance(decode.get(key), (int, float)):
+                return f"'quant.decode' lacks a numeric '{key}'"
+        bb = decode["kv_block_bytes_bf16"]
+        if bb > 0 and abs(
+            decode["kv_bytes_ratio"]
+            - decode["kv_block_bytes_int8"] / bb
+        ) > max(0.05 * decode["kv_bytes_ratio"], 0.005):
+            return ("'quant.decode.kv_bytes_ratio' inconsistent with "
+                    "kv_block_bytes_int8/kv_block_bytes_bf16")
+        if decode.get("token_parity") is False:
+            # A byte ratio at unequal outputs measures nothing.
+            return "'quant.decode.token_parity' is false — the A/B is invalid"
+    if train is None and decode is None:
+        return "'quant' carries neither a 'train' nor a 'decode' leg"
+    return None
+
+
 def _goodput_schema_problem(block):
     """Why a round's ``goodput`` block (bench.py's wall-clock attribution
     ledger stamp) is malformed, or None. Absent blocks are fine — rounds
@@ -463,6 +517,7 @@ def build_ledger(repo, threshold=0.05):
             "pipeline_probe": None,
             "serving": None,
             "autoscale": None,
+            "quant": None,
             "goodput": None,
             "documented": n in documented,
         }
@@ -525,6 +580,12 @@ def build_ledger(repo, threshold=0.05):
                     problems.append(f"{name}: {aprobe_problem}")
                     aprobe = None
                 row["autoscale"] = aprobe
+                qprobe = parsed.get("quant")
+                qprobe_problem = _quant_probe_schema_problem(qprobe)
+                if qprobe_problem:
+                    problems.append(f"{name}: {qprobe_problem}")
+                    qprobe = None
+                row["quant"] = qprobe
                 gp = parsed.get("goodput")
                 gp_problem = _goodput_schema_problem(gp)
                 if gp_problem:
@@ -736,6 +797,36 @@ def render_table(ledger, out=sys.stdout):
             if aprobe.get("token_parity"):
                 parts.append("parity ok")
             w(f"{'':>7}autoscale: " + "  ".join(parts) + "\n")
+        qprobe = r.get("quant")
+        if isinstance(qprobe, dict):
+            train = qprobe.get("train")
+            if isinstance(train, dict):
+                parts = [
+                    f"bf16 {train['bf16_ms']:.1f}ms",
+                    f"fp8 {train['fp8_ms']:.1f}ms",
+                    f"speedup {train['speedup_fp8']:.2f}x",
+                    f"loss drift {train['loss_rel_diff']:.2%}",
+                ]
+                xray = train.get("quant_xray") or {}
+                casts = xray.get("f8_casts") or {}
+                if casts:
+                    parts.append(
+                        f"f8 casts e4m3={casts.get('e4m3', 0)} "
+                        f"e5m2={casts.get('e5m2', 0)}"
+                    )
+                w(f"{'':>7}quant train: " + "  ".join(parts) + "\n")
+            decode = qprobe.get("decode")
+            if isinstance(decode, dict):
+                parts = [
+                    f"bf16 {decode['bf16_tokens_per_sec']:,.0f} tok/s",
+                    f"int8-kv {decode['int8_kv_tokens_per_sec']:,.0f} tok/s",
+                    f"kv bytes/block {decode['kv_block_bytes_bf16']:,}B"
+                    f" -> {decode['kv_block_bytes_int8']:,}B"
+                    f" ({decode['kv_bytes_ratio']:.2f}x)",
+                ]
+                if decode.get("token_parity"):
+                    parts.append("parity ok")
+                w(f"{'':>7}quant decode: " + "  ".join(parts) + "\n")
         gp = r.get("goodput")
         if isinstance(gp, dict):
             parts = [

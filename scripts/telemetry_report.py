@@ -505,6 +505,54 @@ def render(report, out=sys.stdout):
             w("  fused-kernel dispatch decisions: " + "  ".join(parts)
               + "\n")
 
+    # -- quant (low-precision dispatch + fp8 delayed-scaling state;
+    # smp.quant) ---------------------------------------------------------
+    # smp_quant_dispatch_total counts the trace-time routing decisions
+    # (which seams engaged fp8 / which knobs fell back), smp_quant_amax /
+    # smp_quant_scale carry the delayed-scaling statistics per site
+    # (latest absorb), and smp_serve_kv_bytes makes the int8 paged-KV
+    # pool halving a measured byte count. Rendered identically for one
+    # dump and the cross-rank aggregate (counters summed; the gauges are
+    # maxed, which is exact for the replicated SPMD quant state).
+    q_disp = _series(report, "smp_quant_dispatch_total")
+    q_amax = _series(report, "smp_quant_amax")
+    kv_bytes_total = _value(report, "smp_serve_kv_bytes", state="total")
+    if q_disp or q_amax or kv_bytes_total is not None:
+        w("\n-- quant --\n")
+        if q_disp:
+            counts = {}
+            for s in q_disp:
+                key = (s["labels"].get("site", "?"),
+                       s["labels"].get("path", "?"))
+                counts[key] = counts.get(key, 0) + s["value"]
+            parts = [
+                f"{site}/{path} x{int(v)}"
+                for (site, path), v in sorted(counts.items())
+            ]
+            w("  dispatch decisions: " + "  ".join(parts) + "\n")
+        observed = [s for s in q_amax if s.get("value", 0) > 0]
+        if q_amax:
+            silent = len(q_amax) - len(observed)
+            if observed:
+                w(f"  {'site':<16}{'amax':>12}{'scale':>12}\n")
+                for s in sorted(
+                    observed, key=lambda s: s["labels"].get("site", "")
+                ):
+                    site = s["labels"].get("site", "?")
+                    scale = _value(report, "smp_quant_scale", site=site)
+                    w(f"  {site:<16}{s['value']:>12.4g}"
+                      + (f"{scale:>12.4g}" if scale is not None
+                         else f"{'n/a':>12}") + "\n")
+            if silent:
+                w(f"  ({silent} slot(s) never observed — scale held at "
+                  "1.0)\n")
+        if kv_bytes_total is not None:
+            kv_bytes_used = _value(
+                report, "smp_serve_kv_bytes", state="used"
+            )
+            w(f"  kv pool bytes: {_fmt_bytes(kv_bytes_used)} used / "
+              f"{_fmt_bytes(kv_bytes_total)} total\n")
+
     # -- serving (smp.serving continuous-batching engine) ---------------
     # Latency distributions (percentiles from the merged log-bucketed
     # histograms — identical in single-dump and cross-rank dir modes,

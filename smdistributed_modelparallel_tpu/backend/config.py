@@ -159,6 +159,22 @@ class ModelParallelConfig:
                     "ring or 0/off/false/none"
                 )
 
+        # Environment alias for the training matmul precision
+        # (SMP_MATMUL_PRECISION), same precedence rule.
+        env_matmul_prec = os.environ.get("SMP_MATMUL_PRECISION")
+        if (env_matmul_prec is not None
+                and "matmul_precision" not in user_config):
+            val = env_matmul_prec.strip().lower()
+            if val in ("fp8", "float8"):
+                user_config["matmul_precision"] = "fp8"
+            elif val in ("0", "off", "false", "none", "bf16", "bfloat16"):
+                user_config["matmul_precision"] = "bf16"
+            else:
+                raise ConfigError(
+                    f"SMP_MATMUL_PRECISION={env_matmul_prec!r}: expected "
+                    "fp8 or bf16/0/off/none"
+                )
+
         # Resolve aliases (e.g. partitions -> pipeline_parallel_degree).
         alias_map = {
             spec["alias"]: key for key, spec in SCHEMA.items() if "alias" in spec

@@ -508,6 +508,25 @@ SCHEMA = {
         "tp. Inert at tensor_parallel_degree 1; does not compose with "
         "context_parallel_degree > 1 (the ring owns the sequence axis).",
     },
+    "matmul_precision": {
+        "type": str,
+        "default": "bf16",
+        "options": ["bf16", "fp8"],
+        "description": "TPU extension: training matmul precision (env "
+        "alias SMP_MATMUL_PRECISION). 'bf16' (default): byte-identical "
+        "programs to older builds — the knob contributes nothing to "
+        "step keys, exec-cache facts, or X-ray fingerprints. 'fp8': "
+        "the matmul seams (tp ring chunk matmuls, fused-QKV Pallas "
+        "kernel, transformer/linear einsum paths, bias+GELU epilogue "
+        "input, attention score operands) quantize to fp8 — e4m3 "
+        "forward operands, e5m2 gradients — with delayed scaling: "
+        "per-slot amax history threaded through the step like the "
+        "fp16 loss scaler (smp.quant.QuantState; checkpointed beside "
+        "it as quant_states.pt). Canonicalizes back to bf16 under "
+        "pipeline_parallel_degree > 1 or sharded_params: zero3 (warn "
+        "once). On CPU/interpret XLA upcasts the f8 dots — CPU runs "
+        "prove parity, not speed (BENCH_NOTES Round 20).",
+    },
     "fused_qkv": {
         "type": bool,
         "default": False,

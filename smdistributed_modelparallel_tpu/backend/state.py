@@ -22,6 +22,7 @@ class ModelParallelState:
         self.tp_registry = None     # lazily created TensorParallelismRegistry
         self.rng_manager = None
         self.loss_scaler = None     # DynamicLossScaler when cfg.fp16
+        self.quant_state = None     # quant.QuantState when matmul_precision fp8
         self.timeline = None        # Timeline (SMP_TIMELINE_PATH)
         self.memory_metrics = None  # StepMemoryMetricsCollector
         self.step_count = 0
@@ -83,6 +84,15 @@ class ModelParallelState:
             self.loss_scaler = DynamicLossScaler()
         else:
             self.loss_scaler = None
+        from smdistributed_modelparallel_tpu import quant
+
+        if quant.matmul_precision_mode(cfg) == "fp8":
+            # Delayed-scaling amax/scale state, threaded through the
+            # step like the loss scaler and checkpointed beside it
+            # (quant_states.pt).
+            self.quant_state = quant.QuantState()
+        else:
+            self.quant_state = None
         from smdistributed_modelparallel_tpu.utils.metrics import (
             StepMemoryMetricsCollector,
         )

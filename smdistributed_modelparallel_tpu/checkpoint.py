@@ -205,6 +205,10 @@ def save_checkpoint(path, tag=None, model=None, optimizer=None,
         scaler_sd = (
             state.loss_scaler.state_dict() if state.loss_scaler else None
         )
+        quant_sd = (
+            state.quant_state.state_dict()
+            if getattr(state, "quant_state", None) is not None else None
+        )
         cfg_snapshot = _smp_config_snapshot()
         import smdistributed_modelparallel_tpu as smp
 
@@ -263,23 +267,29 @@ def save_checkpoint(path, tag=None, model=None, optimizer=None,
                 # Only coordinates OUTSIDE the live degree ranges are
                 # stale — no current rank writes those — plus every copy
                 # when this save carries no scaler at all.
-                for fname in os.listdir(ckpt_dir):
-                    if not (fname.startswith("fp16_states_")
-                            and fname.endswith(".pt")):
-                        continue
-                    parts = fname[len("fp16_states_"):-3].split("_")
-                    try:
-                        coords = [int(p) for p in parts]
-                    except ValueError:
-                        continue
-                    stale = scaler_sd is None or len(coords) != 3 or any(
-                        c >= d for c, d in zip(coords, live_degrees)
-                    )
-                    if stale:
+                for prefix, present in (
+                    ("fp16_states_", scaler_sd is not None),
+                    # Same per-coordinate replicated-struct layout for the
+                    # fp8 delayed-scaling state (quant_states_*.pt).
+                    ("quant_states_", quant_sd is not None),
+                ):
+                    for fname in os.listdir(ckpt_dir):
+                        if not (fname.startswith(prefix)
+                                and fname.endswith(".pt")):
+                            continue
+                        parts = fname[len(prefix):-3].split("_")
                         try:
-                            os.unlink(os.path.join(ckpt_dir, fname))
-                        except OSError:
-                            pass
+                            coords = [int(p) for p in parts]
+                        except ValueError:
+                            continue
+                        stale = not present or len(coords) != 3 or any(
+                            c >= d for c, d in zip(coords, live_degrees)
+                        )
+                        if stale:
+                            try:
+                                os.unlink(os.path.join(ckpt_dir, fname))
+                            except OSError:
+                                pass
             if model_payload is not None:
                 # True per-rank shards (reference: per-rank partial files,
                 # torch/checkpoint.py:124-165): each process writes only
@@ -296,6 +306,8 @@ def save_checkpoint(path, tag=None, model=None, optimizer=None,
                 )
             if scaler_sd is not None:
                 save(scaler_sd, os.path.join(ckpt_dir, "fp16_states.pt"))
+            if quant_sd is not None:
+                save(quant_sd, os.path.join(ckpt_dir, "quant_states.pt"))
             with open(os.path.join(ckpt_dir, "user_content.pt"), "wb") as fh:
                 pickle.dump(user_content, fh, protocol=4)
             with open(os.path.join(ckpt_dir, "smp_config.pt"), "wb") as fh:
@@ -726,6 +738,18 @@ def _resume_from_checkpoint(path, tag=None, partial=True, strict=True,
                 if any_fp16:
                     with open(any_fp16[0], "rb") as fh:
                         state.loss_scaler.load_state_dict(pickle.load(fh))
+        if getattr(state, "quant_state", None) is not None:
+            quant_path = os.path.join(ckpt_dir, "quant_states.pt")
+            if os.path.exists(_partial_name(quant_path)):
+                state.quant_state.load_state_dict(load(quant_path))
+            else:
+                # Elastic resume for the replicated fp8 amax/scale struct:
+                # any saved coordinate's copy is THE copy.
+                stem, ext = os.path.splitext(quant_path)
+                any_quant = sorted(_glob.glob(f"{stem}_*{ext}"))
+                if any_quant:
+                    with open(any_quant[0], "rb") as fh:
+                        state.quant_state.load_state_dict(pickle.load(fh))
         with open(os.path.join(ckpt_dir, "user_content.pt"), "rb") as fh:
             user_content = pickle.load(fh)
     else:

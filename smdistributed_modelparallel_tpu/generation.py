@@ -122,9 +122,16 @@ def _half_cast(params, half):
     """Match the training step's compute dtype: under bf16/fp16 configs
     the decode forward runs on half-precision params, so generation
     throughput and numerics track training (shared predicate:
-    nn/utils.half_cast)."""
+    nn/utils.half_cast). Under ``SMP_DECODE_WEIGHTS=int8`` the params
+    first round-trip through the serving path's per-channel int8 grid
+    (fake-quant — value-identical to store-int8 + dequant), so
+    ``smp.generate`` and the serving engine emit the same tokens under
+    the same knob."""
+    from smdistributed_modelparallel_tpu import quant
     from smdistributed_modelparallel_tpu.nn.utils import half_cast
 
+    if quant.decode_weights_mode() == "int8":
+        params = quant.fake_quant_decode_params(params)
     return half_cast(params, half)
 
 
@@ -689,11 +696,14 @@ def generate(model, input_ids, max_new_tokens, *, temperature=0.0,
         # The mesh is part of the key: sharding constraints traced into the
         # program bind the mesh active at trace time (smp.reset + re-init
         # with a different mesh must not reuse a stale program).
+        from smdistributed_modelparallel_tpu import quant as _quant
+
         key = (module, B, T, max_new_tokens, float(temperature), top_k,
                top_p, eos_token_id, pad_token_id, decoder_start_token_id,
                has_mask, attention_mask is not None, num_beams,
                float(length_penalty), num_return_sequences, str(half),
-               state.mesh if state.initialized else None)
+               state.mesh if state.initialized else None
+               ) + _quant.serving_key_suffix()
         compiled = _COMPILED.get(key)
         if compiled is not None:
             _COMPILED.move_to_end(key)

@@ -42,6 +42,22 @@ from smdistributed_modelparallel_tpu.nn.utils import (
 )
 
 
+def _maybe_fp8_matmul(x, w, site):
+    """The GSPMD-path matmul, routed through the fp8 delayed-scaling
+    seam when a quant step trace is active (matmul_precision: fp8);
+    byte-identical ``x @ w`` otherwise."""
+    from smdistributed_modelparallel_tpu import quant
+
+    if quant.fp8_trace_active():
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            record_quant_dispatch,
+        )
+
+        record_quant_dispatch(site, "fp8")
+        return quant.fp8_matmul(x, w, site)
+    return x @ w
+
+
 class DistributedLinear(nn.Module):
     """Row-parallel (input-partitioned) linear: y = x @ W + b.
 
@@ -92,7 +108,7 @@ class DistributedLinear(nn.Module):
             # matmul; XLA reduces. (Reference: scatter_and_merge input then
             # local matmul, torch/nn/linear.py:40-57.)
             x = shard_activation(x, *([None] * (x.ndim - 1) + [TP_AXIS]))
-            y = x @ kernel.astype(x.dtype)
+            y = _maybe_fp8_matmul(x, kernel.astype(x.dtype), "linear_row")
             y = shard_activation(y, *([None] * y.ndim))
         if self.use_bias:
             bias = self.param(
@@ -154,7 +170,7 @@ class ColumnParallelLinear(nn.Module):
                 return shard_activation(
                     y, *([None] * (y.ndim - 1) + [TP_AXIS])
                 )
-        y = x @ kernel.astype(x.dtype)
+        y = _maybe_fp8_matmul(x, kernel.astype(x.dtype), "linear_col")
         if bias is not None:
             y = y + bias.astype(y.dtype)
         return shard_activation(y, *([None] * (y.ndim - 1) + [TP_AXIS]))

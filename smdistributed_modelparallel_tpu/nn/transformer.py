@@ -97,6 +97,26 @@ def _init(range_, use_normal=True):
     return nn.initializers.normal(stddev=range_)
 
 
+def _fp8_active():
+    """Whether this trace dispatches the fp8 matmul seams (a quant step
+    trace is installed — matmul_precision: fp8, training step only)."""
+    from smdistributed_modelparallel_tpu import quant
+
+    return quant.fp8_trace_active()
+
+
+def _fp8_mm(x, w, site, **kw):
+    """The fp8 delayed-scaling matmul for one transformer seam, with
+    the dispatch decision counted (``smp_quant_dispatch_total``)."""
+    from smdistributed_modelparallel_tpu import quant
+    from smdistributed_modelparallel_tpu.utils.telemetry import (
+        record_quant_dispatch,
+    )
+
+    record_quant_dispatch(site, "fp8")
+    return quant.fp8_matmul(x, w, site, **kw)
+
+
 def apply_rotary(q, k, rotary_dim, base=10000.0, neox_style=False, offset=0):
     """Rotary position embedding on the first ``rotary_dim`` channels.
 
@@ -233,7 +253,12 @@ class DistributedAttentionLayer(nn.Module):
                 (D, 2, H, hd),
                 dtype,
             )
-            q = jnp.einsum("btd,dhk->bthk", hidden, q_kernel.astype(hidden.dtype))
+            if _fp8_active():
+                q = _fp8_mm(hidden, q_kernel.astype(hidden.dtype), "qkv")
+            else:
+                q = jnp.einsum(
+                    "btd,dhk->bthk", hidden, q_kernel.astype(hidden.dtype)
+                )
             if self.use_qkv_bias:
                 q_bias = self.param(
                     "query/bias", partitioned(nn.initializers.zeros, (TP_AXIS, None)),
@@ -305,16 +330,46 @@ class DistributedAttentionLayer(nn.Module):
                     matmul_bias,
                 )
 
-                qkv5 = matmul_bias(
-                    hidden.reshape(-1, D),
-                    qkv_kernel.astype(hidden.dtype).reshape(D, 3 * H * hd),
-                    qkv_bias.astype(hidden.dtype)
-                    if qkv_bias is not None else None,
-                    interpret=jax.default_backend() != "tpu",
-                ).reshape(B, T, 3, H, hd)
+                if _fp8_active():
+                    # The fp8 rung of the fused-QKV ladder: same tiling,
+                    # e4m3 operand refs (pallas_qkv.matmul_bias_fp8),
+                    # dequant + bias in the XLA epilogue.
+                    qkv5 = _fp8_mm(
+                        hidden.reshape(-1, D),
+                        qkv_kernel.astype(hidden.dtype).reshape(
+                            D, 3 * H * hd
+                        ),
+                        "qkv",
+                        bias=qkv_bias.astype(hidden.dtype)
+                        if qkv_bias is not None else None,
+                        use_pallas=True,
+                        interpret=jax.default_backend() != "tpu",
+                    ).reshape(B, T, 3, H, hd)
+                else:
+                    qkv5 = matmul_bias(
+                        hidden.reshape(-1, D),
+                        qkv_kernel.astype(hidden.dtype).reshape(
+                            D, 3 * H * hd
+                        ),
+                        qkv_bias.astype(hidden.dtype)
+                        if qkv_bias is not None else None,
+                        interpret=jax.default_backend() != "tpu",
+                    ).reshape(B, T, 3, H, hd)
             self._record_qkv_dispatch(fused_qkv and qkv5 is not None)
             if qkv5 is not None:
                 q, k, v = qkv5[:, :, 0], qkv5[:, :, 1], qkv5[:, :, 2]
+            elif _fp8_active():
+                # [B, T, 3, H, hd] (the fp8 path contracts D in place —
+                # the c axis rides behind t instead of in front; the
+                # slices below account for the layout).
+                qkv = _fp8_mm(
+                    hidden, qkv_kernel.astype(hidden.dtype), "qkv"
+                )
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                if qkv_bias is not None:
+                    q = q + qkv_bias[0].astype(q.dtype)
+                    k = k + qkv_bias[1].astype(k.dtype)
+                    v = v + qkv_bias[2].astype(v.dtype)
             else:
                 qkv = jnp.einsum("btd,dchk->bcthk", hidden, qkv_kernel.astype(hidden.dtype))
                 q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
@@ -408,6 +463,18 @@ class DistributedAttentionLayer(nn.Module):
             or self.attention_dropout_prob == 0.0
             else self.make_rng("dropout")
         )
+        if _fp8_active():
+            # fp8 handoff precision for the score matmul: q/k round to
+            # the e4m3 grid with their slots' delayed scales (straight-
+            # through gradient), then the flash/jnp attention runs as
+            # built — the values the score dot consumes are exactly the
+            # ones a native-f8 kernel would see. A real in-kernel fp8
+            # flash pass is the TPU follow-up (its backward would hand
+            # f8-dtyped cotangents across the custom_vjp boundary).
+            from smdistributed_modelparallel_tpu import quant as _quant
+
+            q = _quant.fake_quant(q, "attn_q.x")
+            k = _quant.fake_quant(k, "attn_k.x")
         ctx = attention_core(
             q, k, v,
             causal=causal,
@@ -444,7 +511,15 @@ class DistributedAttentionLayer(nn.Module):
                 n_contract=2, x_tp_dim=2,
             )
         if out is None:
-            out = jnp.einsum("bthk,hkd->btd", ctx, proj_kernel.astype(ctx.dtype))
+            if _fp8_active():
+                out = _fp8_mm(
+                    ctx, proj_kernel.astype(ctx.dtype), "attn_proj",
+                    n_contract=2,
+                )
+            else:
+                out = jnp.einsum(
+                    "bthk,hkd->btd", ctx, proj_kernel.astype(ctx.dtype)
+                )
         out = shard_activation(out, *_hidden_spec(_seq_parallel(memory_opt)))
         if self.use_attn_dense_bias:
             proj_bias = self.param(
@@ -537,7 +612,12 @@ class DistributedTransformerOutputLayer(nn.Module):
                     w_tp_dim=1,
                 )
             if y is None:
-                y = hidden @ kernel.astype(hidden.dtype)
+                if _fp8_active():
+                    y = _fp8_mm(
+                        hidden, kernel.astype(hidden.dtype), "mlp_fc"
+                    )
+                else:
+                    y = hidden @ kernel.astype(hidden.dtype)
                 y = shard_activation(y, BATCH_AXES, CP_AXIS, TP_AXIS)
                 if bias is not None:
                     y = y + bias.astype(y.dtype)
@@ -576,7 +656,10 @@ class DistributedTransformerOutputLayer(nn.Module):
             out = ring_rs_matmul(h, proj_kernel.astype(h.dtype),
                                  n_contract=1)
         if out is None:
-            out = h @ proj_kernel.astype(h.dtype)
+            if _fp8_active():
+                out = _fp8_mm(h, proj_kernel.astype(h.dtype), "mlp_proj")
+            else:
+                out = h @ proj_kernel.astype(h.dtype)
         out = shard_activation(out, *_hidden_spec(_seq_parallel(memory_opt)))
         if self.use_mlp_bias:
             proj_bias = self.param(
@@ -791,7 +874,18 @@ class _LayerScanBody(nn.Module):
             x, cross_states=cross_states, attention_mask=attention_mask, xs=xs
         )
         out = name_layer_activation(out)
-        return (out, cross_states, attention_mask), None
+        ys = None
+        if _fp8_active():
+            # The fp8 seams inside this body recorded amax observations
+            # on THIS scan trace; drain them into per-layer ys so they
+            # escape the nn.scan — the Python-side pending dict cannot
+            # carry tracers across the scan boundary.
+            from smdistributed_modelparallel_tpu import quant as _q
+
+            qd = _q.scan_drain()
+            if qd:
+                ys = qd
+        return (out, cross_states, attention_mask), ys
 
 
 class DistributedTransformer(nn.Module):
@@ -931,9 +1025,16 @@ class DistributedTransformer(nn.Module):
         self.seq_layers = ScanLayers(self._layer_kwargs(), name="seq_layers")
 
     def __call__(self, hidden, cross_states=None, attention_mask=None):
-        (out, _, _), _ = self.seq_layers(
+        (out, _, _), ys = self.seq_layers(
             (hidden, cross_states, attention_mask), self.layer_xs()
         )
+        if ys is not None:
+            # Stacked per-layer amax from the body's quant drain: fold
+            # the max over layers back into the enclosing trace level
+            # (the microbatch body re-drains it into ITS ys).
+            from smdistributed_modelparallel_tpu import quant as _q
+
+            _q.absorb_stacked(ys)
         return out
 
     # -- pipeline decomposition: identity embed/head carrying the side

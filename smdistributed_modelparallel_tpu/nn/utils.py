@@ -158,9 +158,24 @@ def fused_bias_gelu(h, b):
     feature dim is tp-sharded, so the call runs inside a tp manual
     region handing the kernel its local block (a plain pallas_call on
     the sharded array would force a gather); at tp=1 it is a direct
-    call. Callers guard with ``pallas_gelu.bias_gelu_ok``."""
+    call. Callers guard with ``pallas_gelu.bias_gelu_ok``.
+
+    Under ``matmul_precision: fp8`` the epilogue INPUT rounds to the
+    e4m3 grid with the ``gelu_in`` slot's delayed scale (straight-
+    through gradient) before the kernel — the handoff between the fp8
+    matmul and the fused activation carries fp8 information content,
+    matching what a fused fp8-epilogue kernel would hand over."""
     from smdistributed_modelparallel_tpu.ops.pallas_gelu import bias_gelu
 
+    from smdistributed_modelparallel_tpu import quant
+
+    if quant.fp8_trace_active():
+        from smdistributed_modelparallel_tpu.utils.telemetry import (
+            record_quant_dispatch,
+        )
+
+        record_quant_dispatch("gelu_in", "fp8")
+        h = quant.fake_quant(h, "gelu_in.x")
     interpret = jax.default_backend() != "tpu"
     mesh = _mesh()
     tp = mesh.shape.get(TP_AXIS, 1) if mesh is not None else 1
@@ -385,13 +400,32 @@ class PagedKVCache:
 
     def __init__(self, mod, num_blocks, block_tokens, heads, head_dim,
                  dtype):
+        from smdistributed_modelparallel_tpu import quant as _quant
+
+        # SMP_KV_QUANT=int8: the pools store int8 with per-block-per-head
+        # scale sidecars ([num_blocks, H] f32 — running block maxima that
+        # only grow), halving the pool bytes; decode dequantizes at the
+        # gather. The knob is static env config, so the two layouts are
+        # different compiled programs (serving keys carry the suffix).
+        self._quant = _quant.kv_quant_mode() == "int8"
+        self._dtype = dtype
         shape = (num_blocks, block_tokens, heads, head_dim)
+        pool_dtype = _quant.kv_pool_dtype(dtype)
         self._pk = mod.variable(
-            "cache", "pool_key", lambda: jnp.zeros(shape, dtype)
+            "cache", "pool_key", lambda: jnp.zeros(shape, pool_dtype)
         )
         self._pv = mod.variable(
-            "cache", "pool_value", lambda: jnp.zeros(shape, dtype)
+            "cache", "pool_value", lambda: jnp.zeros(shape, pool_dtype)
         )
+        if self._quant:
+            self._sk = mod.variable(
+                "cache", "scale_key",
+                lambda: jnp.zeros((num_blocks, heads), jnp.float32),
+            )
+            self._sv = mod.variable(
+                "cache", "scale_value",
+                lambda: jnp.zeros((num_blocks, heads), jnp.float32),
+            )
         self.num_blocks = num_blocks
         self.block_tokens = block_tokens
 
@@ -399,6 +433,10 @@ class PagedKVCache:
         # tp shards the head axis, exactly like the activations/contiguous
         # caches; trivial-axis meshes make this a no-op.
         return shard_activation(pool, None, None, TP_AXIS, None)
+
+    def _shard_scale(self, scale):
+        # The scale sidecars shard with the pools' head axis.
+        return shard_activation(scale, None, TP_AXIS)
 
     def append(self, k, v, block_tables, positions, valid=None,
                window=None):
@@ -432,10 +470,31 @@ class PagedKVCache:
             )
         flat = dest.reshape(-1)
         H, hd = k.shape[2], k.shape[3]
-        pk = self._pk.value.reshape(self.num_blocks * bt, H, hd)
-        pv = self._pv.value.reshape(self.num_blocks * bt, H, hd)
-        pk = pk.at[flat].set(k.reshape(B * T, H, hd))
-        pv = pv.at[flat].set(v.reshape(B * T, H, hd))
+        if self._quant:
+            from smdistributed_modelparallel_tpu import quant as _quant
+
+            # int8 pools: grow the touched blocks' scales by the incoming
+            # tokens' per-head amax, requantize the pool under the grown
+            # scales, then write the tokens quantized LAST (so they land
+            # on the final grid — one rounding, not two).
+            blk_flat = flat // bt
+            pk8, sk, qk = _quant.kv_quantize_append(
+                self._pk.value, self._sk.value, k.reshape(B * T, H, hd),
+                blk_flat,
+            )
+            pv8, sv, qv = _quant.kv_quantize_append(
+                self._pv.value, self._sv.value, v.reshape(B * T, H, hd),
+                blk_flat,
+            )
+            pk = pk8.reshape(self.num_blocks * bt, H, hd).at[flat].set(qk)
+            pv = pv8.reshape(self.num_blocks * bt, H, hd).at[flat].set(qv)
+            self._sk.value = self._shard_scale(sk)
+            self._sv.value = self._shard_scale(sv)
+        else:
+            pk = self._pk.value.reshape(self.num_blocks * bt, H, hd)
+            pv = self._pv.value.reshape(self.num_blocks * bt, H, hd)
+            pk = pk.at[flat].set(k.reshape(B * T, H, hd))
+            pv = pv.at[flat].set(v.reshape(B * T, H, hd))
         self._pk.value = self._shard(
             pk.reshape(self.num_blocks, bt, H, hd)
         )
@@ -452,6 +511,14 @@ class PagedKVCache:
         pv_flat = self._pv.value.reshape(self.num_blocks * bt, H, hd)
         k_all = jnp.take(pk_flat, slots, axis=0)        # [B, S, H, hd]
         v_all = jnp.take(pv_flat, slots, axis=0)
+        if self._quant:
+            slot_blocks = slots // bt                   # [B, S]
+            k_all = _quant.kv_dequantize_gather(
+                k_all, self._sk.value, slot_blocks, self._dtype
+            )
+            v_all = _quant.kv_dequantize_gather(
+                v_all, self._sv.value, slot_blocks, self._dtype
+            )
         cols = jnp.arange(max_blocks * bt, dtype=jnp.int32)
         # keep[b, t, j]: column j visible to chunk row t of sequence b.
         keep = cols[None, None, :] <= pos[:, :, None]

@@ -207,6 +207,30 @@ def _chunk_mm(a, w2d, bias, use_pallas, interpret):
     return out
 
 
+def _maybe_fp8_operands(x, w, site):
+    """The ring's fp8 seam (matmul_precision: fp8): round both operands
+    to the fp8 grid with the site's delayed scales at the RING BOUNDARY
+    — inside, the shard_map/fori_loop bodies trace separately, so amax
+    observations recorded there could never escape to the step's
+    QuantState. Operand-level fp8: the partial matmuls consume the
+    e4m3-gridded values (exactly the values a native-f8 MXU pass would
+    see), the f32 ring accumulators and the mirrored backward stay as
+    built. No-op outside a quant step trace."""
+    from smdistributed_modelparallel_tpu import quant
+
+    if not quant.fp8_trace_active():
+        return x, w
+    from smdistributed_modelparallel_tpu.utils.telemetry import (
+        record_quant_dispatch,
+    )
+
+    record_quant_dispatch(site, "fp8")
+    return (
+        quant.fake_quant(x, site + ".x"),
+        quant.fake_quant(w, site + ".w"),
+    )
+
+
 # ----------------------------------------------------------------------
 # ring all-gather matmul (column-parallel)
 # ----------------------------------------------------------------------
@@ -367,6 +391,7 @@ def ring_ag_matmul(x, w, bias=None, *, w_tp_dim=1, fused=False):
         return None
     from smdistributed_modelparallel_tpu.nn.utils import shard_activation
 
+    x, w = _maybe_fp8_operands(x, w, "ring_ag")
     x = shard_activation(
         x, *([None] * (x.ndim - 2) + [TP_AXIS, None])
     )
@@ -510,6 +535,7 @@ def ring_rs_matmul(x, w, *, n_contract=1, x_tp_dim=None):
         return None
     from smdistributed_modelparallel_tpu.nn.utils import shard_activation
 
+    x, w = _maybe_fp8_operands(x, w, "ring_rs")
     x = shard_activation(
         x, *[TP_AXIS if d == x_tp_dim else None for d in range(x.ndim)]
     )

@@ -159,6 +159,52 @@ def matmul_bias(x, w, b=None, *, interpret=False):
     return _matmul_nobias(x, w, interpret)
 
 
+def _mm_fp8_kernel(x_ref, w_ref, y_ref):
+    # Operands stay f8 INTO the dot — the MXU consumes them natively on
+    # f8-capable TPUs; preferred_element_type pins the f32 accumulator.
+    y_ref[...] = jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def matmul_bias_fp8(x8, w8, *, interpret=False):
+    """The fp8 rung of this kernel ladder (matmul_precision: fp8):
+    ``x8 [N, D] @ w8 [D, F] -> f32`` with e4m3 operand refs — the
+    delayed-scaling dequant multiply and the bias add stay in the XLA
+    epilogue (``quant._fp8_mm2d``), keeping the kernel a pure f8 MXU
+    pass. Not differentiable on its own: the caller's custom_vjp owns
+    the e5m2 backward. The (32, 128) floor of ``_BLOCK_CANDIDATES``
+    satisfies the f8 minimum tile; an unfittable contraction width
+    falls back to the plain f8 dot (same operands, XLA-tiled)."""
+    N, D = x8.shape
+    F = w8.shape[1]
+    blocks = _auto_blocks(D)
+    if blocks is None:
+        return jax.lax.dot_general(
+            x8, w8, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    bn, bf = blocks
+    bn = min(bn, max(32, -(-N // 32) * 32))
+    n_pad = -(-N // bn) * bn
+    f_pad = -(-F // bf) * bf
+    xp = _pad_to(x8, n_pad, 0)
+    wp = _pad_to(w8, f_pad, 1)
+    y = pl.pallas_call(
+        _mm_fp8_kernel,
+        grid=(n_pad // bn, f_pad // bf),
+        in_specs=[
+            pl.BlockSpec((bn, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((D, bf), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bn, bf), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, f_pad), jnp.float32),
+        interpret=interpret or FORCE_INTERPRET,
+    )(xp, wp)
+    return y[:N, :F]
+
+
 def fused_qkv_ok(D, ring=False, tp=1):
     """Dispatch precondition for the fused QKV projection: the knob's
     target backend (TPU, or interpret-mode testing), a fitting tile

@@ -78,6 +78,7 @@ from smdistributed_modelparallel_tpu.utils.exceptions import (
 )
 from smdistributed_modelparallel_tpu.utils.logger import get_logger
 from smdistributed_modelparallel_tpu.utils.telemetry import (
+    record_quant_dispatch,
     record_serve_latency,
     record_serve_occupancy,
     record_serve_programs,
@@ -309,6 +310,17 @@ class ServingEngine:
                 "families keep smp.generate)."
             )
         self.module = module
+        from smdistributed_modelparallel_tpu import quant as quant_mod
+
+        # SMP_DECODE_WEIGHTS=int8: weight-only quantization, applied ONCE
+        # here (and at adopt_params) — the resident tree is int8 + per-
+        # output-channel scales; programs dequantize on the way in.
+        self._wq = quant_mod.decode_weights_mode() == "int8"
+        if self._wq:
+            params = quant_mod.quantize_decode_params(params)
+            record_quant_dispatch("decode_weights", "int8")
+        if quant_mod.kv_quant_mode() == "int8":
+            record_quant_dispatch("kv_cache", "int8")
         self.params = params
         self.max_len = int(module.max_len)
         self.bt = int(block_tokens_override or block_tokens())
@@ -355,6 +367,17 @@ class ServingEngine:
         self._t0 = None
         self._gen_tokens = 0
         self._cache = self._init_cache()
+        # Per-block KV bytes, summed over every cache leaf keyed by pool
+        # block (all layers' K/V pools + any int8 scale sidecars) — the
+        # multiplier behind the smp_serve_kv_bytes gauges, so the pool-
+        # bytes halving under SMP_KV_QUANT=int8 is observable, not
+        # inferred.
+        nb = self.alloc.num_blocks
+        self.kv_block_bytes = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(self._cache)
+            if nb in getattr(leaf, "shape", ())
+        ) // nb
         self._chips = max(len(jax.local_devices()), 1)
         # Metrics time-series snapshotter (the autoscaler feed):
         # SMP_TIMESERIES_INTERVAL=0 (the default) constructs NOTHING —
@@ -486,6 +509,13 @@ class ServingEngine:
             int(version) if version is not None else self.weights_version + 1
         )
         params = chaos.on_weight_update(new_version, params)
+        if self._wq:
+            # Quantize BEFORE the aval comparison: the resident tree is
+            # the quantized layout, so like compares with like and the
+            # compiled programs' input avals stay satisfied.
+            from smdistributed_modelparallel_tpu import quant as quant_mod
+
+            params = quant_mod.quantize_decode_params(params)
         old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
         new_leaves, new_def = jax.tree_util.tree_flatten(params)
         if old_def != new_def or [
@@ -531,7 +561,8 @@ class ServingEngine:
 
         def shape_fn(p):
             return self.decode_mod.apply(
-                {"params": p}, jnp.zeros((1, 1), jnp.int32), paged=paged0,
+                {"params": self._deq_params(p)},
+                jnp.zeros((1, 1), jnp.int32), paged=paged0,
                 mutable=["cache"],
             )[1]["cache"]
 
@@ -541,6 +572,16 @@ class ServingEngine:
         )
 
     # -- compiled programs ---------------------------------------------
+
+    def _deq_params(self, params):
+        """Weight-only int8: expand the resident {q, s} tree back to the
+        module's float params INSIDE the program (the dequant fuses into
+        the consuming matmuls' HBM reads). No-op at the default."""
+        if self._wq:
+            from smdistributed_modelparallel_tpu import quant as quant_mod
+
+            params = quant_mod.dequantize_decode_params(params)
+        return params
 
     def _half_params(self, params):
         from smdistributed_modelparallel_tpu.nn.utils import half_cast
@@ -567,7 +608,7 @@ class ServingEngine:
         if kind == "decode":
             def fn(params, cache, toks, positions, tables, temps, top_ks,
                    top_ps, key_data):
-                params = self._half_params(params)
+                params = self._half_params(self._deq_params(params))
                 logits, mut = self.decode_mod.apply(
                     {"params": params, "cache": cache}, toks[:, None],
                     paged={"block_tables": tables, "positions": positions},
@@ -589,7 +630,7 @@ class ServingEngine:
         elif kind == "prefill":
             def fn(params, cache, toks, table, start, valid, temps,
                    top_ks, top_ps, key_data):
-                params = self._half_params(params)
+                params = self._half_params(self._deq_params(params))
                 logits, mut = self.decode_mod.apply(
                     {"params": params, "cache": cache}, toks,
                     paged={"block_tables": table, "positions": start,
@@ -613,11 +654,13 @@ class ServingEngine:
             raise ValueError(kind)
 
         name = f"serving_{kind}"
+        from smdistributed_modelparallel_tpu import quant as quant_mod
+
         key_src = (
             "serving", kind, repr(self.decode_mod), S, MB, C, self.bt,
             str(self.half),
             tuple(sorted(self._mesh.shape.items())) if self._mesh else None,
-        )
+        ) + quant_mod.serving_key_suffix()
         findings_fn = functools.partial(
             hlo_audit.serving_kv_findings, cache_template=self._cache
         )
@@ -966,6 +1009,7 @@ class ServingEngine:
             kv_free=self.alloc.free_blocks,
             kv_reserved=snap[3],
             kv_total=self.alloc.num_blocks,
+            block_bytes=self.kv_block_bytes,
         )
 
     def _progress_of_admitted(self, n):

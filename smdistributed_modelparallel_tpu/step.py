@@ -408,7 +408,17 @@ class StepFunction:
             () if tmode == "off" and not _fused_qkv
             else ((tmode, _fused_qkv),)
         )
-        key_pre = (pipe_key, zero_key) + recompute_key + tp_overlap_key + (
+        # Low-precision knob, canonicalized the same way: the default
+        # ("bf16", also the pp>1/zero3 fallback via
+        # quant.matmul_precision_mode) contributes NOTHING — default
+        # keys and the committed goldens stay byte-identical — while
+        # fp8 rebuilds the program (quantized seams, the QuantState
+        # input/output) at identical shapes. Mirrored in the exec-cache
+        # knob facts.
+        from smdistributed_modelparallel_tpu import quant as quant_mod
+        qmode = quant_mod.matmul_precision_mode(cfg)
+        quant_key = () if qmode == "bf16" else ((qmode,),)
+        key_pre = (pipe_key, zero_key) + recompute_key + tp_overlap_key + quant_key + (
                    treedef, tuple(scan_idx), tuple(bcast_idx),
                    tuple((i, _static_key(v)) for i, v in sorted(static.items())),
                    tuple((v.shape, str(v.dtype)) for v in scan_vals),
@@ -521,10 +531,19 @@ class StepFunction:
             extra = (_cached_mb_weights(
                 num_mb, bucket_state["active_mb"], mesh
             ),)
-        grads, outputs, grads_finite, next_rng, fused_out, health_word = (
+        if qmode == "fp8":
+            # The delayed-scaling state rides the step like the fp16
+            # loss scale: last step's scales enter as a program input,
+            # the rolled history + refreshed scales come back as the
+            # program's quant output, absorbed below.
+            extra = extra + (quant_mod.ensure_state().arrays(),)
+        (grads, outputs, grads_finite, next_rng, fused_out, health_word,
+         quant_out) = (
             compiled(in_params, opt_state, scan_vals, bcast_vals, rng,
                      loss_scale, *extra)
         )
+        if qmode == "fp8" and quant_out:
+            quant_mod.ensure_state().absorb(quant_out)
         state.step_rng = next_rng
         schema = list(getattr(compiled, "health_schema", ()) or ())
         if schema:
@@ -678,6 +697,13 @@ class StepFunction:
             if has_backward:
                 def scaled_fwd(run_params, mb_leaves, bcast_leaves, key):
                     loss, out = mb_forward(run_params, mb_leaves, bcast_leaves, key)
+                    # fp8 delayed scaling: amax recorded during this
+                    # forward are JVP-trace values — they must exit
+                    # value_and_grad as aux OUTPUTS (a Python-side stash
+                    # would hold dead tracers once the grad closes).
+                    qd = _quant().scan_drain()
+                    if qd:
+                        out = (out, qd)
                     # fp16: differentiate scale*loss so half grads stay
                     # representable (reference LossScaler.backward).
                     return loss * loss_scale, out
@@ -794,6 +820,12 @@ class StepFunction:
                     (loss_v, out), grads = grad_fn(
                         run_params, mb_leaves, bcast_leaves, key
                     )
+                    if _quant().scan_was_drained():
+                        # Unwrap the aux-threaded amax and re-record them
+                        # at THIS trace level so the body-end drain ships
+                        # them out of the microbatch scan.
+                        out, qaux = out
+                        _quant().absorb_stacked(qaux)
                     if wmb is not None:
                         grads = jax.tree_util.tree_map(
                             lambda g: wmb.astype(g.dtype) * g, grads
@@ -805,6 +837,13 @@ class StepFunction:
                     # Health sentinel: the per-microbatch loss rides out of
                     # the scan so the word records the FIRST bad microbatch.
                     ys = (out, loss_v) if hc is not None else out
+                    # fp8 delayed scaling: the amax observations absorbed
+                    # from the grad aux above exit the scan as stacked
+                    # outputs; () outside a quant trace — the ys pytree
+                    # (and the program) is unchanged at the default.
+                    qd = _quant().scan_drain()
+                    if qd:
+                        ys = (ys, qd)
                     return acc, ys
 
                 acc0 = jax.tree_util.tree_map(
@@ -823,6 +862,12 @@ class StepFunction:
                 grads, ys = jax.lax.scan(
                     z3_body if use_z3 else body, acc0, xs
                 )
+                if _quant().scan_was_drained():
+                    ys, qstk = ys
+                    # Max over the microbatch axis: one amax per slot for
+                    # the whole step, folded into the rolled history at
+                    # the runner's finalize.
+                    _quant().absorb_stacked(qstk)
                 if hc is not None:
                     outs, losses = ys
                     hc.add_stacked("loss", losses / loss_scale)
@@ -858,9 +903,13 @@ class StepFunction:
             def body(carry, xs):
                 mb_leaves, key = xs
                 _, out = mb_forward(run_params, mb_leaves, bcast_leaves, key)
-                return carry, out
+                qd = _quant().scan_drain()
+                return carry, ((out, qd) if qd else out)
 
             _, outs = jax.lax.scan(body, 0, (scan_leaves, keys))
+            if _quant().scan_was_drained():
+                outs, qstk = outs
+                _quant().absorb_stacked(qstk)
             if hc is not None:
                 hc.add_stacked("outputs", outs)
             return None, outs, None
@@ -1057,6 +1106,14 @@ class StepFunction:
         return _make_runner(step_impl, "step_pipeline", scan_meta, fused_update, model)
 
 
+def _quant():
+    """Lazy quant-module accessor for the trace-time seams (keeps the
+    import out of step.py's module load order)."""
+    from smdistributed_modelparallel_tpu import quant
+
+    return quant
+
+
 def _make_runner(step_impl, name, scan_meta, fused_update, model,
                  raw_divisor=None):
     """Jit + AOT-compile the full per-step program once.
@@ -1094,12 +1151,24 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
     hmode = health.mode()
     schema_box = []
 
+    # fp8 delayed scaling (matmul_precision: fp8): the runner decides
+    # ONCE, at build time, whether this program threads QuantState —
+    # mirroring the health sentinel: at the "bf16" default no context
+    # installs, the quant output is () (flattens to nothing), and the
+    # traced program is byte-identical to a build without smp.quant.
+    quanted = _quant().matmul_precision_mode(state.cfg) == "fp8"
+
     def full_impl(params, opt_state, raw_scan, bcast_vals, rng, loss_scale,
                   *extra):
         # `extra` is the shape-bucketing microbatch-weight vector when the
-        # step engine built a masked program; empty otherwise (and the
-        # traced program is byte-identical to the pre-bucketing build).
-        with health.collecting(hmode) as hc:
+        # step engine built a masked program, then the QuantState arrays
+        # under fp8; empty otherwise (and the traced program is
+        # byte-identical to the pre-bucketing build).
+        qarrs = None
+        if quanted:
+            qarrs = extra[-1]
+            extra = extra[:-1]
+        with _quant().step_trace(qarrs), health.collecting(hmode) as hc:
             if hc is not None and hc.mode == "full":
                 hc.add_tree("params", params)
             use_rng, next_rng = jax.random.split(rng)
@@ -1149,7 +1218,12 @@ def _make_runner(step_impl, name, scan_meta, fused_update, model,
                 if packed is not None:
                     word = packed
                     schema_box[:] = names
-        return grads, outs, finite, next_rng, fused_out, word
+            # Rolled amax history + refreshed scales — the program's
+            # quant output, absorbed into state.quant_state by the step
+            # engine. () when not quanted: the flat outputs (and the
+            # compiled program) are unchanged.
+            qout = _quant().finalize(qarrs) if quanted else ()
+        return grads, outs, finite, next_rng, fused_out, word, qout
 
     # fused_step_donation: params/opt_state buffers alias into
     # new_params/new_opt (same shapes + pinned shardings), dropping the

@@ -224,7 +224,25 @@ def _knob_facts():
         # (pre-knob disk entries keep verifying); a knob flip is a
         # version mismatch, never a warm hit of the other program.
         **_tp_overlap_knob_facts(cfg),
+        # Quantization knobs (matmul_precision / SMP_KV_QUANT /
+        # SMP_DECODE_WEIGHTS), same contract: bf16/none contribute no
+        # facts at all.
+        **_quant_knob_facts(cfg),
     }
+
+
+def _quant_knob_facts(cfg):
+    from smdistributed_modelparallel_tpu import quant
+
+    facts = {}
+    mode = quant.matmul_precision_mode(cfg)
+    if mode != "bf16":
+        facts["matmul_precision"] = mode
+    if quant.kv_quant_mode() != "none":
+        facts["kv_quant"] = quant.kv_quant_mode()
+    if quant.decode_weights_mode() != "none":
+        facts["decode_weights"] = quant.decode_weights_mode()
+    return facts
 
 
 def _tp_overlap_knob_facts(cfg):

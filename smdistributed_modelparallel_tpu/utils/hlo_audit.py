@@ -1026,6 +1026,133 @@ def jnp_dtype_bytes(dtype):
 
 
 # ----------------------------------------------------------------------
+# Low-precision (fp8) evidence census + the silently-upcast detector
+# ----------------------------------------------------------------------
+
+_F8_E4M3_RE = re.compile(r"f8e4m3", re.IGNORECASE)
+_F8_E5M2_RE = re.compile(r"f8e5m2", re.IGNORECASE)
+_HLO_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(\S+)")
+_HLO_SHAPE_RE = re.compile(r"\[([\d,]*)\]")
+
+
+def _shape_elements(type_str):
+    m = _HLO_SHAPE_RE.search(type_str)
+    if not m or not m.group(1):
+        return 1
+    n = 1
+    for d in m.group(1).split(","):
+        n *= int(d)
+    return n
+
+
+def quant_report(hlo_text):
+    """fp8 evidence census over the compiled HLO (matmul_precision:
+    fp8 programs only — the block is additive, so every bf16
+    fingerprint is unchanged).
+
+    - ``native_f8_dots``: dot/convolution lines consuming f8-typed
+      operands directly — what an f8-capable TPU MXU lowers to.
+    - ``fp8_origin_dots``: dots whose operands are one-hop ``convert``
+      upcasts OF an f8 value — XLA:CPU's legalization (it upcasts f8
+      operands to f32 before the dot). The VALUES flowing through are
+      still the quantized grid, so CPU-smoke programs count here.
+    - ``f8_casts``: value-producing ops with an f8 result type, by
+      format (e4m3 forward operands, e5m2 backward cotangents).
+
+    A quantized program shows nonzero evidence in at least one bucket;
+    all-zero under mode=fp8 is the ``quant_upcast`` finding."""
+    casts = {"e4m3": 0, "e5m2": 0}
+    f8_names = set()
+    upcast_names = set()
+    native_dots = 0
+    origin_dots = 0
+    for line in hlo_text.splitlines():
+        m = _HLO_DEF_RE.match(line)
+        if not m:
+            continue
+        name, out_type = m.group(1), m.group(2)
+        out_f8 = bool(
+            _F8_E4M3_RE.search(out_type) or _F8_E5M2_RE.search(out_type)
+        )
+        if out_f8:
+            f8_names.add(name)
+            if _F8_E4M3_RE.search(out_type):
+                casts["e4m3"] += 1
+            else:
+                casts["e5m2"] += 1
+        body = line[m.end(2):]
+        if "convert(" in body and not out_f8:
+            # Upcast convert FROM f8: operand type printed inline, or the
+            # operand name is a known f8 producer.
+            if (_F8_E4M3_RE.search(body) or _F8_E5M2_RE.search(body)
+                    or any(
+                        op in f8_names
+                        for op in re.findall(r"%([\w.\-]+)", body)
+                    )):
+                upcast_names.add(name)
+        if " dot(" in line or re.search(r"\bdot\(", body):
+            ops = re.findall(r"%([\w.\-]+)", body)
+            if (_F8_E4M3_RE.search(body) or _F8_E5M2_RE.search(body)
+                    or any(op in f8_names for op in ops)):
+                native_dots += 1
+            elif any(op in upcast_names for op in ops):
+                origin_dots += 1
+    return {
+        "native_f8_dots": native_dots,
+        "fp8_origin_dots": origin_dots,
+        "f8_casts": casts,
+    }
+
+
+def _largest_wide_dot(hlo_text):
+    """(name, elements) of the biggest dot with non-f8 operands — the
+    one the quant_upcast finding names as the likeliest missed seam."""
+    best = None
+    for line in hlo_text.splitlines():
+        m = _HLO_DEF_RE.match(line)
+        if not m:
+            continue
+        body = line[m.end(2):]
+        if not re.search(r"\bdot\(", body):
+            continue
+        if _F8_E4M3_RE.search(line) or _F8_E5M2_RE.search(line):
+            continue
+        n = _shape_elements(m.group(2))
+        if best is None or n > best[1]:
+            best = (m.group(1), n)
+    return best
+
+
+def _quant_findings(quant_block, hlo_text):
+    """The silently-upcast-matmul detector: mode=fp8 promised f8 dots
+    but the compiled program carries ZERO fp8 evidence — no native f8
+    dot, no fp8-origin dot, no f8 cast. That is the quantization
+    equivalent of the missing_tp_ring finding: the knob was paid for
+    (scale state threaded, cache keys split) and silently bought
+    nothing."""
+    findings = []
+    if quant_block is None:
+        return findings
+    if (quant_block["native_f8_dots"] or quant_block["fp8_origin_dots"]
+            or any(quant_block["f8_casts"].values())):
+        return findings
+    wide = _largest_wide_dot(hlo_text)
+    return [{
+        "kind": "quant_upcast",
+        "tensor": wide[0] if wide else "*",
+        "bytes_wasted": 0,
+        "detail": (
+            "matmul_precision=fp8 but the compiled program contains no "
+            "f8 evidence at all (no f8-operand dot, no fp8-origin dot, "
+            "no f8 cast) — every seam dispatched the full-precision "
+            "path"
+            + (f"; largest full-precision dot: %{wide[0]} "
+               f"({wide[1]} elements)" if wide else "")
+        ),
+    }]
+
+
+# ----------------------------------------------------------------------
 # The audit itself
 # ----------------------------------------------------------------------
 
@@ -1035,7 +1162,7 @@ class ProgramAudit:
 
     def __init__(self, name, key, census, remat, memory, findings,
                  flops, bytes_accessed, hlo_sha256, config, zero=None,
-                 recompute=None, tp_overlap=None):
+                 recompute=None, tp_overlap=None, quant=None):
         self.name = name
         self.key = key
         self.census = census
@@ -1049,6 +1176,7 @@ class ProgramAudit:
         self.zero = zero
         self.recompute = recompute
         self.tp_overlap = tp_overlap
+        self.quant = quant
         self.fingerprint = self._fingerprint()
         self.fingerprint_hash = fingerprint_hash(self.fingerprint)
 
@@ -1098,6 +1226,10 @@ class ProgramAudit:
         # ring census/overlap-evidence block.
         if self.tp_overlap is not None:
             fp["tp_overlap"] = self.tp_overlap
+        # Additive likewise: only matmul_precision=fp8 step programs
+        # carry the fp8 evidence census.
+        if self.quant is not None:
+            fp["quant"] = self.quant
         return fp
 
     def as_dict(self):
@@ -1130,6 +1262,19 @@ def _config_snapshot(cfg):
     tp_overlap = _tp_overlap_mode(cfg)
     if tp_overlap != "off":
         snap["tp_overlap"] = tp_overlap
+    # Additive likewise for the quant knob family (bf16/none omitted).
+    try:
+        from smdistributed_modelparallel_tpu import quant as _quant
+
+        mode = _quant.matmul_precision_mode(cfg)
+        if mode != "bf16":
+            snap["matmul_precision"] = mode
+        if _quant.kv_quant_mode() != "none":
+            snap["kv_quant"] = _quant.kv_quant_mode()
+        if _quant.decode_weights_mode() != "none":
+            snap["decode_weights"] = _quant.decode_weights_mode()
+    except Exception:  # pragma: no cover - defensive
+        pass
     return snap
 
 
@@ -1177,6 +1322,18 @@ def audit_compiled(name, compiled, key=None, params=None,
     tp_overlap = None
     if _tp_overlap_mode(cfg) != "off" and tp_ring_expected is not False:
         tp_overlap = tp_overlap_report(text, mesh=mesh)
+    # fp8 evidence census: training step programs only (serving/decode
+    # programs never dispatch the fp8 seams — ``tp_ring_expected=False``
+    # marks that family, exactly as for the ring detector).
+    quant = None
+    try:
+        from smdistributed_modelparallel_tpu import quant as _quant_mod
+
+        if (_quant_mod.matmul_precision_mode(cfg) != "bf16"
+                and tp_ring_expected is not False):
+            quant = quant_report(text)
+    except Exception:  # pragma: no cover - defensive
+        pass
     recompute = None
     try:
         from smdistributed_modelparallel_tpu.parallel import (
@@ -1195,6 +1352,7 @@ def audit_compiled(name, compiled, key=None, params=None,
     )
     findings += _loop_findings(text, census, cfg, mesh)
     findings += _tp_overlap_findings(tp_overlap, cfg, mesh)
+    findings += _quant_findings(quant, text)
     if extra_findings_fn is not None:
         # Program-owner-specific detectors (e.g. the serving engine's
         # replicated-KV-pool check) — run on whatever executable is being
@@ -1217,7 +1375,7 @@ def audit_compiled(name, compiled, key=None, params=None,
     audit = ProgramAudit(
         name, key, census, remat, memory, findings, flops, bytes_accessed,
         hlo_sha, _config_snapshot(cfg), zero=zero, recompute=recompute,
-        tp_overlap=tp_overlap,
+        tp_overlap=tp_overlap, quant=quant,
     )
     if publish:
         # Unpublished audits stay out of the registry too: a verification
@@ -1346,7 +1504,7 @@ def bench_summary(audit):
 #: compare (memory/FLOPs/hashes move with jaxlib versions; these move
 #: only when the program's parallel structure does).
 SEMANTIC_FIELDS = ("config", "collectives", "replicated", "remat", "zero",
-                   "recompute", "tp_overlap")
+                   "recompute", "tp_overlap", "quant")
 
 
 def diff(a, b, fields=None, remat_tol=0.02):
@@ -1408,6 +1566,21 @@ def diff(a, b, fields=None, remat_tol=0.02):
         for k in sorted(set(ta) | set(tb)):
             if ta.get(k) != tb.get(k):
                 add(f"tp_overlap.{k}", ta.get(k), tb.get(k))
+    if picked("quant"):
+        # Evidence presence, not exact counts: cast/dot tallies move with
+        # jaxlib fusion decisions; whether a bucket holds f8 evidence at
+        # all only moves when the program's quantization does.
+        qa, qb = a.get("quant") or {}, b.get("quant") or {}
+        if bool(qa) != bool(qb):
+            add("quant.present", bool(qa), bool(qb))
+        elif qa:
+            for k in ("native_f8_dots", "fp8_origin_dots"):
+                if bool(qa.get(k)) != bool(qb.get(k)):
+                    add(f"quant.{k}", qa.get(k), qb.get(k))
+            fa_, fb_ = qa.get("f8_casts") or {}, qb.get("f8_casts") or {}
+            for k in sorted(set(fa_) | set(fb_)):
+                if bool(fa_.get(k)) != bool(fb_.get(k)):
+                    add(f"quant.f8_casts.{k}", fa_.get(k), fb_.get(k))
     if picked("memory"):
         ma, mb = a.get("memory", {}), b.get("memory", {})
         for k in sorted(set(ma) | set(mb)):

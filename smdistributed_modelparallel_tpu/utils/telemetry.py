@@ -1171,10 +1171,15 @@ def record_serve_trace(event, rid, trace=None, slot=-1, pos=-1, detail=""):
 
 
 def record_serve_occupancy(queue_depth, active_slots, total_slots,
-                           kv_used, kv_free, kv_reserved, kv_total):
+                           kv_used, kv_free, kv_reserved, kv_total,
+                           block_bytes=None):
     """Continuous-batching occupancy gauges: request queue depth, decode
     slots in use, and KV-pool block accounting (used / free / promised-
-    but-unallocated reservations / total)."""
+    but-unallocated reservations / total). ``block_bytes`` (bytes per
+    pool block AT THE POOL DTYPE, scale sidecars included) additionally
+    publishes the block counts as ``smp_serve_kv_bytes`` — the gauge
+    that makes the int8-KV halving claim checkable against the bf16
+    pool rather than inferred from dtype names."""
     telemetry.gauge(
         "smp_serve_queue_depth", "requests waiting for a decode slot"
     ).set(int(queue_depth))
@@ -1190,6 +1195,50 @@ def record_serve_occupancy(queue_depth, active_slots, total_slots,
     g_kv.labels(state="free").set(int(kv_free))
     g_kv.labels(state="reserved").set(int(kv_reserved))
     g_kv.labels(state="total").set(int(kv_total))
+    if block_bytes is not None:
+        g_b = telemetry.gauge(
+            "smp_serve_kv_bytes",
+            "paged KV-pool bytes by state (blocks x bytes per block at "
+            "the pool dtype, including quantization-scale sidecars)",
+        )
+        g_b.labels(state="used").set(int(kv_used) * int(block_bytes))
+        g_b.labels(state="free").set(int(kv_free) * int(block_bytes))
+        g_b.labels(state="reserved").set(
+            int(kv_reserved) * int(block_bytes)
+        )
+        g_b.labels(state="total").set(int(kv_total) * int(block_bytes))
+
+
+def record_quant_state(slots, amax, scale):
+    """Latest delayed-scaling statistics per quantization slot
+    (``quant.QuantState.absorb`` after each fp8 step): the newest amax
+    observation and the dequantization scale now in force."""
+    g_a = telemetry.gauge(
+        "smp_quant_amax",
+        "latest per-slot amax observation of the fp8 delayed-scaling "
+        "recipe",
+    )
+    g_s = telemetry.gauge(
+        "smp_quant_scale",
+        "per-slot fp8 dequantization scale currently in force",
+    )
+    for slot, a, s in zip(slots, amax, scale):
+        g_a.labels(site=slot).set(float(a))
+        g_s.labels(site=slot).set(float(s))
+
+
+def record_quant_dispatch(site, path):
+    """One low-precision dispatch decision at trace/setup time: a seam
+    routed through fp8 (``path=fp8``), a knob canonicalized back to
+    bf16 (``path=bf16_fallback``), the KV pool went int8
+    (``site=kv_cache``), or decode weights were repacked
+    (``site=decode_weights``). Counts are per-trace, not per-step —
+    the signal is WHICH paths engaged, mirroring the fused-kernel
+    dispatch counter."""
+    telemetry.counter(
+        "smp_quant_dispatch_total",
+        "low-precision dispatch decisions by seam and path",
+    ).labels(site=site, path=path).inc()
 
 
 def record_serve_programs(n):

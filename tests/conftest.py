@@ -196,6 +196,14 @@ _SLOW_TESTS = (
     # the in-process burst autoscale end-to-end pays 3 engines' compiles
     # (static reference, replica0, the warm-started standby).
     "test_controller.py::TestAutoscaleEndToEnd",
+    # Quant heavy multi-compile cases: the fp8 acceptance gate (bf16
+    # baseline + fp8 compile, parity + census + golden in one test),
+    # the upcast-detector e2e, and the int8-KV serving gate stay fast
+    # in test_quant.py; the checkpoint/elastic round trip builds three
+    # fp8 setups and the weight-only parity runs pay 2 engines' + many
+    # generate-reference compiles.
+    "test_quant.py::TestQuantCheckpoint",
+    "test_quant.py::TestDecodeWeightsInt8",
 )
 
 

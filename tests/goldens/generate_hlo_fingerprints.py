@@ -90,6 +90,22 @@ TP_OVERLAP_CONFIGS = {
 }
 
 
+# The fp8 training program (tests/test_quant.py gate): the smp.nn
+# transformer LM-head train step with `matmul_precision: fp8` — the
+# fingerprint's `quant` block commits the fp8 evidence census (e4m3
+# forward casts, e5m2 gradient casts; on XLA:CPU the dots legalize as
+# fp8-origin upcasts, counted as evidence) and the config snapshot
+# carries `matmul_precision: fp8`. Compiled LAST so every earlier
+# golden stays byte-stable. Gated on evidence PRESENCE per bucket, not
+# exact counts (see hlo_audit.diff) — jaxlib fusion churn alone does
+# not require regeneration.
+QUANT_CONFIGS = {
+    "quant_fp8": {
+        "microbatches": 2, "ddp": True, "matmul_precision": "fp8",
+    },
+}
+
+
 def fingerprint_of(cfg):
     import jax
     import jax.numpy as jnp
@@ -222,6 +238,13 @@ def main():
         fp["name"] = name
         programs[name] = fp
     for name, cfg in TP_OVERLAP_CONFIGS.items():
+        sys.stderr.write(f"compiling {name} ...\n")
+        fp = tp_overlap_fingerprint_of(cfg)
+        fp["name"] = name
+        programs[name] = fp
+    for name, cfg in QUANT_CONFIGS.items():
+        # Same smp.nn LM-head geometry as the tp_overlap golden — the
+        # fp8 seams live in the same layer family.
         sys.stderr.write(f"compiling {name} ...\n")
         fp = tp_overlap_fingerprint_of(cfg)
         fp["name"] = name
